@@ -1,0 +1,32 @@
+"""agentcontrolplane_tpu — a TPU-native agent control plane.
+
+A from-scratch rebuild of the capabilities of humanlayer/agentcontrolplane
+(reference: /root/reference, snapshot 2025-07-04): durable, Kubernetes-style
+orchestration of long-lived AI agents — declarative LLM / Agent / Task /
+ToolCall / MCPServer / ContactChannel objects reconciled by phase machines
+whose entire execution state is the checkpointed context window — plus an
+in-tree ``provider: tpu`` LLM backend: a JAX/XLA generate loop (pjit tensor
+parallelism over ICI, paged KV cache, continuous batching of concurrent Task
+CRs) replacing the reference's delegation to external LLM SaaS.
+
+Package layout:
+
+- ``api``        — object model (the reference's ``acp/api/v1alpha1``).
+- ``kernel``     — the control-plane runtime the reference gets from
+                   Kubernetes: durable object store with watches, optimistic
+                   concurrency, label selection, owner-reference GC; leases;
+                   events; rate-limited workqueues; a controller manager.
+- ``controllers``— the six reconcilers (task, toolcall, agent, llm,
+                   mcpserver, contactchannel).
+- ``llmclient``  — provider-agnostic chat-completion seam + providers.
+- ``mcp``        — MCP server manager (stdio/http transports) + adapters.
+- ``humanlayer`` — human approval / contact clients (in-tree + HTTP).
+- ``server``     — REST API (aiohttp).
+- ``models``     — JAX model definitions (Llama family).
+- ``ops``        — TPU ops: attention, paged KV cache, sampling, RoPE, norms.
+- ``parallel``   — meshes, shardings, ring attention, collectives.
+- ``engine``     — serving engine: prefill/decode, continuous batching.
+- ``train``      — sharded training/fine-tuning step (dp/tp/sp).
+"""
+
+__version__ = "0.1.0"

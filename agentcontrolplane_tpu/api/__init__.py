@@ -1,0 +1,5 @@
+from .meta import ObjectMeta, OwnerReference, Resource, new_meta
+from .resources import *  # noqa: F401,F403
+from .resources import KINDS, from_doc
+
+__all__ = ["ObjectMeta", "OwnerReference", "Resource", "new_meta", "KINDS", "from_doc"]

@@ -1,0 +1,77 @@
+"""Object metadata — the subset of Kubernetes ObjectMeta the reference relies on.
+
+The reference (humanlayer/agentcontrolplane) stores all execution state in CRs
+in etcd and leans on: names/namespaces, labels (fan-out/fan-in joins, e.g.
+``acp/internal/controller/task/state_machine.go:296-299``), owner references
+(GC of ToolCalls and child Tasks, ``state_machine.go:693-722``), and
+resourceVersion optimistic concurrency (conflict-retried status updates,
+``acp/internal/controller/agent/state_machine.go:162-204``).
+"""
+
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+
+class OwnerReference(BaseModel):
+    """Reference to an owning object; owned objects are garbage-collected.
+
+    Mirrors the reference's use of metav1.OwnerReference when a Task creates
+    ToolCall CRs (``acp/internal/controller/task/state_machine.go:700-712``).
+    """
+
+    kind: str
+    name: str
+    uid: str
+    controller: bool = True
+
+
+class ObjectMeta(BaseModel):
+    model_config = ConfigDict(populate_by_name=True)
+
+    name: str
+    namespace: str = "default"
+    uid: str = Field(default_factory=lambda: uuid.uuid4().hex)
+    resource_version: int = 0
+    generation: int = 0
+    labels: dict[str, str] = Field(default_factory=dict)
+    annotations: dict[str, str] = Field(default_factory=dict)
+    owner_references: list[OwnerReference] = Field(default_factory=list)
+    creation_timestamp: float = Field(default_factory=time.time)
+    deletion_timestamp: Optional[float] = None
+
+
+class Resource(BaseModel):
+    """Base class for every API object (the reference's CRD equivalent).
+
+    Subclasses set ``kind`` as a class-level default and define ``spec`` and
+    ``status`` pydantic models.
+    """
+
+    model_config = ConfigDict(populate_by_name=True)
+
+    kind: str = ""
+    metadata: ObjectMeta
+
+    @property
+    def name(self) -> str:
+        return self.metadata.name
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.namespace
+
+    @property
+    def key(self) -> tuple[str, str, str]:
+        return (self.kind, self.metadata.namespace, self.metadata.name)
+
+    def owner_ref(self) -> OwnerReference:
+        return OwnerReference(kind=self.kind, name=self.metadata.name, uid=self.metadata.uid)
+
+
+def new_meta(name: str, namespace: str = "default", labels: dict[str, str] | None = None) -> ObjectMeta:
+    return ObjectMeta(name=name, namespace=namespace, labels=dict(labels or {}))

@@ -1,0 +1,486 @@
+"""The object model: 6 agent-orchestration kinds + Secret/Event/Lease.
+
+Rebuilt from the reference's CRDs in ``acp/api/v1alpha1/`` (see SURVEY.md §1 L1):
+
+- LLM            (``llm_types.go:140-173``)
+- Agent          (``agent_types.go:8-35``)
+- Task           (``task_types.go``)
+- ToolCall       (``toolcall_types.go``)
+- MCPServer      (``mcpserver_types.go:9-39``)
+- ContactChannel (``contactchannel_types.go:23-87``)
+
+plus the Kubernetes-native kinds the reference leans on (Secret for API keys,
+Event for user-facing execution history, coordination Lease for distributed
+locking) which our kernel provides in-tree.
+
+Design deltas from the reference (TPU-native, not a port):
+
+- provider enum gains ``tpu``: an in-tree JAX/XLA serving backend (the north
+  star) alongside the external SaaS providers.
+- floats are real floats (the reference encodes temperature/topP as validated
+  strings to work around CRD schema limits — a k8s artifact we don't inherit).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Literal, Optional
+
+from pydantic import BaseModel, ConfigDict, Field
+
+from .meta import ObjectMeta, Resource, new_meta
+
+# ---------------------------------------------------------------------------
+# Shared message model (reference: task_types.go:56-97)
+# ---------------------------------------------------------------------------
+
+
+class ToolCallFunction(BaseModel):
+    name: str
+    arguments: str = "{}"  # JSON-encoded arguments, as in OpenAI tool calls
+
+
+class MessageToolCall(BaseModel):
+    id: str
+    function: ToolCallFunction
+    type: str = "function"
+
+
+Role = Literal["system", "user", "assistant", "tool"]
+
+
+class Message(BaseModel):
+    """One message of a context window (task_types.go:56-97)."""
+
+    role: Role
+    content: str = ""
+    tool_calls: list[MessageToolCall] = Field(default_factory=list)
+    tool_call_id: Optional[str] = None
+    name: Optional[str] = None
+
+
+class SpanContext(BaseModel):
+    """Persisted trace root so one logical trace spans many reconciles
+    (reference: task_types.go:99-106, task/state_machine.go:122-137)."""
+
+    trace_id: str = ""
+    span_id: str = ""
+
+
+# ---------------------------------------------------------------------------
+# Secret (kernel-provided equivalent of core/v1 Secret)
+# ---------------------------------------------------------------------------
+
+
+class SecretSpec(BaseModel):
+    data: dict[str, str] = Field(default_factory=dict)
+
+
+class Secret(Resource):
+    kind: str = "Secret"
+    spec: SecretSpec = Field(default_factory=SecretSpec)
+
+
+class SecretKeyRef(BaseModel):
+    """APIKeySource (llm_types.go:34-38) / env-from-secret (mcpserver_types.go:41-61)."""
+
+    name: str
+    key: str
+
+
+# ---------------------------------------------------------------------------
+# LLM (llm_types.go)
+# ---------------------------------------------------------------------------
+
+LLMProvider = Literal["openai", "anthropic", "mistral", "google", "vertex", "tpu", "mock"]
+
+
+class BaseConfig(BaseModel):
+    """Common sampling parameters (llm_types.go:41-71)."""
+
+    model: str = ""
+    base_url: Optional[str] = None
+    temperature: Optional[float] = None
+    max_tokens: Optional[int] = None
+    top_p: Optional[float] = None
+    top_k: Optional[int] = None
+    frequency_penalty: Optional[float] = None
+    presence_penalty: Optional[float] = None
+
+
+class TPUProviderConfig(BaseModel):
+    """In-tree TPU serving backend config (no reference analogue; north star).
+
+    ``checkpoint`` is a local HF-format checkpoint directory (safetensors +
+    tokenizer); ``preset`` selects an architecture preset from
+    ``agentcontrolplane_tpu.models`` when serving randomly-initialised weights
+    (tests/benchmarks).
+    """
+
+    checkpoint: Optional[str] = None
+    preset: Optional[str] = None
+    tensor_parallelism: int = 0  # 0 = all local devices
+    max_sequences: int = 64
+    max_context: int = 8192
+    page_size: int = 16
+    quantization: Optional[Literal["int8"]] = None
+
+
+class LLMSpec(BaseModel):
+    provider: LLMProvider
+    api_key_from: Optional[SecretKeyRef] = None
+    parameters: BaseConfig = Field(default_factory=BaseConfig)
+    tpu: Optional[TPUProviderConfig] = None
+    # Per-provider extras (llm_types.go:73-138); kept as open maps.
+    provider_config: dict[str, Any] = Field(default_factory=dict)
+
+
+class LLMStatus(BaseModel):
+    ready: bool = False
+    status: Literal["", "Ready", "Error", "Pending"] = ""
+    status_detail: str = ""
+
+
+class LLM(Resource):
+    kind: str = "LLM"
+    spec: LLMSpec
+    status: LLMStatus = Field(default_factory=LLMStatus)
+
+
+# ---------------------------------------------------------------------------
+# ContactChannel (contactchannel_types.go)
+# ---------------------------------------------------------------------------
+
+
+class SlackChannelConfig(BaseModel):
+    channel_or_user_id: str = ""
+    context_about_channel_or_user: str = ""
+
+
+class EmailChannelConfig(BaseModel):
+    address: str = ""
+    context_about_user: str = ""
+
+
+class ContactChannelSpec(BaseModel):
+    type: Literal["slack", "email"]
+    api_key_from: Optional[SecretKeyRef] = None
+    channel_api_key_from: Optional[SecretKeyRef] = None
+    channel_id: Optional[str] = None
+    slack: Optional[SlackChannelConfig] = None
+    email: Optional[EmailChannelConfig] = None
+
+
+class ContactChannelStatus(BaseModel):
+    ready: bool = False
+    status: Literal["", "Ready", "Error", "Pending"] = ""
+    status_detail: str = ""
+
+
+class ContactChannel(Resource):
+    kind: str = "ContactChannel"
+    spec: ContactChannelSpec
+    status: ContactChannelStatus = Field(default_factory=ContactChannelStatus)
+
+
+# ---------------------------------------------------------------------------
+# MCPServer (mcpserver_types.go)
+# ---------------------------------------------------------------------------
+
+
+class EnvVar(BaseModel):
+    name: str
+    value: Optional[str] = None
+    value_from: Optional[SecretKeyRef] = None
+
+
+class MCPServerSpec(BaseModel):
+    transport: Literal["stdio", "http"]
+    command: Optional[str] = None
+    args: list[str] = Field(default_factory=list)
+    env: list[EnvVar] = Field(default_factory=list)
+    url: Optional[str] = None
+    # Gates ALL tools of this server behind human approval
+    # (mcpserver_types.go:30-39).
+    approval_contact_channel: Optional[str] = None
+
+
+class MCPTool(BaseModel):
+    name: str
+    description: str = ""
+    input_schema: dict[str, Any] = Field(default_factory=dict)
+
+
+class MCPServerStatus(BaseModel):
+    connected: bool = False
+    status: Literal["", "Ready", "Error", "Pending"] = ""
+    status_detail: str = ""
+    tools: list[MCPTool] = Field(default_factory=list)
+
+
+class MCPServer(Resource):
+    kind: str = "MCPServer"
+    spec: MCPServerSpec
+    status: MCPServerStatus = Field(default_factory=MCPServerStatus)
+
+
+# ---------------------------------------------------------------------------
+# Agent (agent_types.go)
+# ---------------------------------------------------------------------------
+
+
+class LocalObjectRef(BaseModel):
+    name: str
+
+
+class AgentSpec(BaseModel):
+    llm_ref: LocalObjectRef
+    system: str
+    description: str = ""  # used in the delegate-tool description
+    mcp_servers: list[LocalObjectRef] = Field(default_factory=list)
+    human_contact_channels: list[LocalObjectRef] = Field(default_factory=list)
+    sub_agents: list[LocalObjectRef] = Field(default_factory=list)
+
+
+class ResolvedMCPServer(BaseModel):
+    name: str
+    tools: list[str] = Field(default_factory=list)
+
+
+class ResolvedSubAgent(BaseModel):
+    name: str
+    description: str = ""
+
+
+class AgentStatus(BaseModel):
+    """Caches *resolved* dependencies (agent_types.go:53-102)."""
+
+    ready: bool = False
+    status: Literal["", "Ready", "Error", "Pending"] = ""
+    status_detail: str = ""
+    valid_mcp_servers: list[ResolvedMCPServer] = Field(default_factory=list)
+    valid_human_contact_channels: list[str] = Field(default_factory=list)
+    valid_sub_agents: list[ResolvedSubAgent] = Field(default_factory=list)
+
+
+class Agent(Resource):
+    kind: str = "Agent"
+    spec: AgentSpec
+    status: AgentStatus = Field(default_factory=AgentStatus)
+
+
+# ---------------------------------------------------------------------------
+# Task (task_types.go)
+# ---------------------------------------------------------------------------
+
+# Phases (task_types.go:170-193). The reference declares 9 but only 7 are
+# reachable (SendContextWindowToLLM / CheckingToolCalls / ErrorBackoff are
+# never set by the state machine — SURVEY.md §1); we declare the reachable set.
+TASK_PHASE_INITIALIZING = "Initializing"
+TASK_PHASE_PENDING = "Pending"
+TASK_PHASE_READY_FOR_LLM = "ReadyForLLM"
+TASK_PHASE_TOOL_CALLS_PENDING = "ToolCallsPending"
+TASK_PHASE_FINAL_ANSWER = "FinalAnswer"
+TASK_PHASE_FAILED = "Failed"
+
+TaskPhase = Literal[
+    "",
+    "Initializing",
+    "Pending",
+    "ReadyForLLM",
+    "ToolCallsPending",
+    "FinalAnswer",
+    "Failed",
+]
+
+# Label keys for fan-out/fan-in joins (task/state_machine.go:296-299, 713-717).
+LABEL_TASK = "acp.tpu/task"
+LABEL_TOOL_CALL_REQUEST = "acp.tpu/toolcallrequest"
+LABEL_PARENT_TOOLCALL = "acp.tpu/parent-toolcall"
+LABEL_AGENT = "acp.tpu/agent"
+LABEL_V1BETA3 = "acp.tpu/v1beta3"
+
+
+class TaskSpec(BaseModel):
+    agent_ref: LocalObjectRef
+    # Exactly one of user_message / context_window (task_types.go:24-54).
+    user_message: Optional[str] = None
+    context_window: Optional[list[Message]] = None
+    contact_channel_ref: Optional[LocalObjectRef] = None
+    channel_token_from: Optional[SecretKeyRef] = None
+    thread_id: Optional[str] = None
+
+
+class TaskStatus(BaseModel):
+    phase: TaskPhase = ""
+    status: Literal["", "Ready", "Error", "Pending"] = ""
+    status_detail: str = ""
+    # THE source of truth for the conversation (task_types.go:137-139).
+    context_window: list[Message] = Field(default_factory=list)
+    message_count: int = 0
+    output: str = ""
+    user_msg_preview: str = ""  # first 50 chars (validation/task_validation.go)
+    error: str = ""
+    span_context: Optional[SpanContext] = None
+    tool_call_request_id: Optional[str] = None
+
+
+class Task(Resource):
+    kind: str = "Task"
+    spec: TaskSpec
+    status: TaskStatus = Field(default_factory=TaskStatus)
+
+
+# ---------------------------------------------------------------------------
+# ToolCall (toolcall_types.go)
+# ---------------------------------------------------------------------------
+
+TOOL_TYPE_MCP = "MCP"
+TOOL_TYPE_HUMAN_CONTACT = "HumanContact"
+TOOL_TYPE_DELEGATE = "DelegateToAgent"
+
+ToolType = Literal["MCP", "HumanContact", "DelegateToAgent"]
+
+# Phases (toolcall_types.go:89-116).
+TC_PHASE_PENDING = "Pending"
+TC_PHASE_RUNNING = "Running"
+TC_PHASE_SUCCEEDED = "Succeeded"
+TC_PHASE_FAILED = "Failed"
+TC_PHASE_AWAITING_HUMAN_INPUT = "AwaitingHumanInput"
+TC_PHASE_AWAITING_SUB_AGENT = "AwaitingSubAgent"
+TC_PHASE_AWAITING_HUMAN_APPROVAL = "AwaitingHumanApproval"
+TC_PHASE_READY_TO_EXECUTE = "ReadyToExecuteApprovedTool"
+TC_PHASE_ERR_REQUESTING_APPROVAL = "ErrorRequestingHumanApproval"
+TC_PHASE_ERR_REQUESTING_INPUT = "ErrorRequestingHumanInput"
+TC_PHASE_REJECTED = "ToolCallRejected"
+
+ToolCallPhase = Literal[
+    "",
+    "Pending",
+    "Running",
+    "Succeeded",
+    "Failed",
+    "AwaitingHumanInput",
+    "AwaitingSubAgent",
+    "AwaitingHumanApproval",
+    "ReadyToExecuteApprovedTool",
+    "ErrorRequestingHumanApproval",
+    "ErrorRequestingHumanInput",
+    "ToolCallRejected",
+]
+
+
+class ToolCallSpec(BaseModel):
+    tool_call_id: str
+    task_ref: LocalObjectRef
+    tool_ref: LocalObjectRef  # name is "server__tool" / "delegate_to_agent__x" / channel tool
+    tool_type: ToolType
+    arguments: str = "{}"
+
+
+class ToolCallStatus(BaseModel):
+    phase: ToolCallPhase = ""
+    status: Literal["", "Ready", "Error", "Pending", "Succeeded"] = ""
+    status_detail: str = ""
+    external_call_id: str = ""
+    result: str = ""
+    error: str = ""
+    span_context: Optional[SpanContext] = None
+    start_time: Optional[float] = None
+    completion_time: Optional[float] = None
+
+
+class ToolCall(Resource):
+    kind: str = "ToolCall"
+    spec: ToolCallSpec
+    status: ToolCallStatus = Field(default_factory=ToolCallStatus)
+
+
+# ---------------------------------------------------------------------------
+# Event (kernel-provided equivalent of core/v1 Event)
+# ---------------------------------------------------------------------------
+
+
+class EventSpec(BaseModel):
+    involved_kind: str = ""
+    involved_name: str = ""
+    involved_uid: str = ""
+    type: Literal["Normal", "Warning"] = "Normal"
+    reason: str = ""
+    message: str = ""
+    count: int = 1
+    last_timestamp: float = 0.0
+
+
+class Event(Resource):
+    kind: str = "Event"
+    spec: EventSpec = Field(default_factory=EventSpec)
+
+
+# ---------------------------------------------------------------------------
+# Lease (kernel-provided equivalent of coordination.k8s.io/v1 Lease)
+# ---------------------------------------------------------------------------
+
+
+class LeaseSpec(BaseModel):
+    holder_identity: str = ""
+    lease_duration_seconds: float = 30.0
+    acquire_time: float = 0.0
+    renew_time: float = 0.0
+
+
+class Lease(Resource):
+    kind: str = "Lease"
+    spec: LeaseSpec = Field(default_factory=LeaseSpec)
+
+
+# ---------------------------------------------------------------------------
+# Kind registry (deserialization from the store's canonical dict form)
+# ---------------------------------------------------------------------------
+
+KINDS: dict[str, type[Resource]] = {
+    "Secret": Secret,
+    "LLM": LLM,
+    "ContactChannel": ContactChannel,
+    "MCPServer": MCPServer,
+    "Agent": Agent,
+    "Task": Task,
+    "ToolCall": ToolCall,
+    "Event": Event,
+    "Lease": Lease,
+}
+
+
+def from_doc(doc: dict[str, Any]) -> Resource:
+    cls = KINDS[doc["kind"]]
+    return cls.model_validate(doc)
+
+
+__all__ = [
+    # message model
+    "Message", "MessageToolCall", "ToolCallFunction", "Role", "SpanContext",
+    # kinds
+    "Secret", "SecretSpec", "SecretKeyRef",
+    "LLM", "LLMSpec", "LLMStatus", "LLMProvider", "BaseConfig", "TPUProviderConfig",
+    "ContactChannel", "ContactChannelSpec", "ContactChannelStatus",
+    "SlackChannelConfig", "EmailChannelConfig",
+    "MCPServer", "MCPServerSpec", "MCPServerStatus", "MCPTool", "EnvVar",
+    "Agent", "AgentSpec", "AgentStatus", "ResolvedMCPServer", "ResolvedSubAgent",
+    "LocalObjectRef",
+    "Task", "TaskSpec", "TaskStatus", "TaskPhase",
+    "ToolCall", "ToolCallSpec", "ToolCallStatus", "ToolCallPhase", "ToolType",
+    "Event", "EventSpec",
+    "Lease", "LeaseSpec",
+    # phase/label constants
+    "TASK_PHASE_INITIALIZING", "TASK_PHASE_PENDING", "TASK_PHASE_READY_FOR_LLM",
+    "TASK_PHASE_TOOL_CALLS_PENDING", "TASK_PHASE_FINAL_ANSWER", "TASK_PHASE_FAILED",
+    "TC_PHASE_PENDING", "TC_PHASE_RUNNING", "TC_PHASE_SUCCEEDED", "TC_PHASE_FAILED",
+    "TC_PHASE_AWAITING_HUMAN_INPUT", "TC_PHASE_AWAITING_SUB_AGENT",
+    "TC_PHASE_AWAITING_HUMAN_APPROVAL", "TC_PHASE_READY_TO_EXECUTE",
+    "TC_PHASE_ERR_REQUESTING_APPROVAL", "TC_PHASE_ERR_REQUESTING_INPUT",
+    "TC_PHASE_REJECTED",
+    "TOOL_TYPE_MCP", "TOOL_TYPE_HUMAN_CONTACT", "TOOL_TYPE_DELEGATE",
+    "LABEL_TASK", "LABEL_TOOL_CALL_REQUEST", "LABEL_PARENT_TOOLCALL",
+    "LABEL_AGENT", "LABEL_V1BETA3",
+    # registry
+    "KINDS", "from_doc",
+]

@@ -1,0 +1,27 @@
+"""Store errors — the k8s apierrors subset the reference's controllers branch on."""
+
+from __future__ import annotations
+
+
+class StoreError(Exception):
+    pass
+
+
+class NotFound(StoreError):
+    """Equivalent of apierrors.IsNotFound — controllers branch on this to
+    requeue-and-wait (e.g. agent missing -> Task Pending,
+    reference acp/internal/controller/task/state_machine.go:379-424)."""
+
+
+class AlreadyExists(StoreError):
+    """Equivalent of apierrors.IsAlreadyExists — used for idempotent child
+    creation (reference toolcall/executor.go:184-238)."""
+
+
+class Conflict(StoreError):
+    """resourceVersion mismatch — optimistic-concurrency conflict; callers
+    re-Get and retry (reference agent/state_machine.go:162-204)."""
+
+
+class Invalid(StoreError):
+    """Validation failure at admission."""

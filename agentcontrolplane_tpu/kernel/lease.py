@@ -1,0 +1,85 @@
+"""Distributed locking via Leases.
+
+Reimplements the reference's coordination.k8s.io Lease pattern
+(``acp/internal/controller/task/state_machine.go:1069-1145`` and
+``acp/docs/distributed-locking.md``): create-or-adopt-expired semantics with a
+TTL, so a surviving operator replica can adopt a dead replica's in-flight task
+lock after expiry. Also used for leader election (``cmd/main.go:213-226``
+equivalent, see kernel.runtime.LeaderElector).
+"""
+
+from __future__ import annotations
+
+import time
+
+from ..api.resources import Lease, LeaseSpec
+from ..api.meta import ObjectMeta
+from .errors import AlreadyExists, Conflict, NotFound
+from .store import Store
+
+
+def try_acquire(
+    store: Store,
+    name: str,
+    holder: str,
+    namespace: str = "default",
+    ttl: float = 30.0,
+    now: float | None = None,
+) -> bool:
+    """Attempt to acquire/renew the lease. Returns True iff held by ``holder``.
+
+    Semantics mirror acquireTaskLease (task/state_machine.go:1069-1132):
+    - absent        -> create, acquired
+    - held by us    -> renew, acquired
+    - expired       -> adopt (CAS-guarded), acquired
+    - held, live    -> not acquired
+    """
+    now = time.time() if now is None else now
+    try:
+        existing = store.get("Lease", name, namespace)
+    except NotFound:
+        lease = Lease(
+            metadata=ObjectMeta(name=name, namespace=namespace),
+            spec=LeaseSpec(
+                holder_identity=holder,
+                lease_duration_seconds=ttl,
+                acquire_time=now,
+                renew_time=now,
+            ),
+        )
+        try:
+            store.create(lease)
+            return True
+        except AlreadyExists:
+            return False
+
+    assert isinstance(existing, Lease)
+    spec = existing.spec
+    expired = now - spec.renew_time > spec.lease_duration_seconds
+    if spec.holder_identity == holder or expired:
+        existing.spec = LeaseSpec(
+            holder_identity=holder,
+            lease_duration_seconds=ttl,
+            acquire_time=now if spec.holder_identity != holder else spec.acquire_time,
+            renew_time=now,
+        )
+        try:
+            store.update(existing)
+            return True
+        except (Conflict, NotFound):
+            return False
+    return False
+
+
+def release(store: Store, name: str, holder: str, namespace: str = "default") -> None:
+    """Delete the lease if held by ``holder`` (best-effort)."""
+    try:
+        lease = store.get("Lease", name, namespace)
+    except NotFound:
+        return
+    assert isinstance(lease, Lease)
+    if lease.spec.holder_identity == holder:
+        try:
+            store.delete("Lease", name, namespace)
+        except NotFound:
+            pass

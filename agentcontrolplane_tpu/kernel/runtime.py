@@ -1,0 +1,266 @@
+"""Controller manager — controller-runtime, asyncio-native.
+
+Mirrors the manager the reference builds in ``acp/cmd/main.go:208-323``:
+controllers are registered with the kinds they reconcile and the kinds they
+own (watch events on owned objects are mapped to the owning object's key, like
+controller-runtime's ``Owns()``), each gets a rate-limited workqueue fed by
+store watches, and N workers call ``reconcile(key)`` returning a ``Result``
+with requeue semantics. Leader election gates singleton runnables (the REST
+server, ``acp/internal/server/runnable.go:25-39``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+import uuid
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional, Protocol
+
+from ..api.meta import Resource
+from . import lease as leaselib
+from .events import EventRecorder
+from .queue import WorkQueue
+from .store import Key, Store, WatchEvent
+
+log = logging.getLogger("acp_tpu.runtime")
+
+
+@dataclass
+class Result:
+    """Reconcile outcome (controller-runtime ctrl.Result)."""
+
+    requeue: bool = False
+    requeue_after: Optional[float] = None
+
+    @staticmethod
+    def done() -> "Result":
+        return Result()
+
+    @staticmethod
+    def after(seconds: float) -> "Result":
+        return Result(requeue_after=seconds)
+
+
+class Reconciler(Protocol):
+    async def reconcile(self, key: Key) -> Result: ...
+
+
+KeyMapper = Callable[[Resource], Optional[Key]]
+
+
+def map_owner(owner_kind: str) -> KeyMapper:
+    """Map an owned object's event to its controller-owner's key."""
+
+    def mapper(obj: Resource) -> Optional[Key]:
+        for ref in obj.metadata.owner_references:
+            if ref.kind == owner_kind:
+                return (owner_kind, obj.metadata.namespace, ref.name)
+        return None
+
+    return mapper
+
+
+@dataclass
+class _Controller:
+    name: str
+    kind: str
+    reconciler: Reconciler
+    mappers: dict[str, KeyMapper] = field(default_factory=dict)
+    workers: int = 4
+    queue: WorkQueue[Key] = field(default_factory=WorkQueue)
+
+
+class LeaderElector:
+    """Lease-based leader election (cmd/main.go:213-226 equivalent)."""
+
+    def __init__(
+        self,
+        store: Store,
+        identity: str,
+        lease_name: str = "acp-tpu-leader",
+        namespace: str = "default",
+        ttl: float = 15.0,
+        renew_interval: float = 5.0,
+    ):
+        self._store = store
+        self.identity = identity
+        self._lease_name = lease_name
+        self._namespace = namespace
+        self._ttl = ttl
+        self._renew = renew_interval
+        self.is_leader = False
+        self._task: Optional[asyncio.Task] = None
+
+    async def _run(self) -> None:
+        while True:
+            self.is_leader = leaselib.try_acquire(
+                self._store, self._lease_name, self.identity, self._namespace, self._ttl
+            )
+            await asyncio.sleep(self._renew)
+
+    def start(self) -> None:
+        self._task = asyncio.ensure_future(self._run())
+
+    async def stop(self) -> None:
+        if self._task:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        if self.is_leader:
+            leaselib.release(self._store, self._lease_name, self.identity, self._namespace)
+            self.is_leader = False
+
+
+Runnable = Callable[[], Awaitable[None]]
+
+
+class Manager:
+    """Holds the store, recorder, controllers and runnables; runs them all."""
+
+    def __init__(
+        self,
+        store: Store,
+        identity: str | None = None,
+        leader_election: bool = False,
+    ):
+        self.store = store
+        self.identity = identity or f"acp-tpu-{uuid.uuid4().hex[:8]}"
+        self.recorder = EventRecorder(store)
+        self._controllers: list[_Controller] = []
+        self._runnables: list[tuple[Runnable, bool]] = []  # (fn, leader_gated)
+        self._tasks: list[asyncio.Task] = []
+        self._watches = []
+        self.elector = LeaderElector(store, self.identity) if leader_election else None
+        self._started = False
+
+    def add_controller(
+        self,
+        name: str,
+        kind: str,
+        reconciler: Reconciler,
+        owns: list[str] | None = None,
+        watches: dict[str, KeyMapper] | None = None,
+        workers: int = 4,
+    ) -> None:
+        mappers: dict[str, KeyMapper] = {}
+        for owned in owns or []:
+            mappers[owned] = map_owner(kind)
+        mappers.update(watches or {})
+        self._controllers.append(
+            _Controller(name=name, kind=kind, reconciler=reconciler, mappers=mappers, workers=workers)
+        )
+
+    def add_runnable(self, fn: Runnable, leader_gated: bool = False) -> None:
+        self._runnables.append((fn, leader_gated))
+
+    async def _watch_loop(self, ctl: _Controller) -> None:
+        kinds = {ctl.kind, *ctl.mappers.keys()}
+        watch = self.store.watch(kinds, namespace=None)
+        self._watches.append(watch)
+        # initial list (cache sync)
+        for obj in self.store.list(ctl.kind, namespace=None):
+            ctl.queue.add(obj.key)
+        async for ev in watch:
+            self._dispatch(ctl, ev)
+
+    def _dispatch(self, ctl: _Controller, ev: WatchEvent) -> None:
+        obj = ev.object
+        if obj.kind == ctl.kind:
+            # DELETED also enqueues: reconcile observes NotFound and releases
+            # non-owned resources (controller-runtime semantics).
+            ctl.queue.add(obj.key)
+            return
+        mapper = ctl.mappers.get(obj.kind)
+        if mapper is None:
+            return
+        key = mapper(obj)
+        if key is not None:
+            ctl.queue.add(key)
+
+    async def _worker(self, ctl: _Controller) -> None:
+        while True:
+            key = await ctl.queue.get()
+            if key is None:
+                return
+            try:
+                result = await ctl.reconciler.reconcile(key)
+            except Exception:
+                log.exception("%s: reconcile %s failed", ctl.name, key)
+                ctl.queue.add_rate_limited(key)
+            else:
+                ctl.queue.forget(key)
+                if result.requeue_after is not None:
+                    ctl.queue.add_after(key, result.requeue_after)
+                elif result.requeue:
+                    ctl.queue.add_rate_limited(key)
+            finally:
+                ctl.queue.done(key)
+
+    async def _leader_gated_runner(self, fn: Runnable) -> None:
+        """Run ``fn`` only while leader; cancel it on leadership loss and
+        restart it if leadership is re-acquired (no split-brain singletons)."""
+        assert self.elector is not None
+        while True:
+            while not self.elector.is_leader:
+                await asyncio.sleep(0.1)
+            task = asyncio.ensure_future(fn())
+            while self.elector.is_leader and not task.done():
+                await asyncio.sleep(0.1)
+            if not task.done():
+                task.cancel()
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+            if task.done() and self.elector.is_leader:
+                return  # fn finished on its own while still leader
+
+    async def start(self) -> None:
+        """Start everything; returns once all loops are scheduled."""
+        if self._started:
+            return
+        self._started = True
+        if self.elector:
+            self.elector.start()
+        for ctl in self._controllers:
+            ctl.queue = WorkQueue()  # fresh queue: stop() shutdown is permanent
+        for ctl in self._controllers:
+            self._tasks.append(asyncio.ensure_future(self._watch_loop(ctl)))
+            for _ in range(ctl.workers):
+                self._tasks.append(asyncio.ensure_future(self._worker(ctl)))
+        for fn, gated in self._runnables:
+            if gated and self.elector:
+                self._tasks.append(asyncio.ensure_future(self._leader_gated_runner(fn)))
+            else:
+                self._tasks.append(asyncio.ensure_future(fn()))
+        # yield once so watch loops register before callers mutate the store
+        await asyncio.sleep(0)
+
+    async def stop(self) -> None:
+        for ctl in self._controllers:
+            ctl.queue.shutdown()
+        for w in self._watches:
+            w.stop()
+        if self.elector:
+            await self.elector.stop()
+        for t in self._tasks:
+            t.cancel()
+        for t in self._tasks:
+            try:
+                await t
+            except (asyncio.CancelledError, Exception):
+                pass
+        self._tasks.clear()
+        self._watches.clear()
+        self._started = False
+
+    async def run_until(self, predicate: Callable[[], bool], timeout: float = 30.0) -> None:
+        deadline = time.monotonic() + timeout
+        while not predicate():
+            if time.monotonic() > deadline:
+                raise TimeoutError("run_until timed out")
+            await asyncio.sleep(0.02)

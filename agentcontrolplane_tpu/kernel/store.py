@@ -1,0 +1,389 @@
+"""Durable object store — the control-plane kernel.
+
+The reference outsources durable state to Kubernetes: etcd-backed CRDs,
+apiserver watches, label-selector Lists, resourceVersion optimistic
+concurrency, owner-reference garbage collection (SURVEY.md §0, §1 L0). This
+module provides those semantics in-tree so the control plane runs standalone
+on a TPU pod:
+
+- ``create/get/list/update/update_status/delete`` with deep-copied documents,
+  monotonically increasing ``resource_version``s and generation tracking;
+- label-selector ``list`` (exact-match map, like the reference's
+  ``client.MatchingLabels`` joins at task/state_machine.go:296-299);
+- ``watch`` streams (ADDED/MODIFIED/DELETED) feeding controller workqueues;
+- cascading deletion of owned objects (k8s GC equivalent, used for
+  Task -> ToolCall -> child-Task trees);
+- a pluggable durability backend: in-memory (tests) or sqlite WAL (operator),
+  so operator restart = resume, preserving the reference's defining
+  checkpoint/resume property (README.md:1291-1303 "async/await at the
+  infrastructure layer").
+
+Thread-safety: all mutating operations take an RLock so the TPU engine thread
+can read objects; watch delivery is asyncio-native (queues are drained by the
+controller manager on the event loop).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import sqlite3
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable, Optional
+
+from ..api.meta import Resource
+from ..api.resources import from_doc
+from .errors import AlreadyExists, Conflict, Invalid, NotFound
+
+Key = tuple[str, str, str]  # (kind, namespace, name)
+
+
+@dataclass
+class WatchEvent:
+    type: str  # "ADDED" | "MODIFIED" | "DELETED"
+    object: Resource
+
+    @property
+    def key(self) -> Key:
+        return self.object.key
+
+
+class Backend:
+    """Durability backend interface."""
+
+    def load_all(self) -> tuple[int, list[dict[str, Any]]]:
+        return 0, []
+
+    def put(self, doc: dict[str, Any]) -> None:
+        pass
+
+    def remove(self, key: Key) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class MemoryBackend(Backend):
+    pass
+
+
+class SqliteBackend(Backend):
+    """Append-to-latest sqlite backend (WAL) — the etcd stand-in."""
+
+    def __init__(self, path: str):
+        self._conn = sqlite3.connect(path, check_same_thread=False)
+        self._conn.execute("PRAGMA journal_mode=WAL")
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS objects ("
+            " kind TEXT, namespace TEXT, name TEXT, rv INTEGER, doc TEXT,"
+            " PRIMARY KEY (kind, namespace, name))"
+        )
+        self._lock = threading.Lock()
+
+    def load_all(self) -> tuple[int, list[dict[str, Any]]]:
+        with self._lock:
+            rows = self._conn.execute("SELECT rv, doc FROM objects").fetchall()
+        docs = [json.loads(doc) for _, doc in rows]
+        max_rv = max((rv for rv, _ in rows), default=0)
+        return max_rv, docs
+
+    def put(self, doc: dict[str, Any]) -> None:
+        meta = doc["metadata"]
+        with self._lock:
+            self._conn.execute(
+                "INSERT OR REPLACE INTO objects (kind, namespace, name, rv, doc)"
+                " VALUES (?, ?, ?, ?, ?)",
+                (doc["kind"], meta["namespace"], meta["name"], meta["resource_version"], json.dumps(doc)),
+            )
+            self._conn.commit()
+
+    def remove(self, key: Key) -> None:
+        with self._lock:
+            self._conn.execute(
+                "DELETE FROM objects WHERE kind=? AND namespace=? AND name=?", key
+            )
+            self._conn.commit()
+
+    def close(self) -> None:
+        with self._lock:
+            self._conn.close()
+
+
+@dataclass
+class _Watcher:
+    kinds: frozenset[str]
+    namespace: Optional[str]
+    queue: asyncio.Queue = field(default_factory=asyncio.Queue)
+    loop: Optional[asyncio.AbstractEventLoop] = None
+
+    def matches(self, ev: WatchEvent) -> bool:
+        if ev.object.kind not in self.kinds:
+            return False
+        if self.namespace is not None and ev.object.metadata.namespace != self.namespace:
+            return False
+        return True
+
+    def deliver(self, ev: WatchEvent) -> None:
+        if self.loop is not None and self.loop is not _current_loop():
+            self.loop.call_soon_threadsafe(self.queue.put_nowait, ev)
+        else:
+            self.queue.put_nowait(ev)
+
+
+def _current_loop() -> Optional[asyncio.AbstractEventLoop]:
+    try:
+        return asyncio.get_running_loop()
+    except RuntimeError:
+        return None
+
+
+def _match_labels(labels: dict[str, str], selector: dict[str, str]) -> bool:
+    return all(labels.get(k) == v for k, v in selector.items())
+
+
+class Store:
+    def __init__(self, backend: Backend | None = None):
+        self._backend = backend or MemoryBackend()
+        self._lock = threading.RLock()
+        self._objects: dict[Key, dict[str, Any]] = {}
+        self._watchers: list[_Watcher] = []
+        rv, docs = self._backend.load_all()
+        self._rv = rv
+        for doc in docs:
+            obj = from_doc(doc)
+            self._objects[obj.key] = doc
+
+    # -- helpers ---------------------------------------------------------
+
+    def _next_rv(self) -> int:
+        self._rv += 1
+        return self._rv
+
+    def _notify(self, type_: str, doc: dict[str, Any]) -> None:
+        ev = WatchEvent(type=type_, object=from_doc(doc))
+        for w in list(self._watchers):
+            if w.matches(ev):
+                w.deliver(ev)
+
+    @staticmethod
+    def _doc(obj: Resource) -> dict[str, Any]:
+        return json.loads(obj.model_dump_json())
+
+    # -- CRUD ------------------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        if not obj.kind:
+            raise Invalid("object has no kind")
+        if not obj.metadata.name:
+            raise Invalid("object has no name")
+        with self._lock:
+            key = obj.key
+            if key in self._objects:
+                raise AlreadyExists(f"{key} already exists")
+            obj.metadata.resource_version = self._next_rv()
+            obj.metadata.generation = 1
+            doc = self._doc(obj)
+            self._objects[key] = doc
+            self._backend.put(doc)
+            self._notify("ADDED", doc)
+        return from_doc(doc)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        with self._lock:
+            doc = self._objects.get((kind, namespace, name))
+            if doc is None:
+                raise NotFound(f"{kind} {namespace}/{name} not found")
+            return from_doc(doc)
+
+    def try_get(self, kind: str, name: str, namespace: str = "default") -> Optional[Resource]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = "default",
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[Resource]:
+        out: list[Resource] = []
+        with self._lock:
+            for (k, ns, _), doc in self._objects.items():
+                if k != kind:
+                    continue
+                if namespace is not None and ns != namespace:
+                    continue
+                if label_selector and not _match_labels(
+                    doc["metadata"].get("labels") or {}, label_selector
+                ):
+                    continue
+                out.append(from_doc(doc))
+        out.sort(key=lambda o: o.metadata.creation_timestamp)
+        return out
+
+    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+        with self._lock:
+            key = obj.key
+            cur = self._objects.get(key)
+            if cur is None:
+                raise NotFound(f"{key} not found")
+            if obj.metadata.resource_version != cur["metadata"]["resource_version"]:
+                raise Conflict(
+                    f"{key}: resource_version {obj.metadata.resource_version} != "
+                    f"{cur['metadata']['resource_version']}"
+                )
+            new = self._doc(obj)
+            if status_only:
+                # status subresource: spec/labels/owner refs are taken from
+                # the stored copy, only status moves.
+                merged = dict(cur)
+                merged["status"] = new.get("status")
+                new = merged
+            else:
+                # spec update: preserve stored status, bump generation if the
+                # spec actually changed.
+                new["status"] = cur.get("status")
+                if new.get("spec") != cur.get("spec"):
+                    new["metadata"]["generation"] = cur["metadata"]["generation"] + 1
+                else:
+                    new["metadata"]["generation"] = cur["metadata"]["generation"]
+            new["metadata"]["resource_version"] = self._next_rv()
+            self._objects[key] = new
+            self._backend.put(new)
+            self._notify("MODIFIED", new)
+        return from_doc(new)
+
+    def update(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=False)
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self._update(obj, status_only=True)
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        with self._lock:
+            key = (kind, namespace, name)
+            doc = self._objects.pop(key, None)
+            if doc is None:
+                raise NotFound(f"{key} not found")
+            self._backend.remove(key)
+            self._notify("DELETED", doc)
+            self._gc_owned(doc["metadata"]["uid"])
+
+    def _gc_owned(self, owner_uid: str) -> None:
+        """Cascade-delete objects owned by ``owner_uid`` (k8s GC equivalent)."""
+        owned = [
+            key
+            for key, doc in self._objects.items()
+            if any(
+                ref.get("uid") == owner_uid
+                for ref in doc["metadata"].get("owner_references") or []
+            )
+        ]
+        for kind, ns, name in owned:
+            try:
+                self.delete(kind, name, ns)
+            except NotFound:
+                pass
+
+    # -- conflict-retried mutation (agent/state_machine.go:162-204) -------
+
+    def mutate_status(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        fn: Callable[[Resource], None],
+        attempts: int = 3,
+    ) -> Resource:
+        """Get-latest, apply ``fn``, update status; retry on Conflict."""
+        last: Exception | None = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update_status(obj)
+            except Conflict as e:  # re-get and retry
+                last = e
+        raise last  # type: ignore[misc]
+
+    # -- watch -----------------------------------------------------------
+
+    def watch(
+        self, kinds: str | Iterable[str], namespace: Optional[str] = None
+    ) -> "Watch":
+        if isinstance(kinds, str):
+            kinds = [kinds]
+        w = _Watcher(kinds=frozenset(kinds), namespace=namespace, loop=_current_loop())
+        with self._lock:
+            self._watchers.append(w)
+        return Watch(self, w)
+
+    def _unwatch(self, w: _Watcher) -> None:
+        with self._lock:
+            if w in self._watchers:
+                self._watchers.remove(w)
+
+    def close(self) -> None:
+        self._backend.close()
+
+
+class Watch:
+    """Async iterator over watch events; ``stop()`` detaches and ends iteration."""
+
+    _SENTINEL = object()
+
+    def __init__(self, store: Store, watcher: _Watcher):
+        self._store = store
+        self._watcher = watcher
+
+    def __aiter__(self) -> "Watch":
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self._watcher.queue.get()
+        if ev is self._SENTINEL:
+            raise StopAsyncIteration
+        return ev
+
+    async def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
+        try:
+            ev = await asyncio.wait_for(self._watcher.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        if ev is self._SENTINEL:
+            return None
+        return ev
+
+    def stop(self) -> None:
+        self._store._unwatch(self._watcher)
+        # unblock any consumer parked in __anext__/next
+        if self._watcher.loop is not None and self._watcher.loop is not _current_loop():
+            self._watcher.loop.call_soon_threadsafe(
+                self._watcher.queue.put_nowait, self._SENTINEL
+            )
+        else:
+            self._watcher.queue.put_nowait(self._SENTINEL)
+
+
+async def wait_for(
+    store: Store,
+    kind: str,
+    name: str,
+    namespace: str,
+    predicate: Callable[[Resource], bool],
+    timeout: float = 10.0,
+    poll: float = 0.02,
+) -> Resource:
+    """Poll until ``predicate(obj)`` — the Eventually() of our test harness."""
+    deadline = time.monotonic() + timeout
+    while True:
+        obj = store.try_get(kind, name, namespace)
+        if obj is not None and predicate(obj):
+            return obj
+        if time.monotonic() > deadline:
+            raise TimeoutError(f"timed out waiting for {kind} {namespace}/{name}")
+        await asyncio.sleep(poll)

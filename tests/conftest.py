@@ -1,0 +1,38 @@
+"""Test harness.
+
+- Forces JAX onto a virtual 8-device CPU mesh (multi-chip sharding tests run
+  without TPU hardware, per the driver contract).
+- Native asyncio test support (async def tests run via asyncio.run).
+- Shared fixtures: store, manager-equivalents live in tests/fixtures.py.
+"""
+
+import asyncio
+import inspect
+import os
+
+# Must be set before jax is imported anywhere.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import pytest
+
+
+def pytest_pyfunc_call(pyfuncitem):
+    fn = pyfuncitem.obj
+    if inspect.iscoroutinefunction(fn):
+        kwargs = {
+            name: pyfuncitem.funcargs[name]
+            for name in pyfuncitem._fixtureinfo.argnames
+        }
+        asyncio.run(fn(**kwargs))
+        return True
+    return None
+
+
+@pytest.fixture
+def store():
+    from agentcontrolplane_tpu.kernel import Store
+
+    return Store()

@@ -1,0 +1,39 @@
+"""Lease semantics: create-or-adopt-expired, per the reference's
+acquireTaskLease (task/state_machine.go:1069-1132) and
+acp/docs/distributed-locking.md expiry/takeover scenarios."""
+
+from agentcontrolplane_tpu.kernel import Store, lease
+
+
+def test_acquire_create_and_renew(store):
+    assert lease.try_acquire(store, "task-llm-t1", "pod-a", ttl=30, now=100.0)
+    # held by us -> renew succeeds
+    assert lease.try_acquire(store, "task-llm-t1", "pod-a", ttl=30, now=110.0)
+    got = store.get("Lease", "task-llm-t1")
+    assert got.spec.holder_identity == "pod-a"
+    assert got.spec.renew_time == 110.0
+    assert got.spec.acquire_time == 100.0
+
+
+def test_contention_live_lease_not_acquired(store):
+    assert lease.try_acquire(store, "l", "pod-a", ttl=30, now=100.0)
+    assert not lease.try_acquire(store, "l", "pod-b", ttl=30, now=110.0)
+    assert store.get("Lease", "l").spec.holder_identity == "pod-a"
+
+
+def test_expired_lease_adopted(store):
+    """A surviving replica adopts a dead replica's lock after TTL expiry."""
+    assert lease.try_acquire(store, "l", "pod-a", ttl=30, now=100.0)
+    assert lease.try_acquire(store, "l", "pod-b", ttl=30, now=131.0)
+    got = store.get("Lease", "l")
+    assert got.spec.holder_identity == "pod-b"
+    assert got.spec.acquire_time == 131.0
+
+
+def test_release_only_by_holder(store):
+    lease.try_acquire(store, "l", "pod-a", ttl=30, now=100.0)
+    lease.release(store, "l", "pod-b")
+    assert store.try_get("Lease", "l") is not None
+    lease.release(store, "l", "pod-a")
+    assert store.try_get("Lease", "l") is None
+    lease.release(store, "l", "pod-a")  # idempotent

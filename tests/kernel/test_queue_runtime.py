@@ -1,0 +1,107 @@
+"""Workqueue dedup/backoff + controller manager watch->reconcile wiring."""
+
+import asyncio
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LocalObjectRef,
+    Task,
+    TaskSpec,
+    ToolCall,
+    ToolCallSpec,
+)
+from agentcontrolplane_tpu.kernel import Manager, Result, Store, WorkQueue
+
+
+async def test_queue_dedup_and_order():
+    q = WorkQueue()
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert len(q) == 2
+    assert await q.get() == "a"
+    # re-add while active -> goes dirty, re-queued on done()
+    q.add("a")
+    assert await q.get() == "b"
+    q.done("b")
+    q.done("a")
+    assert await q.get() == "a"
+
+
+async def test_queue_add_after_fires():
+    q = WorkQueue()
+    q.add_after("x", 0.05)
+    t0 = asyncio.get_event_loop().time()
+    assert await q.get() == "x"
+    assert asyncio.get_event_loop().time() - t0 >= 0.04
+
+
+async def test_queue_backoff_grows():
+    q = WorkQueue()
+    q.add_rate_limited("k")
+    await q.get()
+    q.done("k")
+    q.add_rate_limited("k")
+    t0 = asyncio.get_event_loop().time()
+    assert await q.get() == "k"
+    assert asyncio.get_event_loop().time() - t0 >= 0.008  # 5ms * 2^1
+    q.forget("k")
+
+
+class RecordingReconciler:
+    def __init__(self, store):
+        self.store = store
+        self.seen = []
+
+    async def reconcile(self, key):
+        self.seen.append(key)
+        return Result.done()
+
+
+async def test_manager_watch_feeds_reconciler():
+    store = Store()
+    rec = RecordingReconciler(store)
+    mgr = Manager(store)
+    mgr.add_controller("task", "Task", rec, owns=["ToolCall"])
+    await mgr.start()
+    try:
+        task = store.create(
+            Task(
+                metadata=ObjectMeta(name="t1"),
+                spec=TaskSpec(agent_ref=LocalObjectRef(name="a"), user_message="m"),
+            )
+        )
+        await mgr.run_until(lambda: ("Task", "default", "t1") in rec.seen, timeout=5)
+
+        # owned ToolCall event maps to the owning Task's key (Owns() semantics)
+        rec.seen.clear()
+        store.create(
+            ToolCall(
+                metadata=ObjectMeta(name="t1-tc-01", owner_references=[task.owner_ref()]),
+                spec=ToolCallSpec(
+                    tool_call_id="1",
+                    task_ref=LocalObjectRef(name="t1"),
+                    tool_ref=LocalObjectRef(name="s__t"),
+                    tool_type="MCP",
+                ),
+            )
+        )
+        await mgr.run_until(lambda: ("Task", "default", "t1") in rec.seen, timeout=5)
+    finally:
+        await mgr.stop()
+
+
+async def test_leader_election_single_leader():
+    store = Store()
+    m1 = Manager(store, identity="pod-a", leader_election=True)
+    m2 = Manager(store, identity="pod-b", leader_election=True)
+    await m1.start()
+    await asyncio.sleep(0.05)
+    await m2.start()
+    try:
+        await m1.run_until(lambda: m1.elector.is_leader, timeout=5)
+        await asyncio.sleep(0.1)
+        assert not m2.elector.is_leader
+    finally:
+        await m1.stop()
+        await m2.stop()

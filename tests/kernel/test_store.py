@@ -1,0 +1,180 @@
+"""Store semantics: CRUD, optimistic concurrency, labels, watch, GC, durability.
+
+Mirrors what the reference gets from envtest (a real apiserver+etcd pair,
+SURVEY.md §4): these are the invariants every controller test builds on.
+"""
+
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    Secret,
+    SecretSpec,
+    Task,
+    TaskSpec,
+    LocalObjectRef,
+    ToolCall,
+    ToolCallSpec,
+)
+from agentcontrolplane_tpu.kernel import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    SqliteBackend,
+    Store,
+)
+
+
+def mktask(name, labels=None, msg="hi"):
+    return Task(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        spec=TaskSpec(agent_ref=LocalObjectRef(name="a"), user_message=msg),
+    )
+
+
+def test_create_get_roundtrip(store):
+    created = store.create(mktask("t1"))
+    assert created.metadata.resource_version == 1
+    assert created.metadata.generation == 1
+    got = store.get("Task", "t1")
+    assert got.spec.user_message == "hi"
+    with pytest.raises(AlreadyExists):
+        store.create(mktask("t1"))
+    with pytest.raises(NotFound):
+        store.get("Task", "missing")
+
+
+def test_update_conflict_on_stale_rv(store):
+    t = store.create(mktask("t1"))
+    fresh = store.get("Task", "t1")
+    fresh.spec.user_message = "updated"
+    store.update(fresh)
+    # stale copy now conflicts
+    t.spec.user_message = "stale write"
+    with pytest.raises(Conflict):
+        store.update(t)
+
+
+def test_spec_update_bumps_generation_status_update_does_not(store):
+    t = store.create(mktask("t1"))
+    t.spec.user_message = "v2"
+    t = store.update(t)
+    assert t.metadata.generation == 2
+    t.status.phase = "Initializing"
+    t = store.update_status(t)
+    assert t.metadata.generation == 2
+    assert t.metadata.resource_version == 3
+
+
+def test_status_subresource_isolation(store):
+    """update() must not clobber status; update_status() must not clobber spec."""
+    t = store.create(mktask("t1"))
+    t.status.phase = "Initializing"
+    t = store.update_status(t)
+
+    # spec-only update carrying a stale empty status
+    fresh = store.get("Task", "t1")
+    fresh.status.phase = ""  # simulates stale in-memory status
+    fresh.spec.user_message = "v2"
+    store.update(fresh)
+    assert store.get("Task", "t1").status.phase == "Initializing"
+
+    # status update carrying a stale spec
+    fresh = store.get("Task", "t1")
+    fresh.spec.user_message = "SHOULD NOT LAND"
+    fresh.status.phase = "ReadyForLLM"
+    store.update_status(fresh)
+    got = store.get("Task", "t1")
+    assert got.spec.user_message == "v2"
+    assert got.status.phase == "ReadyForLLM"
+
+
+def test_list_label_selector(store):
+    store.create(mktask("t1", labels={"acp.tpu/task": "x", "req": "1"}))
+    store.create(mktask("t2", labels={"acp.tpu/task": "x", "req": "2"}))
+    store.create(mktask("t3", labels={"acp.tpu/task": "y"}))
+    assert len(store.list("Task")) == 3
+    assert {t.name for t in store.list("Task", label_selector={"acp.tpu/task": "x"})} == {"t1", "t2"}
+    assert [t.name for t in store.list("Task", label_selector={"acp.tpu/task": "x", "req": "2"})] == ["t2"]
+
+
+def test_owner_reference_gc_cascades(store):
+    task = store.create(mktask("parent"))
+    tc = ToolCall(
+        metadata=ObjectMeta(name="parent-tc-01", owner_references=[task.owner_ref()]),
+        spec=ToolCallSpec(
+            tool_call_id="x",
+            task_ref=LocalObjectRef(name="parent"),
+            tool_ref=LocalObjectRef(name="srv__tool"),
+            tool_type="MCP",
+        ),
+    )
+    store.create(tc)
+    # grandchild owned by the toolcall (delegation chain)
+    child = mktask("delegate-child")
+    child.metadata.owner_references = [tc.owner_ref()]
+    store.create(child)
+
+    store.delete("Task", "parent")
+    assert store.try_get("ToolCall", "parent-tc-01") is None
+    assert store.try_get("Task", "delegate-child") is None
+
+
+def test_mutate_status_retries_conflicts(store):
+    store.create(mktask("t1"))
+
+    calls = {"n": 0}
+
+    def bump(obj):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # interleaved writer causes one conflict
+            fresh = store.get("Task", "t1")
+            fresh.status.status_detail = "interleaved"
+            store.update_status(fresh)
+        obj.status.phase = "Initializing"
+
+    out = store.mutate_status("Task", "t1", "default", bump)
+    assert out.status.phase == "Initializing"
+    assert calls["n"] == 2
+
+
+async def test_watch_stream(store):
+    watch = store.watch("Task")
+    store.create(mktask("t1"))
+    ev = await watch.next(timeout=1)
+    assert ev is not None and ev.type == "ADDED" and ev.object.name == "t1"
+
+    t = store.get("Task", "t1")
+    t.status.phase = "Initializing"
+    store.update_status(t)
+    ev = await watch.next(timeout=1)
+    assert ev.type == "MODIFIED" and ev.object.status.phase == "Initializing"
+
+    store.delete("Task", "t1")
+    ev = await watch.next(timeout=1)
+    assert ev.type == "DELETED"
+    watch.stop()
+
+
+def test_sqlite_durability_restart_resumes(tmp_path):
+    """Operator restart = resume: all state survives in the backend
+    (the reference's defining checkpoint/resume property)."""
+    db = str(tmp_path / "state.db")
+    s1 = Store(SqliteBackend(db))
+    t = s1.create(mktask("t1"))
+    t.status.phase = "ReadyForLLM"
+    t.status.context_window = []
+    s1.update_status(t)
+    s1.create(Secret(metadata=ObjectMeta(name="k"), spec=SecretSpec(data={"a": "b"})))
+    s1.close()
+
+    s2 = Store(SqliteBackend(db))
+    got = s2.get("Task", "t1")
+    assert got.status.phase == "ReadyForLLM"
+    assert got.metadata.resource_version == t.metadata.resource_version + 1
+    assert s2.get("Secret", "k").spec.data == {"a": "b"}
+    # new writes continue from the persisted rv watermark
+    s2.create(mktask("t2"))
+    assert s2.get("Task", "t2").metadata.resource_version > got.metadata.resource_version
+    s2.close()
