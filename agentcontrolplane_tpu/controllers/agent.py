@@ -1,0 +1,116 @@
+"""Agent controller — validates and caches resolved dependencies.
+
+Rebuilt from ``acp/internal/controller/agent/state_machine.go:88-204``:
+validate the LLM ref, MCP server refs (recording discovered tool names),
+contact channel refs, and sub-agent refs; cache the resolved set in status so
+the Task hot path never re-resolves.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from ..api.resources import (
+    Agent,
+    ContactChannel,
+    LLM,
+    MCPServer,
+    ResolvedMCPServer,
+    ResolvedSubAgent,
+)
+from ..kernel.events import EventRecorder
+from ..kernel.runtime import Result
+from ..kernel.store import Key, Store
+
+REQUEUE_DELAY = 5.0
+
+
+@dataclass
+class AgentReconciler:
+    store: Store
+    recorder: EventRecorder
+    requeue_delay: float = REQUEUE_DELAY
+    # Ready agents are revalidated periodically so a later-broken dependency
+    # (deleted LLM, disconnected MCP server) surfaces as Error/Pending rather
+    # than leaving status.ready=True forever.
+    revalidate_interval: float = 60.0
+
+    async def reconcile(self, key: Key) -> Result:
+        _, ns, name = key
+        agent = self.store.try_get("Agent", name, ns)
+        if agent is None:
+            return Result.done()
+        assert isinstance(agent, Agent)
+
+        pending: list[str] = []
+        errors: list[str] = []
+
+        llm = self.store.try_get("LLM", agent.spec.llm_ref.name, ns)
+        if not isinstance(llm, LLM):
+            errors.append(f'LLM "{agent.spec.llm_ref.name}" not found')
+        elif not llm.status.ready:
+            pending.append(f'LLM "{llm.name}" not ready')
+
+        valid_servers: list[ResolvedMCPServer] = []
+        for ref in agent.spec.mcp_servers:
+            server = self.store.try_get("MCPServer", ref.name, ns)
+            if not isinstance(server, MCPServer):
+                errors.append(f'MCPServer "{ref.name}" not found')
+            elif not server.status.connected:
+                pending.append(f'MCPServer "{ref.name}" not connected')
+            else:
+                valid_servers.append(
+                    ResolvedMCPServer(
+                        name=ref.name, tools=[t.name for t in server.status.tools]
+                    )
+                )
+
+        valid_channels: list[str] = []
+        for ref in agent.spec.human_contact_channels:
+            channel = self.store.try_get("ContactChannel", ref.name, ns)
+            if not isinstance(channel, ContactChannel):
+                errors.append(f'ContactChannel "{ref.name}" not found')
+            elif not channel.status.ready:
+                pending.append(f'ContactChannel "{ref.name}" not ready')
+            else:
+                valid_channels.append(ref.name)
+
+        valid_sub_agents: list[ResolvedSubAgent] = []
+        for ref in agent.spec.sub_agents:
+            sub = self.store.try_get("Agent", ref.name, ns)
+            if not isinstance(sub, Agent):
+                errors.append(f'sub-agent "{ref.name}" not found')
+            elif not sub.status.ready:
+                pending.append(f'sub-agent "{ref.name}" not ready')
+            else:
+                valid_sub_agents.append(
+                    ResolvedSubAgent(name=ref.name, description=sub.spec.description)
+                )
+
+        def apply(fresh) -> None:
+            fresh.status.valid_mcp_servers = valid_servers
+            fresh.status.valid_human_contact_channels = valid_channels
+            fresh.status.valid_sub_agents = valid_sub_agents
+            if errors:
+                fresh.status.ready = False
+                fresh.status.status = "Error"
+                fresh.status.status_detail = "; ".join(errors)
+            elif pending:
+                fresh.status.ready = False
+                fresh.status.status = "Pending"
+                fresh.status.status_detail = "; ".join(pending)
+            else:
+                fresh.status.ready = True
+                fresh.status.status = "Ready"
+                fresh.status.status_detail = "All dependencies validated"
+
+        updated = self.store.mutate_status("Agent", name, ns, apply)
+        if errors:
+            self.recorder.event(updated, "Warning", "ValidationFailed", "; ".join(errors))
+            return Result.after(self.requeue_delay)
+        if pending:
+            self.recorder.event(updated, "Normal", "Waiting", "; ".join(pending))
+            return Result.after(self.requeue_delay)
+        if not agent.status.ready:
+            self.recorder.event(updated, "Normal", "ValidationSucceeded", "Agent dependencies validated")
+        return Result.after(self.revalidate_interval)

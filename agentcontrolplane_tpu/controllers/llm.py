@@ -1,0 +1,96 @@
+"""LLM controller — validates provider config with a live 1-token probe.
+
+Rebuilt from ``acp/internal/controller/llm/state_machine.go:185-404``: resolve
+the API key Secret, construct the provider client, and issue a tiny live
+request (probe at 391-402) so a bad key/model fails fast at the LLM object,
+not mid-Task. For ``provider: tpu`` the probe checks the in-process engine is
+loaded (checkpoint present, params sharded) instead of calling out.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+from ..api.resources import LLM, BaseConfig, Message
+from ..kernel.errors import Invalid, NotFound
+from ..kernel.events import EventRecorder
+from ..kernel.runtime import Result
+from ..kernel.store import Key, Store
+from ..llmclient.base import LLMRequestError
+from ..llmclient.factory import LLMClientFactory, resolve_secret_key
+
+REQUEUE_AFTER_ERROR = 30.0
+PROVIDERS_REQUIRING_KEY = {"openai", "anthropic", "mistral", "google", "vertex"}
+
+
+@dataclass
+class LLMReconciler:
+    store: Store
+    recorder: EventRecorder
+    llm_factory: LLMClientFactory
+    probe: bool = True  # live 1-token validation request
+
+    async def reconcile(self, key: Key) -> Result:
+        _, ns, name = key
+        llm = self.store.try_get("LLM", name, ns)
+        if llm is None:
+            return Result.done()
+        assert isinstance(llm, LLM)
+
+        try:
+            api_key = self._validate_spec(llm, ns)
+        except (Invalid, NotFound) as e:
+            self._set_status(llm, ready=False, status="Error", detail=str(e))
+            self.recorder.event(llm, "Warning", "ValidationFailed", str(e))
+            return Result.after(REQUEUE_AFTER_ERROR)
+
+        if self.probe:
+            try:
+                await self._probe(llm, api_key)
+            except Exception as e:
+                detail = f"Provider validation failed: {e}"
+                self._set_status(llm, ready=False, status="Error", detail=detail)
+                self.recorder.event(llm, "Warning", "ProbeFailed", detail)
+                return Result.after(REQUEUE_AFTER_ERROR)
+
+        if not llm.status.ready:
+            self._set_status(llm, ready=True, status="Ready", detail="Provider validated")
+            self.recorder.event(llm, "Normal", "ValidationSucceeded", "LLM provider validated")
+        return Result.done()
+
+    def _validate_spec(self, llm: LLM, ns: str) -> str:
+        provider = llm.spec.provider
+        if provider == "vertex" and not llm.spec.parameters.base_url:
+            # Vertex has no hardcodable default endpoint (it is
+            # project/region-scoped) — never fall back to another vendor's.
+            raise Invalid("provider vertex requires parameters.baseURL")
+        if provider in PROVIDERS_REQUIRING_KEY:
+            if llm.spec.api_key_from is None:
+                raise Invalid(f"provider {provider} requires apiKeyFrom")
+            return resolve_secret_key(self.store, ns, llm.spec.api_key_from)
+        if provider == "tpu" and llm.spec.tpu is None:
+            raise Invalid("provider tpu requires a tpu config block")
+        return ""
+
+    async def _probe(self, llm: LLM, api_key: str) -> None:
+        """1-token live request (llm/state_machine.go:391-402)."""
+        probe_llm = llm.model_copy(deep=True)
+        probe_llm.spec.parameters = BaseConfig(
+            model=llm.spec.parameters.model,
+            base_url=llm.spec.parameters.base_url,
+            max_tokens=1,
+        )
+        client = await self.llm_factory.create_client(probe_llm, api_key)
+        try:
+            await client.send_request([Message(role="user", content="hi")], [])
+        finally:
+            await client.close()
+
+    def _set_status(self, llm: LLM, ready: bool, status: str, detail: str) -> None:
+        def apply(fresh) -> None:
+            fresh.status.ready = ready
+            fresh.status.status = status
+            fresh.status.status_detail = detail
+
+        self.store.mutate_status("LLM", llm.name, llm.namespace, apply)

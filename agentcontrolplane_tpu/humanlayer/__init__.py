@@ -1,0 +1,23 @@
+from .client import (
+    ApprovalStatus,
+    FunctionCallSpec,
+    HTTPHumanLayerClient,
+    HTTPHumanLayerClientFactory,
+    HumanContactStatus,
+    HumanLayerClient,
+    HumanLayerClientFactory,
+)
+from .local import (
+    LocalHumanBackend,
+    LocalHumanLayerClient,
+    LocalHumanLayerClientFactory,
+    PendingApproval,
+    PendingContact,
+)
+
+__all__ = [
+    "ApprovalStatus", "FunctionCallSpec", "HTTPHumanLayerClient",
+    "HTTPHumanLayerClientFactory", "HumanContactStatus", "HumanLayerClient",
+    "HumanLayerClientFactory", "LocalHumanBackend", "LocalHumanLayerClient",
+    "LocalHumanLayerClientFactory", "PendingApproval", "PendingContact",
+]

@@ -1,0 +1,128 @@
+"""Human approval / contact clients.
+
+Rebuilt from the reference's HumanLayer wrapper
+(``acp/internal/humanlayer/hlclient.go:55-69``: request approval, request
+human contact, poll statuses) with two implementations:
+
+- ``HTTPHumanLayerClient`` — speaks the HumanLayer-compatible HTTP API
+  (``HUMANLAYER_API_BASE``), like the generated client in
+  ``acp/internal/humanlayerapi/``.
+- ``LocalHumanBackend`` (local.py) — in-tree approval/contact service
+  surfaced through our REST API, so human-in-loop works with zero external
+  SaaS (TPU-native standalone goal).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Any, Optional, Protocol
+
+import httpx
+
+DEFAULT_API_BASE = "https://api.humanlayer.dev/humanlayer/v1"
+API_TIMEOUT = 10.0  # reference task_controller.go:24
+
+
+@dataclass
+class FunctionCallSpec:
+    """What the human is asked to approve (fn name + kwargs + channel)."""
+
+    fn: str
+    kwargs: dict[str, Any]
+    channel: Optional[dict[str, Any]] = None
+
+
+@dataclass
+class ApprovalStatus:
+    approved: Optional[bool] = None  # None = still pending
+    comment: str = ""
+
+
+@dataclass
+class HumanContactStatus:
+    response: Optional[str] = None  # None = still pending
+
+
+class HumanLayerClient(Protocol):
+    """The seam (hlclient.go:55-69); toolcall controller depends only on this."""
+
+    async def request_approval(self, run_id: str, call_id: str, spec: FunctionCallSpec) -> str: ...
+
+    async def get_function_call_status(self, call_id: str) -> ApprovalStatus: ...
+
+    async def request_human_contact(
+        self, run_id: str, call_id: str, message: str, channel: Optional[dict[str, Any]] = None
+    ) -> str: ...
+
+    async def get_human_contact_status(self, call_id: str) -> HumanContactStatus: ...
+
+
+class HumanLayerClientFactory(Protocol):
+    def create_client(self, api_key: str) -> HumanLayerClient: ...
+
+
+class HTTPHumanLayerClient:
+    """HumanLayer-compatible HTTP API client (humanlayerapi/api_default.go
+    surface: function_calls + contact_requests, polled)."""
+
+    def __init__(self, api_key: str, base_url: Optional[str] = None):
+        self._http = httpx.AsyncClient(
+            base_url=base_url or os.environ.get("HUMANLAYER_API_BASE", DEFAULT_API_BASE),
+            headers={"Authorization": f"Bearer {api_key}"},
+            timeout=API_TIMEOUT,
+        )
+
+    async def request_approval(self, run_id: str, call_id: str, spec: FunctionCallSpec) -> str:
+        body = {
+            "run_id": run_id,
+            "call_id": call_id,
+            "spec": {"fn": spec.fn, "kwargs": spec.kwargs},
+        }
+        if spec.channel:
+            body["spec"]["channel"] = spec.channel
+        resp = await self._http.post("/function_calls", json=body)
+        resp.raise_for_status()
+        return resp.json().get("call_id", call_id)
+
+    async def get_function_call_status(self, call_id: str) -> ApprovalStatus:
+        resp = await self._http.get(f"/function_calls/{call_id}")
+        resp.raise_for_status()
+        status = resp.json().get("status") or {}
+        return ApprovalStatus(
+            approved=status.get("approved"), comment=status.get("comment") or ""
+        )
+
+    async def request_human_contact(
+        self, run_id: str, call_id: str, message: str, channel: Optional[dict[str, Any]] = None
+    ) -> str:
+        body = {"run_id": run_id, "call_id": call_id, "spec": {"msg": message}}
+        if channel:
+            body["spec"]["channel"] = channel
+        resp = await self._http.post("/contact_requests", json=body)
+        resp.raise_for_status()
+        return resp.json().get("call_id", call_id)
+
+    async def get_human_contact_status(self, call_id: str) -> HumanContactStatus:
+        resp = await self._http.get(f"/contact_requests/{call_id}")
+        resp.raise_for_status()
+        status = resp.json().get("status") or {}
+        return HumanContactStatus(response=status.get("response"))
+
+    async def verify_project(self) -> dict[str, Any]:
+        """Credential check used by the ContactChannel controller
+        (contactchannel/state_machine.go:214 equivalent)."""
+        resp = await self._http.get("/project")
+        resp.raise_for_status()
+        return resp.json()
+
+    async def close(self) -> None:
+        await self._http.aclose()
+
+
+class HTTPHumanLayerClientFactory:
+    def __init__(self, base_url: Optional[str] = None):
+        self.base_url = base_url
+
+    def create_client(self, api_key: str) -> HTTPHumanLayerClient:
+        return HTTPHumanLayerClient(api_key, self.base_url)

@@ -1,0 +1,26 @@
+from .base import (
+    LLMClient,
+    LLMRequestError,
+    MESSAGE_SCHEMA,
+    Tool,
+    ToolFunction,
+    merge_choices,
+    tool_from_contact_channel,
+)
+from .factory import (
+    DefaultLLMClientFactory,
+    LLMClientFactory,
+    MockLLMClientFactory,
+    resolve_secret_key,
+)
+from .mock import MockLLMClient, assistant, tool_call_message
+from .openai import OpenAICompatibleClient
+from .anthropic import AnthropicClient
+
+__all__ = [
+    "LLMClient", "LLMRequestError", "MESSAGE_SCHEMA", "Tool", "ToolFunction",
+    "merge_choices", "tool_from_contact_channel", "DefaultLLMClientFactory",
+    "LLMClientFactory", "MockLLMClientFactory", "resolve_secret_key",
+    "MockLLMClient", "assistant", "tool_call_message",
+    "OpenAICompatibleClient", "AnthropicClient",
+]

@@ -1,0 +1,136 @@
+"""Anthropic Messages API client (the reference reaches Anthropic through
+langchaingo; we speak the Messages wire format directly)."""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import httpx
+
+from ..api.resources import BaseConfig, Message, MessageToolCall, ToolCallFunction
+from .base import LLMClient, LLMRequestError, Tool
+
+DEFAULT_BASE_URL = "https://api.anthropic.com"
+REQUEST_TIMEOUT = 30.0
+
+
+def messages_to_anthropic(
+    messages: list[Message],
+) -> tuple[str, list[dict[str, Any]]]:
+    """Split system prompt; map tool results to tool_result blocks."""
+    system = ""
+    out: list[dict[str, Any]] = []
+    for m in messages:
+        if m.role == "system":
+            system = m.content if not system else system + "\n" + m.content
+            continue
+        if m.role == "tool":
+            out.append(
+                {
+                    "role": "user",
+                    "content": [
+                        {
+                            "type": "tool_result",
+                            "tool_use_id": m.tool_call_id or "",
+                            "content": m.content,
+                        }
+                    ],
+                }
+            )
+            continue
+        if m.role == "assistant" and m.tool_calls:
+            blocks: list[dict[str, Any]] = []
+            if m.content:
+                blocks.append({"type": "text", "text": m.content})
+            for tc in m.tool_calls:
+                try:
+                    args = json.loads(tc.function.arguments)
+                except json.JSONDecodeError:
+                    args = {}
+                blocks.append(
+                    {
+                        "type": "tool_use",
+                        "id": tc.id,
+                        "name": tc.function.name,
+                        "input": args,
+                    }
+                )
+            out.append({"role": "assistant", "content": blocks})
+            continue
+        out.append({"role": m.role, "content": m.content})
+    return system, out
+
+
+class AnthropicClient(LLMClient):
+    def __init__(
+        self,
+        api_key: str,
+        params: BaseConfig,
+        http: Optional[httpx.AsyncClient] = None,
+    ):
+        self.params = params
+        self._http = http or httpx.AsyncClient(
+            base_url=params.base_url or DEFAULT_BASE_URL,
+            headers={"x-api-key": api_key, "anthropic-version": "2023-06-01"},
+            timeout=REQUEST_TIMEOUT,
+        )
+
+    async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
+        system, msgs = messages_to_anthropic(messages)
+        payload: dict[str, Any] = {
+            "model": self.params.model or "claude-3-5-sonnet-latest",
+            "max_tokens": self.params.max_tokens or 4096,
+            "messages": msgs,
+        }
+        if system:
+            payload["system"] = system
+        if tools:
+            payload["tools"] = [
+                {
+                    "name": t.function.name,
+                    "description": t.function.description,
+                    "input_schema": t.function.parameters,
+                }
+                for t in tools
+            ]
+        if self.params.temperature is not None:
+            payload["temperature"] = self.params.temperature
+        if self.params.top_p is not None:
+            payload["top_p"] = self.params.top_p
+        if self.params.top_k is not None:
+            payload["top_k"] = self.params.top_k
+        try:
+            resp = await self._http.post("/v1/messages", json=payload)
+        except httpx.HTTPError as e:
+            raise LLMRequestError(599, f"transport error: {e}") from e
+        if resp.status_code != 200:
+            detail = resp.text[:500]
+            try:
+                detail = resp.json().get("error", {}).get("message", detail)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise LLMRequestError(resp.status_code, detail)
+        body = resp.json()
+        content = ""
+        tool_calls: list[MessageToolCall] = []
+        for block in body.get("content", []):
+            if block.get("type") == "text" and not content:
+                content = block.get("text", "")
+            elif block.get("type") == "tool_use":
+                tool_calls.append(
+                    MessageToolCall(
+                        id=block.get("id", ""),
+                        function=ToolCallFunction(
+                            name=block.get("name", ""),
+                            arguments=json.dumps(block.get("input") or {}),
+                        ),
+                    )
+                )
+        # tool calls beat content (langchaingo_client.go:260-270)
+        if tool_calls:
+            return Message(role="assistant", content="", tool_calls=tool_calls)
+        return Message(role="assistant", content=content)
+
+    async def close(self) -> None:
+        await self._http.aclose()

@@ -1,0 +1,112 @@
+"""Provider-agnostic chat-completion seam.
+
+Rebuilt from the reference's ``acp/internal/llmclient/llm_client.go:11-99``:
+one interface — ``send_request(messages, tools) -> assistant Message`` — is
+the boundary everything TPU lives behind. ``LLMRequestError`` carries the HTTP
+status so the Task state machine can treat 4xx as terminal
+(``task/state_machine.go:733-790``).
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+from pydantic import BaseModel, Field
+
+from ..api.resources import ContactChannel, Message
+
+
+class LLMRequestError(Exception):
+    """LLM request failure with HTTP status semantics (llm_client.go:18-30)."""
+
+    def __init__(self, status_code: int, message: str):
+        super().__init__(f"LLM request failed with status {status_code}: {message}")
+        self.status_code = status_code
+        self.message = message
+
+    @property
+    def terminal(self) -> bool:
+        """4xx errors fail the Task terminally; everything else retries."""
+        return 400 <= self.status_code < 500
+
+
+class ToolFunction(BaseModel):
+    name: str
+    description: str = ""
+    parameters: dict[str, Any] = Field(
+        default_factory=lambda: {"type": "object", "properties": {}}
+    )
+
+
+class Tool(BaseModel):
+    """An LLM-visible function tool (llm_client.go:33-50). ``acp_tool_type``
+    is internal routing metadata (MCP | HumanContact | DelegateToAgent), never
+    sent to the model."""
+
+    type: str = "function"
+    function: ToolFunction
+    acp_tool_type: str = "MCP"
+
+
+MESSAGE_SCHEMA: dict[str, Any] = {
+    "type": "object",
+    "properties": {"message": {"type": "string"}},
+    "required": ["message"],
+}
+
+
+def tool_from_contact_channel(channel: ContactChannel) -> Tool:
+    """Human-contact tool for a channel (llm_client.go:53-99): name is
+    ``<channel>__human_contact_<type>``, description from channel context."""
+    if channel.spec.type == "email":
+        name = f"{channel.name}__human_contact_email"
+        desc = (channel.spec.email.context_about_user if channel.spec.email else "") or (
+            "Contact a human via email"
+        )
+    elif channel.spec.type == "slack":
+        name = f"{channel.name}__human_contact_slack"
+        desc = (
+            channel.spec.slack.context_about_channel_or_user if channel.spec.slack else ""
+        ) or "Contact a human via Slack"
+    else:  # pragma: no cover — enum is closed
+        name = f"{channel.name}__human_contact"
+        desc = f"Contact a human via {channel.spec.type} channel"
+    return Tool(
+        function=ToolFunction(name=name, description=desc, parameters=dict(MESSAGE_SCHEMA)),
+        acp_tool_type="HumanContact",
+    )
+
+
+class LLMClient(ABC):
+    """The seam (llm_client.go:11-14). Implementations: openai-compatible
+    HTTP, anthropic HTTP, the in-tree TPU engine, and a scriptable mock."""
+
+    @abstractmethod
+    async def send_request(
+        self, messages: list[Message], tools: list[Tool]
+    ) -> Message: ...
+
+    async def close(self) -> None:  # optional
+        return None
+
+
+def merge_choices(choices: list[Message]) -> Message:
+    """Provider-agnostic multi-choice merge with the "tool calls beat
+    content" rule (langchaingo_client.go:208-282): collect tool calls across
+    ALL choices; if any exist, return them with empty content so the
+    controller takes the tool-call path; else first non-empty content."""
+    out = Message(role="assistant", content="")
+    tool_calls = []
+    content: Optional[str] = None
+    for choice in choices:
+        if content is None and choice.content:
+            content = choice.content
+        tool_calls.extend(choice.tool_calls)
+    if tool_calls:
+        out.tool_calls = tool_calls
+        out.content = ""
+        return out
+    if content is not None:
+        out.content = content
+    return out

@@ -1,0 +1,75 @@
+"""LLM client factory — the dependency-injection seam.
+
+Mirrors ``acp/internal/llmclient/factory.go`` + the factory interface the
+Task reconciler takes (``task_controller.go:36-56``): controllers never
+construct providers directly, so tests inject mocks and the TPU engine is
+just another provider.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Protocol
+
+from ..api.resources import LLM, Secret, SecretKeyRef
+from ..kernel.errors import Invalid, NotFound
+from ..kernel.store import Store
+from .anthropic import AnthropicClient
+from .base import LLMClient
+from .mock import MockLLMClient
+from .openai import OpenAICompatibleClient
+
+
+class LLMClientFactory(Protocol):
+    async def create_client(self, llm: LLM, api_key: str) -> LLMClient: ...
+
+
+def resolve_secret_key(store: Store, namespace: str, ref: Optional[SecretKeyRef]) -> str:
+    if ref is None:
+        return ""
+    try:
+        secret = store.get("Secret", ref.name, namespace)
+    except NotFound:
+        raise Invalid(f'secret "{ref.name}" not found')
+    assert isinstance(secret, Secret)
+    if ref.key not in secret.spec.data:
+        raise Invalid(f'key "{ref.key}" not found in secret "{ref.name}"')
+    return secret.spec.data[ref.key]
+
+
+class DefaultLLMClientFactory:
+    """Routes on ``spec.provider``. ``tpu`` resolves to the in-process
+    serving engine's client (north star: no external provider)."""
+
+    def __init__(self, engine=None):
+        self._engine = engine
+
+    async def create_client(self, llm: LLM, api_key: str) -> LLMClient:
+        provider = llm.spec.provider
+        params = llm.spec.parameters
+        if provider in ("openai", "mistral", "google", "vertex"):
+            if provider == "vertex" and not params.base_url:
+                raise Invalid("provider vertex requires parameters.baseURL")
+            return OpenAICompatibleClient(api_key, params, provider=provider)
+        if provider == "anthropic":
+            return AnthropicClient(api_key, params)
+        if provider == "tpu":
+            if self._engine is None:
+                raise Invalid("provider tpu requires a serving engine")
+            from ..engine.client import TPUEngineClient
+
+            return TPUEngineClient(self._engine, params)
+        if provider == "mock":
+            return MockLLMClient()
+        raise Invalid(f"unknown provider {provider!r}")
+
+
+class MockLLMClientFactory:
+    """Always returns the injected client (test seam)."""
+
+    def __init__(self, client: LLMClient):
+        self.client = client
+        self.calls: list[LLM] = []
+
+    async def create_client(self, llm: LLM, api_key: str) -> LLMClient:
+        self.calls.append(llm)
+        return self.client

@@ -1,0 +1,64 @@
+"""Scriptable mock LLM client — the test seam.
+
+Equivalent of the reference's mockgen'd MockLLMClient
+(``acp/Makefile:111-117``, used at ``task_controller_test.go:18``): script
+responses/errors per call; records every request for assertions.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Union
+
+from ..api.resources import Message, MessageToolCall, ToolCallFunction
+from .base import LLMClient, Tool
+
+
+def assistant(content: str) -> Message:
+    return Message(role="assistant", content=content)
+
+
+def tool_call_message(*calls: tuple[str, dict]) -> Message:
+    """Assistant message with tool calls: (tool_name, args_dict) pairs."""
+    return Message(
+        role="assistant",
+        content="",
+        tool_calls=[
+            MessageToolCall(
+                id=f"call_{i}",
+                function=ToolCallFunction(name=name, arguments=json.dumps(args)),
+            )
+            for i, (name, args) in enumerate(calls)
+        ],
+    )
+
+
+@dataclass
+class RecordedRequest:
+    messages: list[Message]
+    tools: list[Tool]
+
+
+Scripted = Union[Message, Exception, Callable[[list[Message], list[Tool]], Message]]
+
+
+@dataclass
+class MockLLMClient(LLMClient):
+    script: list[Scripted] = field(default_factory=list)
+    default: Optional[Message] = None
+    requests: list[RecordedRequest] = field(default_factory=list)
+
+    async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
+        self.requests.append(RecordedRequest(messages=list(messages), tools=list(tools)))
+        if self.script:
+            item = self.script.pop(0)
+        elif self.default is not None:
+            item = self.default
+        else:
+            item = assistant("mock response")
+        if isinstance(item, Exception):
+            raise item
+        if callable(item) and not isinstance(item, Message):
+            return item(messages, tools)
+        return item

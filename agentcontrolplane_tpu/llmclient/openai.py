@@ -1,0 +1,139 @@
+"""OpenAI-compatible chat-completions client.
+
+Covers providers speaking the OpenAI wire format: openai, mistral, google
+(Gemini's OpenAI-compatible endpoint) — the reference reaches these through
+langchaingo (``langchaingo_client.go:27-80``); we speak HTTP directly via
+httpx with a 30s timeout (the reference's LLMRequestTimeout,
+``task_controller.go:25``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import httpx
+
+from ..api.resources import BaseConfig, Message, MessageToolCall, ToolCallFunction
+from .base import LLMClient, LLMRequestError, Tool, merge_choices
+
+DEFAULT_BASE_URLS = {
+    "openai": "https://api.openai.com/v1",
+    "mistral": "https://api.mistral.ai/v1",
+    "google": "https://generativelanguage.googleapis.com/v1beta/openai",
+}
+
+REQUEST_TIMEOUT = 30.0
+
+
+def messages_to_openai(messages: list[Message]) -> list[dict[str, Any]]:
+    out = []
+    for m in messages:
+        d: dict[str, Any] = {"role": m.role, "content": m.content}
+        if m.tool_calls:
+            d["tool_calls"] = [
+                {
+                    "id": tc.id,
+                    "type": tc.type,
+                    "function": {
+                        "name": tc.function.name,
+                        "arguments": tc.function.arguments,
+                    },
+                }
+                for tc in m.tool_calls
+            ]
+            if not m.content:
+                d["content"] = None
+        if m.role == "tool" and m.tool_call_id:
+            d["tool_call_id"] = m.tool_call_id
+        out.append(d)
+    return out
+
+
+def tools_to_openai(tools: list[Tool]) -> list[dict[str, Any]]:
+    return [
+        {
+            "type": "function",
+            "function": {
+                "name": t.function.name,
+                "description": t.function.description,
+                "parameters": t.function.parameters,
+            },
+        }
+        for t in tools
+    ]
+
+
+def choice_to_message(choice: dict[str, Any]) -> Message:
+    msg = choice.get("message") or {}
+    tool_calls = [
+        MessageToolCall(
+            id=tc.get("id") or f"call_{i}",
+            type=tc.get("type", "function"),
+            function=ToolCallFunction(
+                name=tc["function"]["name"],
+                arguments=tc["function"].get("arguments") or "{}",
+            ),
+        )
+        for i, tc in enumerate(msg.get("tool_calls") or [])
+    ]
+    return Message(role="assistant", content=msg.get("content") or "", tool_calls=tool_calls)
+
+
+class OpenAICompatibleClient(LLMClient):
+    def __init__(
+        self,
+        api_key: str,
+        params: BaseConfig,
+        provider: str = "openai",
+        http: Optional[httpx.AsyncClient] = None,
+    ):
+        self.params = params
+        self.provider = provider
+        base_url = params.base_url or DEFAULT_BASE_URLS.get(provider, DEFAULT_BASE_URLS["openai"])
+        self._http = http or httpx.AsyncClient(
+            base_url=base_url,
+            headers={"Authorization": f"Bearer {api_key}"},
+            timeout=REQUEST_TIMEOUT,
+        )
+
+    def _payload(self, messages: list[Message], tools: list[Tool]) -> dict[str, Any]:
+        p = self.params
+        payload: dict[str, Any] = {
+            "model": p.model or "gpt-4o",
+            "messages": messages_to_openai(messages),
+        }
+        if tools:
+            payload["tools"] = tools_to_openai(tools)
+        for field, key in [
+            ("temperature", "temperature"),
+            ("max_tokens", "max_tokens"),
+            ("top_p", "top_p"),
+            ("frequency_penalty", "frequency_penalty"),
+            ("presence_penalty", "presence_penalty"),
+        ]:
+            v = getattr(p, field)
+            if v is not None:
+                payload[key] = v
+        return payload
+
+    async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
+        try:
+            resp = await self._http.post(
+                "/chat/completions", json=self._payload(messages, tools)
+            )
+        except httpx.HTTPError as e:
+            raise LLMRequestError(599, f"transport error: {e}") from e
+        if resp.status_code != 200:
+            detail = resp.text[:500]
+            try:
+                detail = resp.json().get("error", {}).get("message", detail)
+            except (json.JSONDecodeError, AttributeError):
+                pass
+            raise LLMRequestError(resp.status_code, detail)
+        body = resp.json()
+        choices = [choice_to_message(c) for c in body.get("choices", [])]
+        return merge_choices(choices)
+
+    async def close(self) -> None:
+        await self._http.aclose()

@@ -1,0 +1,15 @@
+from .adapters import (
+    convert_mcp_tools,
+    convert_sub_agents,
+    parse_tool_arguments,
+    split_tool_name,
+)
+from .http import HTTPMCPClient
+from .manager import MCPConnection, MCPManager, convert_env_vars, flatten_tool_result
+from .stdio import MCPError, StdioMCPClient
+
+__all__ = [
+    "convert_mcp_tools", "convert_sub_agents", "parse_tool_arguments",
+    "split_tool_name", "HTTPMCPClient", "MCPConnection", "MCPManager",
+    "convert_env_vars", "flatten_tool_result", "MCPError", "StdioMCPClient",
+]

@@ -1,0 +1,67 @@
+"""MCP tool -> LLM tool-schema conversion.
+
+Rebuilt from ``acp/internal/adapters/mcp_adapter.go:12-51``: tool names are
+mangled ``server__tool`` so a single flat LLM tool namespace routes back to
+the right server; missing schemas default to an empty object schema.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+from ..api.resources import Agent, MCPTool
+from ..llmclient.base import MESSAGE_SCHEMA, Tool, ToolFunction
+
+EMPTY_SCHEMA: dict[str, Any] = {"type": "object", "properties": {}}
+
+
+def convert_mcp_tools(tools: list[MCPTool], server_name: str) -> list[Tool]:
+    out = []
+    for t in tools:
+        out.append(
+            Tool(
+                function=ToolFunction(
+                    name=f"{server_name}__{t.name}",
+                    description=t.description,
+                    parameters=t.input_schema or dict(EMPTY_SCHEMA),
+                ),
+                acp_tool_type="MCP",
+            )
+        )
+    return out
+
+
+def convert_sub_agents(agents: list[Agent]) -> list[Tool]:
+    """Delegate tools ``delegate_to_agent__<name>`` with a message parameter
+    (task_controller.go:94-117)."""
+    return [
+        Tool(
+            function=ToolFunction(
+                name=f"delegate_to_agent__{a.metadata.name}",
+                description=a.spec.description,
+                parameters=dict(MESSAGE_SCHEMA),
+            ),
+            acp_tool_type="DelegateToAgent",
+        )
+        for a in agents
+    ]
+
+
+def split_tool_name(name: str) -> tuple[str, str]:
+    """``server__tool`` -> (server, tool). Raises on unmangled names."""
+    if "__" not in name:
+        raise ValueError(f"tool name {name!r} is not of the form server__tool")
+    server, tool = name.split("__", 1)
+    return server, tool
+
+
+def parse_tool_arguments(arguments: str) -> dict[str, Any]:
+    """JSON arguments string -> dict (mcp_adapter.go:54-60)."""
+    try:
+        parsed = json.loads(arguments or "{}")
+    except json.JSONDecodeError as e:
+        raise ValueError(f"failed to parse tool arguments: {e}") from e
+    if not isinstance(parsed, dict):
+        raise ValueError("tool arguments must be a JSON object")
+    return parsed

@@ -1,0 +1,97 @@
+"""MCP HTTP transport (streamable-HTTP JSON-RPC; SSE responses supported).
+
+Equivalent of the reference's SSE client path
+(``acp/internal/mcpmanager/mcpmanager.go:148``).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import httpx
+
+from .stdio import MCPError, PROTOCOL_VERSION
+
+
+def _parse_sse(text: str) -> dict[str, Any]:
+    """Extract the last JSON data payload from an SSE body."""
+    last = None
+    for line in text.splitlines():
+        if line.startswith("data:"):
+            payload = line[5:].strip()
+            if payload:
+                try:
+                    last = json.loads(payload)
+                except json.JSONDecodeError:
+                    continue
+    if last is None:
+        raise MCPError("no JSON payload in SSE response")
+    return last
+
+
+class HTTPMCPClient:
+    def __init__(self, url: str, headers: dict[str, str] | None = None):
+        self.url = url
+        self._http = httpx.AsyncClient(timeout=30.0, headers=headers or {})
+        self._id = 0
+        self._session_id: Optional[str] = None
+        self.server_info: dict[str, Any] = {}
+
+    async def start(self, timeout: float = 15.0) -> None:
+        result = await self._request(
+            "initialize",
+            {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {},
+                "clientInfo": {"name": "acp-tpu", "version": "0.1.0"},
+            },
+        )
+        self.server_info = result.get("serverInfo", {})
+        await self._notify("notifications/initialized", {})
+
+    async def _post(self, msg: dict[str, Any]) -> Optional[dict[str, Any]]:
+        headers = {"Accept": "application/json, text/event-stream"}
+        if self._session_id:
+            headers["Mcp-Session-Id"] = self._session_id
+        resp = await self._http.post(self.url, json=msg, headers=headers)
+        if resp.status_code >= 400:
+            raise MCPError(f"MCP http {resp.status_code}: {resp.text[:200]}")
+        self._session_id = resp.headers.get("Mcp-Session-Id", self._session_id)
+        if not resp.content:
+            return None
+        ctype = resp.headers.get("content-type", "")
+        if "text/event-stream" in ctype:
+            return _parse_sse(resp.text)
+        return resp.json()
+
+    async def _request(self, method: str, params: dict[str, Any]) -> dict[str, Any]:
+        self._id += 1
+        msg = await self._post(
+            {"jsonrpc": "2.0", "id": self._id, "method": method, "params": params}
+        )
+        if msg is None:
+            raise MCPError(f"{method}: empty response")
+        if "error" in msg:
+            err = msg["error"]
+            raise MCPError(f"{method}: {err.get('message')} ({err.get('code')})")
+        return msg.get("result", {})
+
+    async def _notify(self, method: str, params: dict[str, Any]) -> None:
+        try:
+            await self._post({"jsonrpc": "2.0", "method": method, "params": params})
+        except MCPError:
+            pass  # some servers reject notifications; non-fatal
+
+    async def list_tools(self) -> list[dict[str, Any]]:
+        return (await self._request("tools/list", {})).get("tools", [])
+
+    async def call_tool(self, name: str, arguments: dict[str, Any], timeout: float = 60.0) -> dict[str, Any]:
+        return await self._request("tools/call", {"name": name, "arguments": arguments})
+
+    @property
+    def alive(self) -> bool:
+        return True
+
+    async def close(self) -> None:
+        await self._http.aclose()

@@ -1,0 +1,145 @@
+"""MCP server manager: connection pool + tool invocation.
+
+Rebuilt from ``acp/internal/mcpmanager/mcpmanager.go`` (341 LoC): a pool
+name -> (client, tools) guarded by a lock; stdio (subprocess) and http
+transports; Secret-resolved env vars (``convertEnvVars``, 73-111); tool
+invocation with text-content flattening (``CallTool``, 259-300).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Protocol
+
+from ..api.resources import MCPServer, MCPTool
+from ..kernel.errors import Invalid
+from ..kernel.store import Store
+from ..llmclient.factory import resolve_secret_key
+from .http import HTTPMCPClient
+from .stdio import MCPError, StdioMCPClient
+
+
+class MCPClient(Protocol):
+    server_info: dict[str, Any]
+
+    async def start(self, timeout: float = 15.0) -> None: ...
+    async def list_tools(self) -> list[dict[str, Any]]: ...
+    async def call_tool(self, name: str, arguments: dict[str, Any], timeout: float = 60.0) -> dict[str, Any]: ...
+    async def close(self) -> None: ...
+    @property
+    def alive(self) -> bool: ...
+
+
+@dataclass
+class MCPConnection:
+    name: str
+    client: MCPClient
+    tools: list[MCPTool] = field(default_factory=list)
+
+
+def convert_env_vars(store: Store, namespace: str, server: MCPServer) -> dict[str, str]:
+    """Resolve plain and Secret-sourced env vars (mcpmanager.go:73-111)."""
+    env: dict[str, str] = {}
+    for var in server.spec.env:
+        if var.value is not None:
+            env[var.name] = var.value
+        elif var.value_from is not None:
+            env[var.name] = resolve_secret_key(store, namespace, var.value_from)
+        else:
+            env[var.name] = ""
+    return env
+
+
+def flatten_tool_result(result: dict[str, Any]) -> str:
+    """Flatten MCP content items to one string (mcpmanager.go:280-298):
+    text items are concatenated; non-text items are JSON-encoded."""
+    if result.get("isError"):
+        parts = [
+            c.get("text", "") for c in result.get("content", []) if c.get("type") == "text"
+        ]
+        raise MCPError("tool error: " + ("\n".join(parts) or json.dumps(result)))
+    out: list[str] = []
+    for item in result.get("content", []):
+        if item.get("type") == "text":
+            out.append(item.get("text", ""))
+        else:
+            out.append(json.dumps(item))
+    return "\n".join(out)
+
+
+class MCPManager:
+    """One shared pool per operator process (cmd/main.go:241)."""
+
+    def __init__(self, store: Optional[Store] = None):
+        self._store = store
+        self._connections: dict[str, MCPConnection] = {}
+        self._lock = asyncio.Lock()
+
+    def _make_client(self, server: MCPServer, env: dict[str, str]) -> MCPClient:
+        if server.spec.transport == "stdio":
+            if not server.spec.command:
+                raise Invalid("stdio MCP server requires a command")
+            return StdioMCPClient(server.spec.command, list(server.spec.args), env)
+        if server.spec.transport == "http":
+            if not server.spec.url:
+                raise Invalid("http MCP server requires a url")
+            return HTTPMCPClient(server.spec.url)
+        raise Invalid(f"unknown MCP transport {server.spec.transport!r}")
+
+    async def connect_server(self, server: MCPServer) -> MCPConnection:
+        """Connect (or reconnect), run the handshake, discover tools, cache
+        in the pool (mcpmanager.go:113-218)."""
+        env = (
+            convert_env_vars(self._store, server.metadata.namespace, server)
+            if self._store is not None
+            else {v.name: v.value or "" for v in server.spec.env}
+        )
+        client = self._make_client(server, env)
+        await client.start()
+        raw_tools = await client.list_tools()
+        tools = [
+            MCPTool(
+                name=t.get("name", ""),
+                description=t.get("description", ""),
+                input_schema=t.get("inputSchema") or {"type": "object", "properties": {}},
+            )
+            for t in raw_tools
+        ]
+        conn = MCPConnection(name=server.metadata.name, client=client, tools=tools)
+        async with self._lock:
+            old = self._connections.pop(server.metadata.name, None)
+            self._connections[server.metadata.name] = conn
+        if old is not None:
+            await old.client.close()
+        return conn
+
+    def get_connection(self, name: str) -> Optional[MCPConnection]:
+        return self._connections.get(name)
+
+    def get_tools(self, name: str) -> list[MCPTool]:
+        """Tools for one server (mcpmanager.go:248)."""
+        conn = self._connections.get(name)
+        return list(conn.tools) if conn else []
+
+    def get_tools_map(self) -> dict[str, list[MCPTool]]:
+        return {name: list(c.tools) for name, c in self._connections.items()}
+
+    async def call_tool(self, server_name: str, tool_name: str, arguments: dict[str, Any]) -> str:
+        """Invoke a tool; returns flattened text (mcpmanager.go:259-300)."""
+        conn = self._connections.get(server_name)
+        if conn is None:
+            raise MCPError(f"MCP server {server_name!r} not connected")
+        result = await conn.client.call_tool(tool_name, arguments)
+        return flatten_tool_result(result)
+
+    async def disconnect_server(self, name: str) -> None:
+        async with self._lock:
+            conn = self._connections.pop(name, None)
+        if conn is not None:
+            await conn.client.close()
+
+    async def close(self) -> None:
+        for name in list(self._connections):
+            await self.disconnect_server(name)

@@ -1,0 +1,108 @@
+"""MCP stdio transport: JSON-RPC 2.0 over a subprocess's stdin/stdout.
+
+Equivalent of the reference's NewStdioMCPClient path
+(``acp/internal/mcpmanager/mcpmanager.go:142``, via mark3labs/mcp-go):
+newline-delimited JSON-RPC, ``initialize`` handshake, ``tools/list``,
+``tools/call``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+from typing import Any, Optional
+
+PROTOCOL_VERSION = "2024-11-05"
+
+
+class MCPError(Exception):
+    pass
+
+
+class StdioMCPClient:
+    def __init__(self, command: str, args: list[str], env: dict[str, str] | None = None):
+        self.command = command
+        self.args = args
+        self.env = env or {}
+        self._proc: Optional[asyncio.subprocess.Process] = None
+        self._id = 0
+        self._lock = asyncio.Lock()
+        self.server_info: dict[str, Any] = {}
+
+    async def start(self, timeout: float = 15.0) -> None:
+        env = dict(os.environ)
+        env.update(self.env)
+        self._proc = await asyncio.create_subprocess_exec(
+            self.command,
+            *self.args,
+            stdin=asyncio.subprocess.PIPE,
+            stdout=asyncio.subprocess.PIPE,
+            stderr=asyncio.subprocess.DEVNULL,
+            env=env,
+        )
+        result = await self._request(
+            "initialize",
+            {
+                "protocolVersion": PROTOCOL_VERSION,
+                "capabilities": {},
+                "clientInfo": {"name": "acp-tpu", "version": "0.1.0"},
+            },
+            timeout=timeout,
+        )
+        self.server_info = result.get("serverInfo", {})
+        await self._notify("notifications/initialized", {})
+
+    async def _send(self, msg: dict[str, Any]) -> None:
+        assert self._proc and self._proc.stdin
+        self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
+        await self._proc.stdin.drain()
+
+    async def _request(self, method: str, params: dict[str, Any], timeout: float = 30.0) -> dict[str, Any]:
+        async with self._lock:
+            self._id += 1
+            rid = self._id
+            await self._send({"jsonrpc": "2.0", "id": rid, "method": method, "params": params})
+            assert self._proc and self._proc.stdout
+            while True:
+                line = await asyncio.wait_for(self._proc.stdout.readline(), timeout)
+                if not line:
+                    raise MCPError(f"MCP server {self.command} closed its stdout")
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue  # stray non-protocol output
+                if msg.get("id") != rid:
+                    continue  # notification or unrelated message
+                if "error" in msg:
+                    err = msg["error"]
+                    raise MCPError(f"{method}: {err.get('message')} ({err.get('code')})")
+                return msg.get("result", {})
+
+    async def _notify(self, method: str, params: dict[str, Any]) -> None:
+        await self._send({"jsonrpc": "2.0", "method": method, "params": params})
+
+    async def list_tools(self) -> list[dict[str, Any]]:
+        result = await self._request("tools/list", {})
+        return result.get("tools", [])
+
+    async def call_tool(self, name: str, arguments: dict[str, Any], timeout: float = 60.0) -> dict[str, Any]:
+        return await self._request("tools/call", {"name": name, "arguments": arguments}, timeout=timeout)
+
+    @property
+    def alive(self) -> bool:
+        return self._proc is not None and self._proc.returncode is None
+
+    async def close(self) -> None:
+        if self._proc is None:
+            return
+        if self._proc.returncode is None:
+            try:
+                self._proc.terminate()
+                await asyncio.wait_for(self._proc.wait(), 3.0)
+            except (asyncio.TimeoutError, ProcessLookupError):
+                try:
+                    self._proc.kill()
+                except ProcessLookupError:
+                    pass
+        self._proc = None

@@ -1,0 +1,4 @@
+from .metrics import REGISTRY, Registry
+from .tracing import NOOP_TRACER, Span, Tracer, new_span_id, new_trace_id
+
+__all__ = ["REGISTRY", "Registry", "NOOP_TRACER", "Span", "Tracer", "new_span_id", "new_trace_id"]

@@ -1,0 +1,96 @@
+"""Metrics registry — Prometheus-text-format counters/gauges/histograms.
+
+The reference exposes controller-runtime's Prometheus metrics server
+(``cmd/main.go:167-206``); ours serves this registry at ``/metrics`` on the
+REST server, adding engine metrics (tok/s, batch occupancy, KV pages) the
+reference has no equivalent for.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+
+
+@dataclass
+class _Metric:
+    name: str
+    help: str
+    type: str
+    values: dict[tuple[tuple[str, str], ...], float] = field(default_factory=dict)
+
+
+class Registry:
+    def __init__(self):
+        self._metrics: dict[str, _Metric] = {}
+        self._hist_buckets: dict[str, list[float]] = {}
+        self._hist_data: dict[str, dict[tuple, list[float]]] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, help: str, type_: str) -> _Metric:
+        m = self._metrics.get(name)
+        if m is None:
+            m = _Metric(name=name, help=help, type=type_)
+            self._metrics[name] = m
+        return m
+
+    @staticmethod
+    def _key(labels: dict[str, str] | None) -> tuple[tuple[str, str], ...]:
+        return tuple(sorted((labels or {}).items()))
+
+    def counter_add(self, name: str, value: float = 1.0, labels: dict[str, str] | None = None, help: str = "") -> None:
+        with self._lock:
+            m = self._get(name, help, "counter")
+            k = self._key(labels)
+            m.values[k] = m.values.get(k, 0.0) + value
+
+    def gauge_set(self, name: str, value: float, labels: dict[str, str] | None = None, help: str = "") -> None:
+        with self._lock:
+            m = self._get(name, help, "gauge")
+            m.values[self._key(labels)] = value
+
+    def observe(self, name: str, value: float, labels: dict[str, str] | None = None, help: str = "") -> None:
+        with self._lock:
+            self._get(name, help, "histogram")
+            self._hist_data.setdefault(name, {}).setdefault(self._key(labels), []).append(value)
+
+    def quantile(self, name: str, q: float, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            data = sorted(self._hist_data.get(name, {}).get(self._key(labels), []))
+        if not data:
+            return 0.0
+        idx = min(int(q * len(data)), len(data) - 1)
+        return data[idx]
+
+    def render(self) -> str:
+        """Prometheus text exposition format."""
+        out: list[str] = []
+        with self._lock:
+            for m in self._metrics.values():
+                if m.help:
+                    out.append(f"# HELP {m.name} {m.help}")
+                out.append(f"# TYPE {m.name} {m.type if m.type != 'histogram' else 'summary'}")
+                if m.type == "histogram":
+                    for k, vals in self._hist_data.get(m.name, {}).items():
+                        lbl = self._render_labels(k)
+                        svals = sorted(vals)
+                        for q in (0.5, 0.9, 0.99):
+                            qk = self._render_labels(k + (("quantile", str(q)),))
+                            idx = min(int(q * len(svals)), len(svals) - 1)
+                            out.append(f"{m.name}{qk} {svals[idx]}")
+                        out.append(f"{m.name}_count{lbl} {len(vals)}")
+                        out.append(f"{m.name}_sum{lbl} {sum(vals)}")
+                else:
+                    for k, v in m.values.items():
+                        out.append(f"{m.name}{self._render_labels(k)} {v}")
+        return "\n".join(out) + "\n"
+
+    @staticmethod
+    def _render_labels(k: tuple[tuple[str, str], ...]) -> str:
+        if not k:
+            return ""
+        inner = ",".join(f'{name}="{value}"' for name, value in k)
+        return "{" + inner + "}"
+
+
+REGISTRY = Registry()

@@ -1,0 +1,153 @@
+"""Operator composition — wires the whole control plane.
+
+Equivalent of ``acp/cmd/main.go:68-327``: build the manager, register all six
+controllers with a shared MCPManager and tracer, attach the REST server as a
+leader-gated runnable, and start. The TPU engine (when configured) is started
+here too and handed to the LLM client factory as the ``provider: tpu``
+backend.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+from .controllers import (
+    AgentReconciler,
+    ContactChannelReconciler,
+    LLMReconciler,
+    MCPServerReconciler,
+    TaskReconciler,
+    ToolCallReconciler,
+)
+from .humanlayer import (
+    HumanLayerClientFactory,
+    LocalHumanBackend,
+    LocalHumanLayerClientFactory,
+)
+from .kernel import Manager, SqliteBackend, Store
+from .kernel.runtime import map_owner
+from .llmclient import DefaultLLMClientFactory, LLMClientFactory
+from .mcp import MCPManager
+from .observability import NOOP_TRACER, Tracer
+
+
+@dataclass
+class OperatorOptions:
+    db_path: Optional[str] = None  # None = in-memory store
+    identity: str = "acp-tpu-0"
+    leader_election: bool = False
+    api_port: int = 8082
+    enable_rest: bool = True
+    llm_probe: bool = True
+    verify_channel_credentials: bool = True
+    engine: object | None = None  # engine.Engine for provider: tpu
+
+
+class Operator:
+    def __init__(
+        self,
+        options: OperatorOptions | None = None,
+        store: Optional[Store] = None,
+        llm_factory: Optional[LLMClientFactory] = None,
+        hl_factory: Optional[HumanLayerClientFactory] = None,
+        tracer: Optional[Tracer] = None,
+    ):
+        self.options = options or OperatorOptions()
+        self.store = store or Store(
+            SqliteBackend(self.options.db_path) if self.options.db_path else None
+        )
+        self.tracer = tracer or Tracer()
+        self.mcp_manager = MCPManager(self.store)
+        self.human_backend = LocalHumanBackend()
+        self.hl_factory = hl_factory or LocalHumanLayerClientFactory(self.human_backend)
+        if isinstance(self.hl_factory, LocalHumanLayerClientFactory):
+            self.human_backend = self.hl_factory.backend
+        self.engine = self.options.engine
+        self.llm_factory = llm_factory or DefaultLLMClientFactory(engine=self.engine)
+
+        self.manager = Manager(
+            self.store,
+            identity=self.options.identity,
+            leader_election=self.options.leader_election,
+        )
+        self.task_reconciler = TaskReconciler(
+            store=self.store,
+            recorder=self.manager.recorder,
+            llm_factory=self.llm_factory,
+            mcp_manager=self.mcp_manager,
+            hl_factory=self.hl_factory,
+            tracer=self.tracer,
+            identity=self.options.identity,
+        )
+        self.toolcall_reconciler = ToolCallReconciler(
+            store=self.store,
+            recorder=self.manager.recorder,
+            mcp_manager=self.mcp_manager,
+            hl_factory=self.hl_factory,
+            tracer=self.tracer,
+        )
+        self._register_controllers()
+        self.rest_server = None
+        if self.options.enable_rest:
+            from .server.rest import RestServer
+
+            self.rest_server = RestServer(self)
+            self.manager.add_runnable(
+                self.rest_server.run, leader_gated=self.options.leader_election
+            )
+
+    def _register_controllers(self) -> None:
+        m = self.manager
+        self.llm_reconciler = LLMReconciler(
+            self.store, m.recorder, self.llm_factory, probe=self.options.llm_probe
+        )
+        self.contactchannel_reconciler = ContactChannelReconciler(
+            self.store,
+            m.recorder,
+            self.hl_factory,
+            verify_credentials=self.options.verify_channel_credentials,
+        )
+        self.mcpserver_reconciler = MCPServerReconciler(
+            self.store, m.recorder, self.mcp_manager
+        )
+        self.agent_reconciler = AgentReconciler(self.store, m.recorder)
+        m.add_controller("llm", "LLM", self.llm_reconciler)
+        m.add_controller("contactchannel", "ContactChannel", self.contactchannel_reconciler)
+        m.add_controller("mcpserver", "MCPServer", self.mcpserver_reconciler)
+        # Agents with pending deps self-requeue every 5s (the reference's
+        # polling pattern), so no dependency watch wiring is needed.
+        m.add_controller("agent", "Agent", self.agent_reconciler)
+        m.add_controller(
+            "task",
+            "Task",
+            self.task_reconciler,
+            owns=["ToolCall"],
+        )
+        m.add_controller(
+            "toolcall",
+            "ToolCall",
+            self.toolcall_reconciler,
+            watches={"Task": map_owner("ToolCall")},
+        )
+
+    async def start(self) -> None:
+        await self.manager.start()
+
+    async def stop(self) -> None:
+        await self.manager.stop()
+        await self.mcp_manager.close()
+        if self.rest_server is not None:
+            await self.rest_server.stop()
+        self.store.close()
+
+
+async def run_operator(options: OperatorOptions) -> None:
+    """Blocking entrypoint (the ``mgr.Start`` equivalent)."""
+    op = Operator(options)
+    await op.start()
+    try:
+        await asyncio.Event().wait()
+    finally:
+        await op.stop()

@@ -1,0 +1,3 @@
+from .rest import RestServer
+
+__all__ = ["RestServer"]

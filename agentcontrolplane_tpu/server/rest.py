@@ -1,0 +1,461 @@
+"""REST API server (aiohttp) — the reference's gin server, plus in-tree
+human-approval endpoints.
+
+Rebuilt from ``acp/internal/server/server.go`` (1,545 LoC):
+
+- ``POST /v1/tasks``   — create a Task for an agent (strict JSON decode,
+  404 on missing agent, name ``<agent>-task-<rand8>`` labeled with the agent;
+  server.go:1274-1381)
+- ``GET /v1/tasks`` / ``GET /v1/tasks/{name}``
+- ``POST /v1/agents`` — create Agent + LLM + Secret (+MCP servers)
+  "transactionally-ish" with manual cleanup on failure (server.go:219-437)
+- ``GET/DELETE /v1/agents/{name}``, ``GET /v1/agents``
+- ``POST /v1/beta3/events`` — inbound webhook: fabricates Secret +
+  ContactChannel + Task with thread continuity (server.go:1384-1545)
+
+In-tree additions (the reference delegates these to the HumanLayer SaaS):
+
+- ``GET /v1/approvals`` / ``POST /v1/approvals/{id}/approve|reject``
+- ``GET /v1/contacts`` / ``POST /v1/contacts/{id}/respond``
+- ``GET /metrics`` (Prometheus text), ``/healthz``, ``/readyz``
+- ``GET /v1/events`` — execution history
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+from typing import TYPE_CHECKING, Any, Optional
+
+from aiohttp import web
+
+from ..api.meta import ObjectMeta
+from ..api.resources import (
+    LABEL_AGENT,
+    LABEL_V1BETA3,
+    Agent,
+    AgentSpec,
+    BaseConfig,
+    ContactChannel,
+    ContactChannelSpec,
+    LLM,
+    LLMSpec,
+    LocalObjectRef,
+    Message,
+    Secret,
+    SecretKeyRef,
+    SecretSpec,
+    SlackChannelConfig,
+    Task,
+    TaskSpec,
+)
+from ..kernel.errors import AlreadyExists, Invalid, NotFound
+from ..observability.metrics import REGISTRY
+from ..validation import generate_k8s_random_string, validate_task_message_input
+
+if TYPE_CHECKING:
+    from ..operator import Operator
+
+
+def _json_error(status: int, message: str) -> web.Response:
+    return web.json_response({"error": message}, status=status)
+
+
+def _strict_decode(raw: bytes, allowed: set[str]) -> dict[str, Any]:
+    """DisallowUnknownFields equivalent (server.go:1288-1306)."""
+    body = json.loads(raw)
+    if not isinstance(body, dict):
+        raise Invalid("request body must be a JSON object")
+    unknown = set(body) - allowed
+    if unknown:
+        raise Invalid(f"unknown fields: {sorted(unknown)}")
+    return body
+
+
+def task_to_json(task: Task) -> dict[str, Any]:
+    return {
+        "name": task.name,
+        "namespace": task.namespace,
+        "agentName": task.spec.agent_ref.name,
+        "phase": task.status.phase,
+        "status": task.status.status,
+        "statusDetail": task.status.status_detail,
+        "output": task.status.output,
+        "userMsgPreview": task.status.user_msg_preview,
+        "messageCount": task.status.message_count,
+        "contextWindow": [m.model_dump(exclude_none=True) for m in task.status.context_window],
+        "error": task.status.error,
+        "creationTimestamp": task.metadata.creation_timestamp,
+    }
+
+
+class RestServer:
+    def __init__(self, operator: "Operator", host: str = "127.0.0.1", port: Optional[int] = None):
+        self.operator = operator
+        self.store = operator.store
+        self.host = host
+        self.port = port if port is not None else operator.options.api_port
+        self.app = web.Application()
+        self._register_routes()
+        self._runner: Optional[web.AppRunner] = None
+        self.bound_port: Optional[int] = None
+
+    def _register_routes(self) -> None:
+        r = self.app.router
+        r.add_post("/v1/tasks", self.create_task)
+        r.add_get("/v1/tasks", self.list_tasks)
+        r.add_get("/v1/tasks/{name}", self.get_task)
+        r.add_post("/v1/agents", self.create_agent)
+        r.add_get("/v1/agents", self.list_agents)
+        r.add_get("/v1/agents/{name}", self.get_agent)
+        r.add_delete("/v1/agents/{name}", self.delete_agent)
+        r.add_post("/v1/beta3/events", self.handle_v1beta3_event)
+        r.add_get("/v1/approvals", self.list_approvals)
+        r.add_post("/v1/approvals/{call_id}/approve", self.approve)
+        r.add_post("/v1/approvals/{call_id}/reject", self.reject)
+        r.add_get("/v1/contacts", self.list_contacts)
+        r.add_post("/v1/contacts/{call_id}/respond", self.respond)
+        r.add_get("/v1/events", self.list_events)
+        r.add_get("/metrics", self.metrics)
+        r.add_get("/healthz", self.healthz)
+        r.add_get("/readyz", self.healthz)
+
+    # -- lifecycle -------------------------------------------------------
+
+    async def run(self) -> None:
+        """Serve until cancelled. Blocking (rather than fire-and-forget) so a
+        leader-gated runner can cancel it on leadership loss and restart it on
+        re-acquisition (see kernel.runtime._leader_gated_runner)."""
+        self._runner = web.AppRunner(self.app)
+        await self._runner.setup()
+        site = web.TCPSite(self._runner, self.host, self.port)
+        await site.start()
+        self.bound_port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await self.stop()
+
+    async def stop(self) -> None:
+        if self._runner is not None:
+            runner, self._runner = self._runner, None
+            self.bound_port = None
+            await runner.cleanup()
+
+    # -- tasks (server.go:1274-1381) -------------------------------------
+
+    async def create_task(self, request: web.Request) -> web.Response:
+        try:
+            body = _strict_decode(
+                await request.read(),
+                {"agentName", "userMessage", "contextWindow", "namespace", "contactChannelRef"},
+            )
+        except (Invalid, json.JSONDecodeError) as e:
+            return _json_error(400, str(e))
+        agent_name = body.get("agentName", "")
+        if not agent_name:
+            return _json_error(400, "agentName is required")
+        ns = body.get("namespace", "default")
+        context_window = None
+        if body.get("contextWindow"):
+            try:
+                context_window = [Message.model_validate(m) for m in body["contextWindow"]]
+            except Exception as e:
+                return _json_error(400, f"invalid contextWindow: {e}")
+        try:
+            validate_task_message_input(body.get("userMessage"), context_window)
+        except Invalid as e:
+            return _json_error(400, str(e))
+        if self.store.try_get("Agent", agent_name, ns) is None:
+            return _json_error(404, f'agent "{agent_name}" not found')
+        name = f"{agent_name}-task-{generate_k8s_random_string(8)}"
+        task = Task(
+            metadata=ObjectMeta(name=name, namespace=ns, labels={LABEL_AGENT: agent_name}),
+            spec=TaskSpec(
+                agent_ref=LocalObjectRef(name=agent_name),
+                user_message=body.get("userMessage"),
+                context_window=context_window,
+                contact_channel_ref=(
+                    LocalObjectRef(name=body["contactChannelRef"])
+                    if body.get("contactChannelRef")
+                    else None
+                ),
+            ),
+        )
+        created = self.store.create(task)
+        return web.json_response(task_to_json(created), status=201)
+
+    async def list_tasks(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        tasks = [t for t in self.store.list("Task", ns) if isinstance(t, Task)]
+        return web.json_response([task_to_json(t) for t in tasks])
+
+    async def get_task(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        task = self.store.try_get("Task", request.match_info["name"], ns)
+        if not isinstance(task, Task):
+            return _json_error(404, "task not found")
+        return web.json_response(task_to_json(task))
+
+    # -- agents (server.go:219-437) --------------------------------------
+
+    async def create_agent(self, request: web.Request) -> web.Response:
+        try:
+            body = _strict_decode(
+                await request.read(),
+                {"name", "namespace", "systemPrompt", "description", "llm", "mcpServers", "subAgents"},
+            )
+        except (Invalid, json.JSONDecodeError) as e:
+            return _json_error(400, str(e))
+        name = body.get("name", "")
+        ns = body.get("namespace", "default")
+        llm_cfg = body.get("llm") or {}
+        if not name or not body.get("systemPrompt") or not llm_cfg.get("provider"):
+            return _json_error(400, "name, systemPrompt and llm.provider are required")
+
+        created: list = []  # manual cleanup on failure (server.go:219-437)
+        try:
+            secret_ref = None
+            if llm_cfg.get("apiKey"):
+                secret = self.store.create(
+                    Secret(
+                        metadata=ObjectMeta(name=f"{name}-llm-key", namespace=ns),
+                        spec=SecretSpec(data={"api-key": llm_cfg["apiKey"]}),
+                    )
+                )
+                created.append(secret)
+                secret_ref = SecretKeyRef(name=secret.name, key="api-key")
+            llm = self.store.create(
+                LLM(
+                    metadata=ObjectMeta(name=f"{name}-llm", namespace=ns),
+                    spec=LLMSpec(
+                        provider=llm_cfg["provider"],
+                        api_key_from=secret_ref,
+                        parameters=BaseConfig(
+                            model=llm_cfg.get("model", ""),
+                            base_url=llm_cfg.get("baseURL"),
+                        ),
+                    ),
+                )
+            )
+            created.append(llm)
+            agent = self.store.create(
+                Agent(
+                    metadata=ObjectMeta(name=name, namespace=ns),
+                    spec=AgentSpec(
+                        llm_ref=LocalObjectRef(name=llm.name),
+                        system=body["systemPrompt"],
+                        description=body.get("description", ""),
+                        mcp_servers=[LocalObjectRef(name=s) for s in body.get("mcpServers", [])],
+                        sub_agents=[LocalObjectRef(name=s) for s in body.get("subAgents", [])],
+                    ),
+                )
+            )
+            created.append(agent)
+        except Exception as e:  # incl. pydantic ValidationError for bad provider
+            for obj in reversed(created):
+                try:
+                    self.store.delete(obj.kind, obj.metadata.name, obj.metadata.namespace)
+                except NotFound:
+                    pass
+            status = 409 if isinstance(e, AlreadyExists) else 400
+            return _json_error(status, str(e))
+        return web.json_response({"name": name, "namespace": ns, "llm": llm.name}, status=201)
+
+    async def list_agents(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        agents = [a for a in self.store.list("Agent", ns) if isinstance(a, Agent)]
+        return web.json_response(
+            [
+                {
+                    "name": a.name,
+                    "ready": a.status.ready,
+                    "status": a.status.status,
+                    "description": a.spec.description,
+                }
+                for a in agents
+            ]
+        )
+
+    async def get_agent(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        agent = self.store.try_get("Agent", request.match_info["name"], ns)
+        if not isinstance(agent, Agent):
+            return _json_error(404, "agent not found")
+        return web.json_response(
+            {
+                "name": agent.name,
+                "namespace": agent.namespace,
+                "systemPrompt": agent.spec.system,
+                "llmRef": agent.spec.llm_ref.name,
+                "ready": agent.status.ready,
+                "status": agent.status.status,
+                "statusDetail": agent.status.status_detail,
+                "validMCPServers": [s.model_dump() for s in agent.status.valid_mcp_servers],
+                "validSubAgents": [s.model_dump() for s in agent.status.valid_sub_agents],
+            }
+        )
+
+    async def delete_agent(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        try:
+            self.store.delete("Agent", request.match_info["name"], ns)
+        except NotFound:
+            return _json_error(404, "agent not found")
+        return web.json_response({"deleted": request.match_info["name"]})
+
+    # -- v1beta3 inbound events (server.go:1384-1545) ---------------------
+
+    async def handle_v1beta3_event(self, request: web.Request) -> web.Response:
+        """Inbound webhook: fabricate Secret + ContactChannel + Task so a
+        Slack-style thread event becomes a running agent whose final answer
+        is routed back via respond_to_human."""
+        try:
+            body = json.loads(await request.read())
+        except json.JSONDecodeError as e:
+            return _json_error(400, str(e))
+        event_type = body.get("type", "")
+        if event_type not in ("agent_email.received", "agent_slack.received", ""):
+            return _json_error(400, f"unsupported event type {event_type!r}")
+        payload = body.get("event") or body
+        agent_name = body.get("agentName") or payload.get("agent_name", "")
+        message = (
+            payload.get("message")
+            or (payload.get("body") or {}).get("text", "")
+            or payload.get("text", "")
+        )
+        channel_token = body.get("channelApiKey") or payload.get("channel_api_key", "")
+        thread_id = payload.get("thread_id") or payload.get("thread_ts")
+        event_id = payload.get("event_id") or generate_k8s_random_string(8)
+        ns = body.get("namespace", "default")
+        if not agent_name or not message:
+            return _json_error(400, "agentName and message are required")
+        if self.store.try_get("Agent", agent_name, ns) is None:
+            return _json_error(404, f'agent "{agent_name}" not found')
+
+        secret_name = f"v1beta3-token-{event_id}"
+        channel_name = f"v1beta3-channel-{event_id}"
+        try:
+            self.store.create(
+                Secret(
+                    metadata=ObjectMeta(name=secret_name, namespace=ns),
+                    spec=SecretSpec(data={"token": channel_token}),
+                )
+            )
+        except AlreadyExists:
+            pass
+        channel = ContactChannel(
+            metadata=ObjectMeta(name=channel_name, namespace=ns),
+            spec=ContactChannelSpec(
+                type="slack",
+                channel_api_key_from=SecretKeyRef(name=secret_name, key="token"),
+                channel_id=payload.get("channel_id", "C0000000000"),
+                slack=SlackChannelConfig(
+                    channel_or_user_id=payload.get("channel_id", "C0000000000")
+                ),
+            ),
+        )
+        try:
+            ch = self.store.create(channel)
+            ch.status.ready = True
+            ch.status.status = "Ready"
+            ch.status.status_detail = "v1beta3 channel (per-event token)"
+            self.store.update_status(ch)
+        except AlreadyExists:
+            pass
+        task = Task(
+            metadata=ObjectMeta(
+                name=f"{agent_name}-task-{generate_k8s_random_string(8)}",
+                namespace=ns,
+                labels={LABEL_AGENT: agent_name, LABEL_V1BETA3: "true"},
+            ),
+            spec=TaskSpec(
+                agent_ref=LocalObjectRef(name=agent_name),
+                user_message=message,
+                contact_channel_ref=LocalObjectRef(name=channel_name),
+                channel_token_from=SecretKeyRef(name=secret_name, key="token"),
+                thread_id=thread_id,
+            ),
+        )
+        created = self.store.create(task)
+        return web.json_response({"taskName": created.name, "channel": channel_name}, status=201)
+
+    # -- in-tree human interaction (no reference analogue) ----------------
+
+    async def list_approvals(self, request: web.Request) -> web.Response:
+        b = self.operator.human_backend
+        return web.json_response(
+            [
+                {
+                    "callId": a.call_id,
+                    "runId": a.run_id,
+                    "fn": a.fn,
+                    "kwargs": a.kwargs,
+                    "created": a.created,
+                }
+                for a in b.pending_approvals()
+            ]
+        )
+
+    async def approve(self, request: web.Request) -> web.Response:
+        return self._verdict(request, True)
+
+    async def reject(self, request: web.Request) -> web.Response:
+        return self._verdict(request, False)
+
+    def _verdict(self, request: web.Request, approve: bool) -> web.Response:
+        call_id = request.match_info["call_id"]
+        comment = request.query.get("comment", "")
+        b = self.operator.human_backend
+        if call_id not in b.approvals:
+            return _json_error(404, "approval not found")
+        (b.approve if approve else b.reject)(call_id, comment)
+        return web.json_response({"callId": call_id, "approved": approve})
+
+    async def list_contacts(self, request: web.Request) -> web.Response:
+        b = self.operator.human_backend
+        return web.json_response(
+            [
+                {"callId": c.call_id, "runId": c.run_id, "message": c.message, "created": c.created}
+                for c in b.pending_contacts()
+            ]
+        )
+
+    async def respond(self, request: web.Request) -> web.Response:
+        call_id = request.match_info["call_id"]
+        b = self.operator.human_backend
+        if call_id not in b.contacts:
+            return _json_error(404, "contact not found")
+        try:
+            body = json.loads(await request.read())
+        except json.JSONDecodeError as e:
+            return _json_error(400, str(e))
+        if "response" not in body:
+            return _json_error(400, "response is required")
+        b.respond(call_id, body["response"])
+        return web.json_response({"callId": call_id})
+
+    # -- observability ----------------------------------------------------
+
+    async def list_events(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        events = self.store.list("Event", ns)
+        return web.json_response(
+            [
+                {
+                    "involved": f"{e.spec.involved_kind}/{e.spec.involved_name}",
+                    "type": e.spec.type,
+                    "reason": e.spec.reason,
+                    "message": e.spec.message,
+                    "count": e.spec.count,
+                    "lastTimestamp": e.spec.last_timestamp,
+                }
+                for e in events
+            ]
+        )
+
+    async def metrics(self, request: web.Request) -> web.Response:
+        return web.Response(text=REGISTRY.render(), content_type="text/plain")
+
+    async def healthz(self, request: web.Request) -> web.Response:
+        return web.json_response({"status": "ok"})

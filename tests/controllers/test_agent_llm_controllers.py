@@ -1,0 +1,239 @@
+"""Agent / LLM / ContactChannel / MCPServer controller tests."""
+
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM,
+    BaseConfig,
+    LLMSpec,
+    MCPServer,
+    MCPServerSpec,
+    SecretKeyRef,
+)
+from agentcontrolplane_tpu.controllers.agent import AgentReconciler
+from agentcontrolplane_tpu.controllers.contactchannel import ContactChannelReconciler
+from agentcontrolplane_tpu.controllers.llm import LLMReconciler
+from agentcontrolplane_tpu.controllers.mcpserver import MCPServerReconciler
+from agentcontrolplane_tpu.humanlayer import LocalHumanLayerClientFactory
+from agentcontrolplane_tpu.kernel import EventRecorder
+from agentcontrolplane_tpu.llmclient import (
+    LLMRequestError,
+    MockLLMClient,
+    MockLLMClientFactory,
+    assistant,
+)
+
+from ..fixtures import (
+    make_agent,
+    make_contactchannel,
+    make_llm,
+    make_mcpserver,
+    make_secret,
+    make_task,
+)
+
+
+async def test_agent_all_deps_ready(store):
+    recorder = EventRecorder(store)
+    rec = AgentReconciler(store, recorder)
+    make_llm(store)
+    make_mcpserver(store, "fetch", tools=("fetch", "post"))
+    make_secret(store)
+    make_contactchannel(store, "oncall")
+    make_agent(store, name="sub", ready=True)
+    agent = make_agent(
+        store,
+        name="main-agent",
+        mcp_servers=["fetch"],
+        channels=["oncall"],
+        sub_agents=["sub"],
+        ready=False,
+    )
+    result = await rec.reconcile(("Agent", "default", "main-agent"))
+    agent = store.get("Agent", "main-agent")
+    assert agent.status.ready
+    assert agent.status.status == "Ready"
+    assert agent.status.valid_mcp_servers[0].name == "fetch"
+    assert agent.status.valid_mcp_servers[0].tools == ["fetch", "post"]
+    assert agent.status.valid_human_contact_channels == ["oncall"]
+    assert [s.name for s in agent.status.valid_sub_agents] == ["sub"]
+    # Ready agents are revalidated periodically (dependency drift detection)
+    assert result.requeue_after == rec.revalidate_interval
+
+
+async def test_agent_missing_llm_is_error(store):
+    rec = AgentReconciler(store, EventRecorder(store))
+    make_agent(store, name="a", llm="nope", ready=False)
+    result = await rec.reconcile(("Agent", "default", "a"))
+    agent = store.get("Agent", "a")
+    assert not agent.status.ready
+    assert agent.status.status == "Error"
+    assert 'LLM "nope" not found' in agent.status.status_detail
+    assert result.requeue_after == rec.requeue_delay
+
+
+async def test_agent_pending_llm_is_pending(store):
+    rec = AgentReconciler(store, EventRecorder(store))
+    make_llm(store, ready=False)
+    make_agent(store, name="a", ready=False)
+    await rec.reconcile(("Agent", "default", "a"))
+    agent = store.get("Agent", "a")
+    assert agent.status.status == "Pending"
+
+
+async def test_llm_controller_probe_success(store):
+    mock = MockLLMClient(script=[assistant("ok")])
+    factory = MockLLMClientFactory(mock)
+    rec = LLMReconciler(store, EventRecorder(store), factory, probe=True)
+    make_secret(store)
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="gpt"),
+            spec=LLMSpec(
+                provider="openai",
+                api_key_from=SecretKeyRef(name="test-secret", key="api-key"),
+                parameters=BaseConfig(model="gpt-4o"),
+            ),
+        )
+    )
+    await rec.reconcile(("LLM", "default", "gpt"))
+    llm = store.get("LLM", "gpt")
+    assert llm.status.ready and llm.status.status == "Ready"
+    # the probe used max_tokens=1 (reference llm/state_machine.go:391-402)
+    assert factory.calls[0].spec.parameters.max_tokens == 1
+
+
+async def test_llm_controller_probe_failure(store):
+    mock = MockLLMClient(script=[LLMRequestError(401, "invalid key")])
+    rec = LLMReconciler(store, EventRecorder(store), MockLLMClientFactory(mock), probe=True)
+    make_secret(store)
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="gpt"),
+            spec=LLMSpec(
+                provider="openai",
+                api_key_from=SecretKeyRef(name="test-secret", key="api-key"),
+            ),
+        )
+    )
+    result = await rec.reconcile(("LLM", "default", "gpt"))
+    llm = store.get("LLM", "gpt")
+    assert not llm.status.ready
+    assert llm.status.status == "Error"
+    assert "invalid key" in llm.status.status_detail
+    assert result.requeue_after == 30.0
+
+
+async def test_llm_controller_missing_secret(store):
+    rec = LLMReconciler(store, EventRecorder(store), MockLLMClientFactory(MockLLMClient()), probe=False)
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="gpt"),
+            spec=LLMSpec(
+                provider="openai",
+                api_key_from=SecretKeyRef(name="absent", key="api-key"),
+            ),
+        )
+    )
+    await rec.reconcile(("LLM", "default", "gpt"))
+    llm = store.get("LLM", "gpt")
+    assert llm.status.status == "Error"
+    assert 'secret "absent" not found' in llm.status.status_detail
+
+
+async def test_contactchannel_validation(store):
+    rec = ContactChannelReconciler(
+        store, EventRecorder(store), LocalHumanLayerClientFactory(), verify_credentials=True
+    )
+    make_secret(store)
+    make_contactchannel(store, "oncall", ready=False)
+    await rec.reconcile(("ContactChannel", "default", "oncall"))
+    ch = store.get("ContactChannel", "oncall")
+    assert ch.status.ready and ch.status.status == "Ready"
+
+
+async def test_contactchannel_bad_email(store):
+    rec = ContactChannelReconciler(store, EventRecorder(store), None, verify_credentials=False)
+    make_secret(store)
+    ch = make_contactchannel(store, "bad", ready=False)
+    ch = store.get("ContactChannel", "bad")
+    ch.spec.email.address = "not-an-email"
+    store.update(ch)
+    await rec.reconcile(("ContactChannel", "default", "bad"))
+    ch = store.get("ContactChannel", "bad")
+    assert ch.status.status == "Error"
+    assert "invalid email" in ch.status.status_detail
+
+
+class StubMCPManager:
+    """Scriptable MCPManager for the controller test."""
+
+    def __init__(self, fail=False):
+        self.fail = fail
+        self.connected = {}
+
+    async def connect_server(self, server):
+        if self.fail:
+            raise RuntimeError("spawn failed")
+        from agentcontrolplane_tpu.api.resources import MCPTool
+        from agentcontrolplane_tpu.mcp.manager import MCPConnection
+
+        class _Client:
+            alive = True
+
+        conn = MCPConnection(
+            name=server.metadata.name,
+            client=_Client(),
+            tools=[MCPTool(name="fetch", description="fetch a url")],
+        )
+        self.connected[server.metadata.name] = conn
+        return conn
+
+    def get_connection(self, name):
+        return self.connected.get(name)
+
+    async def disconnect_server(self, name):
+        self.connected.pop(name, None)
+
+
+async def test_mcpserver_connects_and_discovers_tools(store):
+    rec = MCPServerReconciler(store, EventRecorder(store), StubMCPManager())
+    store.create(
+        MCPServer(
+            metadata=ObjectMeta(name="fetch"),
+            spec=MCPServerSpec(transport="stdio", command="uvx", args=["mcp-server-fetch"]),
+        )
+    )
+    result = await rec.reconcile(("MCPServer", "default", "fetch"))
+    server = store.get("MCPServer", "fetch")
+    assert server.status.connected
+    assert [t.name for t in server.status.tools] == ["fetch"]
+    assert result.requeue_after == rec.keepalive_interval
+
+
+async def test_mcpserver_connect_failure_retries(store):
+    rec = MCPServerReconciler(store, EventRecorder(store), StubMCPManager(fail=True))
+    store.create(
+        MCPServer(
+            metadata=ObjectMeta(name="fetch"),
+            spec=MCPServerSpec(transport="stdio", command="nope"),
+        )
+    )
+    result = await rec.reconcile(("MCPServer", "default", "fetch"))
+    server = store.get("MCPServer", "fetch")
+    assert not server.status.connected
+    assert server.status.status == "Error"
+    assert result.requeue_after == 30.0
+
+
+async def test_mcpserver_invalid_spec_terminal(store):
+    rec = MCPServerReconciler(store, EventRecorder(store), StubMCPManager())
+    store.create(
+        MCPServer(metadata=ObjectMeta(name="bad"), spec=MCPServerSpec(transport="stdio"))
+    )
+    result = await rec.reconcile(("MCPServer", "default", "bad"))
+    server = store.get("MCPServer", "bad")
+    assert server.status.status == "Error"
+    assert "requires a command" in server.status.status_detail
+    assert result.requeue_after is None

@@ -1,0 +1,334 @@
+"""Task state-machine conformance tests, single reconcile step at a time.
+
+Mirrors the reference's style (``task/task_controller_test.go``): each test
+drives exactly one phase transition and asserts phases, requeue durations,
+status fields, and emitted events.
+"""
+
+import pytest
+
+from agentcontrolplane_tpu.api.resources import (
+    LABEL_TASK,
+    LABEL_TOOL_CALL_REQUEST,
+    Message,
+)
+from agentcontrolplane_tpu.controllers.task import TaskReconciler, build_initial_context_window
+from agentcontrolplane_tpu.humanlayer import LocalHumanLayerClientFactory
+from agentcontrolplane_tpu.kernel import EventRecorder, Store, lease
+from agentcontrolplane_tpu.llmclient import (
+    LLMRequestError,
+    MockLLMClient,
+    MockLLMClientFactory,
+    assistant,
+    tool_call_message,
+)
+
+from ..fixtures import make_agent, make_llm, make_mcpserver, make_task, make_toolcall
+
+
+class FakeMCPManager:
+    def __init__(self, tools=None, results=None):
+        self._tools = tools or {}
+        self._results = results or {}
+        self.calls = []
+
+    def get_tools(self, name):
+        return self._tools.get(name, [])
+
+    async def call_tool(self, server, tool, args):
+        self.calls.append((server, tool, args))
+        result = self._results.get(f"{server}__{tool}", "ok")
+        if isinstance(result, Exception):
+            raise result
+        return result
+
+
+@pytest.fixture
+def harness(store):
+    recorder = EventRecorder(store)
+    mock = MockLLMClient()
+    factory = MockLLMClientFactory(mock)
+    rec = TaskReconciler(
+        store=store,
+        recorder=recorder,
+        llm_factory=factory,
+        mcp_manager=FakeMCPManager(),
+        hl_factory=LocalHumanLayerClientFactory(),
+    )
+    return store, rec, mock, recorder
+
+
+def key(name):
+    return ("Task", "default", name)
+
+
+async def step(rec, name="test-task"):
+    return await rec.reconcile(key(name))
+
+
+async def test_empty_phase_initializes_and_persists_span(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(store)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "Initializing"
+    assert task.status.span_context is not None
+    assert len(task.status.span_context.trace_id) == 32
+    assert result.requeue
+
+
+async def test_agent_missing_goes_pending_with_requeue(harness):
+    store, rec, mock, recorder = harness
+    make_task(store, agent="missing-agent")
+    await step(rec)  # '' -> Initializing
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "Pending"
+    assert 'Waiting for Agent "missing-agent" to exist' in task.status.status_detail
+    assert result.requeue_after == rec.requeue_delay
+    reasons = [e.spec.reason for e in recorder.events_for(task)]
+    assert "Waiting" in reasons
+
+
+async def test_agent_not_ready_goes_pending(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store, ready=False)
+    make_task(store)
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "Pending"
+    assert "to become ready" in task.status.status_detail
+    assert result.requeue_after == rec.requeue_delay
+
+
+async def test_ready_agent_builds_context_window(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store, system="system prompt here")
+    make_task(store, user_message="hello")
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "ReadyForLLM"
+    assert [m.role for m in task.status.context_window] == ["system", "user"]
+    assert task.status.context_window[0].content == "system prompt here"
+    assert task.status.context_window[1].content == "hello"
+    assert task.status.user_msg_preview == "hello"
+    assert result.requeue
+
+
+async def test_invalid_spec_fails_terminally(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(
+        store,
+        user_message="both",
+        context_window=[Message(role="user", content="also this")],
+    )
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "Failed"
+    assert task.status.status == "Error"
+    assert "only one of" in task.status.error
+    assert not result.requeue and result.requeue_after is None
+
+
+async def test_final_answer_path(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(store, user_message="2+2?")
+    mock.script.append(assistant("4"))
+    await step(rec)
+    await step(rec)
+    result = await step(rec)  # ReadyForLLM -> FinalAnswer
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "FinalAnswer"
+    assert task.status.output == "4"
+    assert task.status.context_window[-1].role == "assistant"
+    assert task.status.context_window[-1].content == "4"
+    assert task.status.message_count == 3
+    assert not result.requeue and result.requeue_after is None
+    # terminal: further reconciles are no-ops
+    assert (await step(rec)).requeue_after is None
+
+
+async def test_tool_calls_fan_out(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    from agentcontrolplane_tpu.api.resources import MCPTool
+
+    rec.mcp_manager = FakeMCPManager(
+        tools={"fetch": [MCPTool(name="fetch", description="fetch a url")]}
+    )
+    make_mcpserver(store, "fetch")
+    make_agent(store, mcp_servers=["fetch"], resolved_tools={"fetch": ["fetch"]})
+    make_task(store, user_message="fetch example.com")
+    mock.script.append(
+        tool_call_message(("fetch__fetch", {"url": "https://example.com"}))
+    )
+    await step(rec)
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "ToolCallsPending"
+    assert task.status.tool_call_request_id
+    # the LLM saw the mangled MCP tool
+    sent_tools = [t.function.name for t in mock.requests[0].tools]
+    assert "fetch__fetch" in sent_tools
+
+    tcs = store.list(
+        "ToolCall",
+        label_selector={
+            LABEL_TASK: "test-task",
+            LABEL_TOOL_CALL_REQUEST: task.status.tool_call_request_id,
+        },
+    )
+    assert len(tcs) == 1
+    tc = tcs[0]
+    assert tc.metadata.name == f"test-task-{task.status.tool_call_request_id}-tc-01"
+    assert tc.spec.tool_type == "MCP"
+    assert tc.spec.tool_ref.name == "fetch__fetch"
+    assert tc.metadata.owner_references[0].name == "test-task"
+    assert result.requeue_after == rec.requeue_delay
+
+
+async def test_tool_calls_join_appends_results_in_order(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    task = make_task(store)
+    # fabricate ToolCallsPending with two completed tool calls
+    task.status.phase = "ToolCallsPending"
+    task.status.tool_call_request_id = "req1234"
+    task.status.context_window = [
+        Message(role="system", content="s"),
+        Message(role="user", content="u"),
+    ]
+    store.update_status(task)
+    labels = {LABEL_TASK: "test-task", LABEL_TOOL_CALL_REQUEST: "req1234"}
+    for i, (name, result_text, phase) in enumerate(
+        [("tc-01", "result one", "Succeeded"), ("tc-02", "Rejected: no", "ToolCallRejected")]
+    ):
+        tc = make_toolcall(store, name=f"test-task-req1234-{name}", labels=labels)
+        tc.status.phase = phase
+        tc.status.status = "Succeeded"
+        tc.status.result = result_text
+        store.update_status(tc)
+
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "ReadyForLLM"
+    tool_msgs = [m for m in task.status.context_window if m.role == "tool"]
+    assert [m.content for m in tool_msgs] == ["result one", "Rejected: no"]
+    assert result.requeue
+
+
+async def test_tool_calls_pending_waits_for_completion(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    task = make_task(store)
+    task.status.phase = "ToolCallsPending"
+    task.status.tool_call_request_id = "req1234"
+    store.update_status(task)
+    labels = {LABEL_TASK: "test-task", LABEL_TOOL_CALL_REQUEST: "req1234"}
+    make_toolcall(store, name="test-task-req1234-tc-01", labels=labels)  # phase ""
+    result = await step(rec)
+    assert store.get("Task", "test-task").status.phase == "ToolCallsPending"
+    assert result.requeue_after == rec.requeue_delay
+
+
+async def test_llm_4xx_fails_terminally(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(store)
+    mock.script.append(LLMRequestError(401, "bad api key"))
+    await step(rec)
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "Failed"
+    assert "401" in task.status.error
+    assert result.requeue_after is None and not result.requeue
+    reasons = [e.spec.reason for e in recorder.events_for(task)]
+    assert "LLMRequestFailed" in reasons
+
+
+async def test_llm_5xx_retries_keeping_phase(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(store)
+    mock.script.append(LLMRequestError(503, "overloaded"))
+    await step(rec)
+    await step(rec)
+    result = await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "ReadyForLLM"  # phase kept
+    assert task.status.status == "Error"
+    assert result.requeue_after == rec.requeue_delay
+    # next attempt succeeds
+    mock.script.append(assistant("recovered"))
+    await step(rec)
+    assert store.get("Task", "test-task").status.phase == "FinalAnswer"
+
+
+async def test_lease_held_by_other_replica_blocks_llm_send(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store)
+    make_task(store)
+    await step(rec)
+    await step(rec)
+    lease.try_acquire(store, "task-llm-test-task", "other-pod", ttl=30)
+    result = await step(rec)
+    assert store.get("Task", "test-task").status.phase == "ReadyForLLM"
+    assert result.requeue_after == rec.requeue_delay
+    assert mock.requests == []  # no LLM call happened
+
+
+def test_build_initial_context_window_prepends_system_iff_absent():
+    # provided window without system -> system prepended
+    win = build_initial_context_window(
+        [Message(role="user", content="u")], "SYS", ""
+    )
+    assert [m.role for m in win] == ["system", "user"]
+    assert win[0].content == "SYS"
+    # provided window with system -> untouched
+    win = build_initial_context_window(
+        [Message(role="system", content="custom"), Message(role="user", content="u")],
+        "SYS",
+        "",
+    )
+    assert win[0].content == "custom"
+    # no window -> [system, user]
+    win = build_initial_context_window([], "SYS", "hello")
+    assert [(m.role, m.content) for m in win] == [("system", "SYS"), ("user", "hello")]
+
+
+async def test_context_window_task_spec(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    make_agent(store, system="AGENT SYS")
+    make_task(
+        store,
+        user_message=None,
+        context_window=[
+            Message(role="user", content="continuing conversation"),
+        ],
+    )
+    await step(rec)
+    await step(rec)
+    task = store.get("Task", "test-task")
+    assert task.status.phase == "ReadyForLLM"
+    assert task.status.context_window[0].role == "system"
+    assert task.status.context_window[0].content == "AGENT SYS"
+    assert task.status.user_msg_preview == "continuing conversation"

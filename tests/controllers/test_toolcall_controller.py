@@ -1,0 +1,230 @@
+"""ToolCall state-machine conformance tests (reference: toolcall/*_test.go)."""
+
+import pytest
+
+from agentcontrolplane_tpu.api.resources import LABEL_PARENT_TOOLCALL
+from agentcontrolplane_tpu.controllers.toolcall import ToolCallReconciler
+from agentcontrolplane_tpu.humanlayer import LocalHumanBackend, LocalHumanLayerClientFactory
+from agentcontrolplane_tpu.kernel import EventRecorder, Store
+
+from ..fixtures import (
+    make_agent,
+    make_contactchannel,
+    make_llm,
+    make_mcpserver,
+    make_secret,
+    make_task,
+    make_toolcall,
+)
+from .test_task_controller import FakeMCPManager
+
+
+@pytest.fixture
+def harness(store):
+    recorder = EventRecorder(store)
+    backend = LocalHumanBackend()
+    mcp = FakeMCPManager(results={"fetch__fetch": "<html>example</html>"})
+    rec = ToolCallReconciler(
+        store=store,
+        recorder=recorder,
+        mcp_manager=mcp,
+        hl_factory=LocalHumanLayerClientFactory(backend),
+    )
+    return store, rec, backend, mcp, recorder
+
+
+def key(name="test-task-abc1234-tc-01"):
+    return ("ToolCall", "default", name)
+
+
+async def drive_to_ready(rec, name="test-task-abc1234-tc-01"):
+    await rec.reconcile(key(name))  # '' -> Pending/Pending (+ span)
+    await rec.reconcile(key(name))  # -> Pending/Ready
+
+
+async def test_initialize_then_setup(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_task(store)
+    make_toolcall(store)
+    result = await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert (tc.status.phase, tc.status.status) == ("Pending", "Pending")
+    assert tc.status.span_context is not None
+    assert result.requeue
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert (tc.status.phase, tc.status.status) == ("Pending", "Ready")
+
+
+async def test_mcp_execution_without_approval(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_task(store)
+    make_mcpserver(store, "fetch")  # no approval channel
+    make_toolcall(store)
+    await drive_to_ready(rec)
+    result = await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Succeeded"
+    assert tc.status.result == "<html>example</html>"
+    assert tc.status.completion_time is not None
+    assert mcp.calls == [("fetch", "fetch", {"url": "https://example.com"})]
+    assert result.requeue_after is None
+
+
+async def test_mcp_failure_marks_failed_with_error_result(harness):
+    store, rec, backend, mcp, recorder = harness
+    mcp._results["fetch__fetch"] = RuntimeError("connection refused")
+    make_task(store)
+    make_mcpserver(store, "fetch")
+    make_toolcall(store)
+    await drive_to_ready(rec)
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Failed"
+    assert "connection refused" in tc.status.error
+    assert tc.status.result.startswith("error:")
+
+
+async def test_approval_gate_approve_then_execute(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_secret(store)
+    make_task(store)
+    make_contactchannel(store, "approvals")
+    make_mcpserver(store, "fetch", approval_channel="approvals")
+    make_toolcall(store)
+    await drive_to_ready(rec)
+
+    result = await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "AwaitingHumanApproval"
+    assert tc.status.external_call_id
+    assert result.requeue_after == rec.poll_interval
+    pending = backend.pending_approvals()
+    assert len(pending) == 1 and pending[0].fn == "fetch__fetch"
+
+    # still pending -> keeps polling
+    result = await rec.reconcile(key())
+    assert result.requeue_after == rec.poll_interval
+
+    backend.approve(tc.status.external_call_id, "go ahead")
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "ReadyToExecuteApprovedTool"
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Succeeded"
+    assert tc.status.result == "<html>example</html>"
+
+
+async def test_approval_rejection_is_a_successful_tool_result(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_secret(store)
+    make_task(store)
+    make_contactchannel(store, "approvals")
+    make_mcpserver(store, "fetch", approval_channel="approvals")
+    make_toolcall(store)
+    await drive_to_ready(rec)
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    backend.reject(tc.status.external_call_id, "too dangerous")
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "ToolCallRejected"
+    assert tc.status.status == "Succeeded"  # the LLM sees the rejection
+    assert tc.status.result == "Rejected: too dangerous"
+    assert mcp.calls == []  # tool never executed
+
+
+async def test_delegate_spawns_child_task_and_joins(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_llm(store)
+    make_agent(store, name="researcher", description="does research")
+    make_task(store)
+    make_toolcall(
+        store,
+        tool="delegate_to_agent__researcher",
+        tool_type="DelegateToAgent",
+        arguments='{"message": "find the answer"}',
+    )
+    await drive_to_ready(rec)
+    result = await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "AwaitingSubAgent"
+    children = store.list("Task", label_selector={LABEL_PARENT_TOOLCALL: tc.name})
+    assert len(children) == 1
+    child = children[0]
+    assert child.spec.agent_ref.name == "researcher"
+    assert child.spec.user_message == "find the answer"
+    assert child.metadata.owner_references[0].name == tc.name
+
+    # idempotent under requeue: no duplicate child
+    await rec.reconcile(key())
+    assert len(store.list("Task", label_selector={LABEL_PARENT_TOOLCALL: tc.name})) == 1
+
+    # child completes -> toolcall succeeds with child's output
+    child.status.phase = "FinalAnswer"
+    child.status.output = "the answer is 42"
+    store.update_status(child)
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Succeeded"
+    assert tc.status.result == "the answer is 42"
+
+
+async def test_delegate_child_failure_propagates(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_llm(store)
+    make_agent(store, name="researcher")
+    make_task(store)
+    make_toolcall(
+        store,
+        tool="delegate_to_agent__researcher",
+        tool_type="DelegateToAgent",
+        arguments='{"message": "do it"}',
+    )
+    await drive_to_ready(rec)
+    await rec.reconcile(key())
+    child = store.list("Task", label_selector={LABEL_PARENT_TOOLCALL: "test-task-abc1234-tc-01"})[0]
+    child.status.phase = "Failed"
+    child.status.error = "llm exploded"
+    store.update_status(child)
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Failed"
+    assert "llm exploded" in tc.status.error
+
+
+async def test_human_contact_roundtrip(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_secret(store)
+    make_task(store)
+    make_contactchannel(store, "oncall")
+    make_toolcall(
+        store,
+        tool="oncall__human_contact_email",
+        tool_type="HumanContact",
+        arguments='{"message": "should I deploy?"}',
+    )
+    await drive_to_ready(rec)
+    result = await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "AwaitingHumanInput"
+    assert result.requeue_after == rec.poll_interval
+    assert backend.pending_contacts()[0].message == "should I deploy?"
+
+    backend.respond(tc.status.external_call_id, "yes, ship it")
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Succeeded"
+    assert tc.status.result == "yes, ship it"
+
+
+async def test_unknown_tool_type_fails(harness):
+    store, rec, backend, mcp, recorder = harness
+    make_task(store)
+    make_toolcall(store, tool="unmangled-name")  # MCP but no server__tool form
+    await drive_to_ready(rec)
+    await rec.reconcile(key())
+    tc = store.get("ToolCall", "test-task-abc1234-tc-01")
+    assert tc.status.phase == "Failed"
+    assert "not of the form" in tc.status.error

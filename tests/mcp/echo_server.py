@@ -1,0 +1,88 @@
+"""Minimal MCP stdio server used as a test fixture (the reference tests
+against real MCP servers like mcp-server-fetch; we need zero-dependency).
+
+Speaks newline-delimited JSON-RPC 2.0: initialize, tools/list, tools/call.
+Tools: echo (returns its input), env (returns an env var — used to test
+Secret-resolved env injection), fail (returns isError).
+"""
+
+import json
+import os
+import sys
+
+TOOLS = [
+    {
+        "name": "echo",
+        "description": "echo back the message",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"message": {"type": "string"}},
+            "required": ["message"],
+        },
+    },
+    {
+        "name": "env",
+        "description": "read an environment variable",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"name": {"type": "string"}},
+            "required": ["name"],
+        },
+    },
+    {
+        "name": "fail",
+        "description": "always fails",
+        "inputSchema": {"type": "object", "properties": {}},
+    },
+]
+
+
+def handle(msg):
+    method = msg.get("method")
+    if method == "initialize":
+        return {
+            "protocolVersion": msg["params"].get("protocolVersion", "2024-11-05"),
+            "capabilities": {"tools": {}},
+            "serverInfo": {"name": "echo-test-server", "version": "1.0"},
+        }
+    if method == "tools/list":
+        return {"tools": TOOLS}
+    if method == "tools/call":
+        name = msg["params"]["name"]
+        args = msg["params"].get("arguments") or {}
+        if name == "echo":
+            return {"content": [{"type": "text", "text": f"echo: {args.get('message', '')}"}]}
+        if name == "env":
+            return {"content": [{"type": "text", "text": os.environ.get(args.get("name", ""), "")}]}
+        if name == "fail":
+            return {"isError": True, "content": [{"type": "text", "text": "scripted failure"}]}
+        return {"isError": True, "content": [{"type": "text", "text": f"unknown tool {name}"}]}
+    return None
+
+
+def main():
+    for line in sys.stdin:
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            msg = json.loads(line)
+        except json.JSONDecodeError:
+            continue
+        if "id" not in msg:
+            continue  # notification
+        result = handle(msg)
+        if result is None:
+            resp = {
+                "jsonrpc": "2.0",
+                "id": msg["id"],
+                "error": {"code": -32601, "message": f"unknown method {msg.get('method')}"},
+            }
+        else:
+            resp = {"jsonrpc": "2.0", "id": msg["id"], "result": result}
+        sys.stdout.write(json.dumps(resp) + "\n")
+        sys.stdout.flush()
+
+
+if __name__ == "__main__":
+    main()

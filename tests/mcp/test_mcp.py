@@ -1,0 +1,149 @@
+"""MCP manager tests against a real stdio subprocess (echo_server.py fixture).
+
+Covers the reference's mcpmanager behaviors: connect + handshake + tool
+discovery, tool invocation with text flattening, Secret-resolved env vars
+(envvar_test.go equivalent), error propagation, reconnect after death.
+"""
+
+import os
+import sys
+
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    EnvVar,
+    MCPServer,
+    MCPServerSpec,
+    Secret,
+    SecretKeyRef,
+    SecretSpec,
+)
+from agentcontrolplane_tpu.mcp import MCPError, MCPManager, flatten_tool_result
+from agentcontrolplane_tpu.mcp.adapters import (
+    convert_mcp_tools,
+    parse_tool_arguments,
+    split_tool_name,
+)
+
+SERVER = os.path.join(os.path.dirname(__file__), "echo_server.py")
+
+
+def echo_server_spec(name="echo", env=None):
+    return MCPServer(
+        metadata=ObjectMeta(name=name),
+        spec=MCPServerSpec(
+            transport="stdio",
+            command=sys.executable,
+            args=[SERVER],
+            env=env or [],
+        ),
+    )
+
+
+async def test_connect_discovers_tools(store):
+    mgr = MCPManager(store)
+    try:
+        conn = await mgr.connect_server(echo_server_spec())
+        assert {t.name for t in conn.tools} == {"echo", "env", "fail"}
+        assert conn.client.server_info["name"] == "echo-test-server"
+        assert mgr.get_tools("echo")  # pool populated
+    finally:
+        await mgr.close()
+
+
+async def test_call_tool_flattens_text(store):
+    mgr = MCPManager(store)
+    try:
+        await mgr.connect_server(echo_server_spec())
+        result = await mgr.call_tool("echo", "echo", {"message": "hello mcp"})
+        assert result == "echo: hello mcp"
+    finally:
+        await mgr.close()
+
+
+async def test_secret_env_vars_reach_subprocess(store):
+    store.create(
+        Secret(
+            metadata=ObjectMeta(name="mcp-creds"),
+            spec=SecretSpec(data={"token": "s3cr3t-value"}),
+        )
+    )
+    mgr = MCPManager(store)
+    try:
+        await mgr.connect_server(
+            echo_server_spec(
+                env=[
+                    EnvVar(name="PLAIN", value="plain-value"),
+                    EnvVar(name="FROM_SECRET", value_from=SecretKeyRef(name="mcp-creds", key="token")),
+                ]
+            )
+        )
+        assert await mgr.call_tool("echo", "env", {"name": "PLAIN"}) == "plain-value"
+        assert await mgr.call_tool("echo", "env", {"name": "FROM_SECRET"}) == "s3cr3t-value"
+    finally:
+        await mgr.close()
+
+
+async def test_tool_error_raises(store):
+    mgr = MCPManager(store)
+    try:
+        await mgr.connect_server(echo_server_spec())
+        with pytest.raises(MCPError, match="scripted failure"):
+            await mgr.call_tool("echo", "fail", {})
+    finally:
+        await mgr.close()
+
+
+async def test_call_unconnected_server_raises(store):
+    mgr = MCPManager(store)
+    with pytest.raises(MCPError, match="not connected"):
+        await mgr.call_tool("ghost", "tool", {})
+
+
+async def test_reconnect_replaces_pool_entry(store):
+    mgr = MCPManager(store)
+    try:
+        conn1 = await mgr.connect_server(echo_server_spec())
+        conn2 = await mgr.connect_server(echo_server_spec())
+        assert mgr.get_connection("echo") is conn2
+        assert not conn1.client.alive  # old client closed
+        assert await mgr.call_tool("echo", "echo", {"message": "x"}) == "echo: x"
+    finally:
+        await mgr.close()
+
+
+def test_adapter_name_mangling():
+    from agentcontrolplane_tpu.api.resources import MCPTool
+
+    tools = convert_mcp_tools(
+        [MCPTool(name="fetch", description="d", input_schema={"type": "object"})], "web"
+    )
+    assert tools[0].function.name == "web__fetch"
+    assert tools[0].acp_tool_type == "MCP"
+    assert split_tool_name("web__fetch") == ("web", "fetch")
+    assert split_tool_name("web__fetch__deep") == ("web", "fetch__deep")
+    with pytest.raises(ValueError):
+        split_tool_name("bare")
+
+
+def test_parse_tool_arguments():
+    assert parse_tool_arguments('{"a": 1}') == {"a": 1}
+    assert parse_tool_arguments("") == {}
+    with pytest.raises(ValueError):
+        parse_tool_arguments("[1,2]")
+    with pytest.raises(ValueError):
+        parse_tool_arguments("{broken")
+
+
+def test_flatten_mixed_content():
+    out = flatten_tool_result(
+        {
+            "content": [
+                {"type": "text", "text": "line1"},
+                {"type": "image", "data": "abc"},
+                {"type": "text", "text": "line2"},
+            ]
+        }
+    )
+    assert out == 'line1\n{"type": "image", "data": "abc"}\nline2'

@@ -1,0 +1,208 @@
+"""REST API tests — httptest-style against the live aiohttp server
+(reference: internal/server/server_test.go, 1,641 LoC)."""
+
+import asyncio
+import json
+
+import aiohttp
+import pytest
+
+from agentcontrolplane_tpu.kernel import wait_for
+from agentcontrolplane_tpu.llmclient import (
+    MockLLMClient,
+    MockLLMClientFactory,
+    assistant,
+)
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+from ..fixtures import make_agent, make_llm
+
+
+class RestHarness:
+    def __init__(self):
+        self.mock = MockLLMClient()
+        self.operator = Operator(
+            options=OperatorOptions(
+                enable_rest=True,
+                api_port=0,  # ephemeral
+                llm_probe=False,
+                verify_channel_credentials=False,
+            ),
+            llm_factory=MockLLMClientFactory(self.mock),
+        )
+        self.operator.task_reconciler.requeue_delay = 0.02
+        self.operator.toolcall_reconciler.poll_interval = 0.02
+        self.store = self.operator.store
+
+    async def __aenter__(self):
+        await self.operator.start()
+        for _ in range(100):
+            if self.operator.rest_server.bound_port:
+                break
+            await asyncio.sleep(0.02)
+        self.base = f"http://127.0.0.1:{self.operator.rest_server.bound_port}"
+        self.http = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.http.close()
+        await self.operator.stop()
+
+
+async def test_create_task_and_poll_to_completion():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        h.mock.script.append(assistant("Paris"))
+        resp = await h.http.post(
+            f"{h.base}/v1/tasks", json={"agentName": "helper", "userMessage": "capital of france?"}
+        )
+        assert resp.status == 201
+        body = await resp.json()
+        assert body["name"].startswith("helper-task-")
+        assert body["userMsgPreview"] == ""  # not yet reconciled
+
+        task_name = body["name"]
+        await wait_for(
+            h.store, "Task", task_name, "default",
+            lambda t: t.status.phase == "FinalAnswer", timeout=10,
+        )
+        resp = await h.http.get(f"{h.base}/v1/tasks/{task_name}")
+        got = await resp.json()
+        assert got["phase"] == "FinalAnswer"
+        assert got["output"] == "Paris"
+        assert [m["role"] for m in got["contextWindow"]] == ["system", "user", "assistant"]
+
+
+async def test_create_task_missing_agent_404():
+    async with RestHarness() as h:
+        resp = await h.http.post(
+            f"{h.base}/v1/tasks", json={"agentName": "ghost", "userMessage": "hi"}
+        )
+        assert resp.status == 404
+
+
+async def test_create_task_strict_decode_rejects_unknown_fields():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        resp = await h.http.post(
+            f"{h.base}/v1/tasks",
+            json={"agentName": "helper", "userMessage": "hi", "bogusField": 1},
+        )
+        assert resp.status == 400
+        assert "unknown fields" in (await resp.json())["error"]
+
+
+async def test_create_task_requires_exactly_one_input():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        resp = await h.http.post(f"{h.base}/v1/tasks", json={"agentName": "helper"})
+        assert resp.status == 400
+        resp = await h.http.post(
+            f"{h.base}/v1/tasks",
+            json={
+                "agentName": "helper",
+                "userMessage": "x",
+                "contextWindow": [{"role": "user", "content": "y"}],
+            },
+        )
+        assert resp.status == 400
+
+
+async def test_create_agent_creates_llm_and_secret():
+    async with RestHarness() as h:
+        resp = await h.http.post(
+            f"{h.base}/v1/agents",
+            json={
+                "name": "writer",
+                "systemPrompt": "you write",
+                "llm": {"provider": "mock", "model": "m", "apiKey": "sk-123"},
+            },
+        )
+        assert resp.status == 201
+        assert h.store.try_get("Agent", "writer") is not None
+        assert h.store.try_get("LLM", "writer-llm") is not None
+        secret = h.store.try_get("Secret", "writer-llm-key")
+        assert secret.spec.data == {"api-key": "sk-123"}
+
+        # duplicate -> 409, and no orphaned extra objects
+        resp = await h.http.post(
+            f"{h.base}/v1/agents",
+            json={"name": "writer", "systemPrompt": "x", "llm": {"provider": "mock"}},
+        )
+        assert resp.status == 409
+
+        resp = await h.http.get(f"{h.base}/v1/agents/writer")
+        body = await resp.json()
+        assert body["llmRef"] == "writer-llm"
+
+
+async def test_v1beta3_event_fabricates_channel_and_task():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="support")
+        h.mock.script.append(assistant("I'll help with that"))
+        resp = await h.http.post(
+            f"{h.base}/v1/beta3/events",
+            json={
+                "type": "agent_slack.received",
+                "agentName": "support",
+                "channelApiKey": "xoxb-token",
+                "event": {
+                    "message": "help me",
+                    "thread_ts": "171717.42",
+                    "channel_id": "C0AAAAAAAAA",
+                    "event_id": "ev12345",
+                },
+            },
+        )
+        assert resp.status == 201
+        body = await resp.json()
+        assert body["channel"] == "v1beta3-channel-ev12345"
+        task = h.store.get("Task", body["taskName"])
+        assert task.metadata.labels["acp.tpu/v1beta3"] == "true"
+        assert task.spec.thread_id == "171717.42"
+        assert task.spec.channel_token_from.name == "v1beta3-token-ev12345"
+        channel = h.store.get("ContactChannel", "v1beta3-channel-ev12345")
+        assert channel.status.ready
+
+        # v1beta3 task completes by delivering the answer through a
+        # respond_to_human tool call against the in-tree human backend
+        task = await wait_for(
+            h.store, "Task", body["taskName"], "default",
+            lambda t: t.status.phase in ("FinalAnswer", "Failed"), timeout=10,
+        )
+        assert task.status.phase == "FinalAnswer"
+        assert task.status.output == "I'll help with that"
+
+
+async def test_approvals_endpoint_roundtrip():
+    async with RestHarness() as h:
+        backend = h.operator.human_backend
+        client = h.operator.hl_factory.create_client("")
+        from agentcontrolplane_tpu.humanlayer import FunctionCallSpec
+
+        call_id = await client.request_approval(
+            "run1", "call-abc", FunctionCallSpec(fn="web__fetch", kwargs={"url": "x"})
+        )
+        resp = await h.http.get(f"{h.base}/v1/approvals")
+        pending = await resp.json()
+        assert [p["callId"] for p in pending] == [call_id]
+
+        resp = await h.http.post(f"{h.base}/v1/approvals/{call_id}/approve?comment=ok")
+        assert resp.status == 200
+        status = await client.get_function_call_status(call_id)
+        assert status.approved is True and status.comment == "ok"
+
+        resp = await h.http.get(f"{h.base}/v1/approvals")
+        assert await resp.json() == []
+
+
+async def test_metrics_and_health():
+    async with RestHarness() as h:
+        resp = await h.http.get(f"{h.base}/healthz")
+        assert (await resp.json())["status"] == "ok"
+        resp = await h.http.get(f"{h.base}/metrics")
+        assert resp.status == 200
