@@ -49,7 +49,7 @@ from ..api.resources import (
     TC_PHASE_SUCCEEDED,
 )
 from ..humanlayer.client import HumanLayerClientFactory
-from ..kernel.errors import Conflict, Invalid, NotFound
+from ..kernel.errors import AlreadyExists, Conflict, Invalid, NotFound
 from ..kernel import lease as leaselib
 from ..kernel.events import EventRecorder
 from ..kernel.runtime import Result
@@ -328,10 +328,23 @@ class TaskReconciler:
         task.status.tool_call_request_id = request_id
         self._update_status(task)  # status FIRST, then create children (667-731)
 
-        for i, tc in enumerate(response.tool_calls):
-            name = f"{task.name}-{request_id}-tc-{i + 1:02d}"
-            tool_type = tool_types.get(tc.function.name, "MCP")
-            self._create_tool_call(task, name, request_id, tc.id, tc.function.name, tc.function.arguments, tool_type)
+        try:
+            for i, tc in enumerate(response.tool_calls):
+                name = f"{task.name}-{request_id}-tc-{i + 1:02d}"
+                tool_type = tool_types.get(tc.function.name, "MCP")
+                self._create_tool_call(task, name, request_id, tc.id, tc.function.name, tc.function.arguments, tool_type)
+        except Exception as e:
+            # Partial fan-out would leave the context window declaring N tool
+            # calls with < N results (providers reject that) — fail the Task
+            # with the real cause instead of wedging in ToolCallsPending.
+            task.status.phase = TASK_PHASE_FAILED
+            task.status.status = "Error"
+            task.status.error = f"failed to create tool calls: {e}"
+            task.status.status_detail = task.status.error
+            self._update_status(task)
+            self.recorder.event(task, "Warning", "ToolCallCreationFailed", str(e))
+            self._end_task_span(task, "ERROR")
+            return Result.done()
         self.recorder.event(
             task,
             "Normal",
@@ -401,8 +414,8 @@ class TaskReconciler:
         )
         try:
             self.store.create(tc)
-        except Exception:
-            log.exception("failed to create ToolCall %s", name)
+        except AlreadyExists:
+            pass  # idempotent under requeue
 
     # -- ToolCallsPending: join (291-341) --------------------------------
 
@@ -425,6 +438,16 @@ class TaskReconciler:
             and len(tool_calls) == 1
             and tool_calls[0].spec.tool_ref.name == "respond_to_human"
         ):
+            delivery = tool_calls[0]
+            if delivery.status.phase == TC_PHASE_FAILED:
+                task.status.phase = TASK_PHASE_FAILED
+                task.status.status = "Error"
+                task.status.error = f"respond_to_human failed: {delivery.status.error}"
+                task.status.status_detail = task.status.error
+                self._update_status(task)
+                self.recorder.event(task, "Warning", "RespondToHumanFailed", delivery.status.error)
+                self._end_task_span(task, "ERROR")
+                return Result.done()
             task.status.phase = TASK_PHASE_FINAL_ANSWER
             task.status.status = "Ready"
             task.status.status_detail = "Human response delivered"

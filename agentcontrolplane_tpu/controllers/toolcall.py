@@ -100,6 +100,10 @@ class ToolCallReconciler:
             return await self._check_approval(tc)
         if phase == TC_PHASE_READY_TO_EXECUTE:
             return await self._execute(tc)
+        if phase == TC_PHASE_RUNNING:
+            # durable-state resume: the operator died mid-execution; re-run
+            # the tool (at-least-once semantics, like the reference's requeue)
+            return await self._execute(tc)
         if phase == TC_PHASE_AWAITING_SUB_AGENT:
             return self._wait_for_sub_agent(tc)
         if phase in (TC_PHASE_AWAITING_HUMAN_INPUT, TC_PHASE_ERR_REQUESTING_INPUT):
@@ -188,7 +192,17 @@ class ToolCallReconciler:
             self._update_status(tc)
             self.recorder.event(tc, "Warning", "ApprovalGateBroken", str(e))
             return Result.after(POLL_INTERVAL_AFTER_ERROR)
-        if channel is None or self.hl_factory is None:
+        if channel is not None and self.hl_factory is None:
+            # approval required but no human-layer wiring: fail CLOSED
+            tc.status.phase = TC_PHASE_ERR_REQUESTING_APPROVAL
+            tc.status.status = "Error"
+            tc.status.status_detail = "approval required but no human-layer client configured"
+            self._update_status(tc)
+            self.recorder.event(
+                tc, "Warning", "ApprovalGateBroken", tc.status.status_detail
+            )
+            return Result.after(POLL_INTERVAL_AFTER_ERROR)
+        if channel is None:
             return await self._execute(tc)
         client = self._hl_client(tc, channel)
         try:

@@ -1,0 +1,10 @@
+from .engine import Engine, GenerationResult, SamplingParams
+from .tokenizer import ByteTokenizer, HFTokenizer, render_prompt, render_system
+from .toolparse import parse_tool_calls, to_message
+from .client import TPUEngineClient
+
+__all__ = [
+    "Engine", "GenerationResult", "SamplingParams", "ByteTokenizer",
+    "HFTokenizer", "render_prompt", "render_system", "parse_tool_calls",
+    "to_message", "TPUEngineClient",
+]

@@ -1,0 +1,44 @@
+"""provider: tpu — the LLMClient implementation backed by the in-process
+engine.
+
+This closes the loop of the north star: the Task reconciler's chat-completion
+call path (``SendRequest(contextWindow, tools) -> Message``,
+``llm_client.go:11-14``) dispatches here instead of to external SaaS. The
+engine is stateless w.r.t. conversations (the full context window arrives
+every time — preserving the reference's checkpoint/resume property); the KV
+cache is per-request state inside the engine.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+from ..api.resources import BaseConfig, Message
+from ..llmclient.base import LLMClient, LLMRequestError, Tool
+from .engine import Engine, SamplingParams
+from .tokenizer import render_prompt
+from .toolparse import to_message
+
+
+class TPUEngineClient(LLMClient):
+    def __init__(self, engine: Engine, params: BaseConfig):
+        self.engine = engine
+        self.params = params
+
+    async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
+        prompt = render_prompt(messages, tools)
+        sampling = SamplingParams(
+            temperature=self.params.temperature or 0.0,
+            top_k=self.params.top_k or 0,
+            top_p=self.params.top_p if self.params.top_p is not None else 1.0,
+            max_tokens=self.params.max_tokens or 512,
+        )
+        future = self.engine.submit(prompt, sampling)
+        try:
+            result = await asyncio.wait_for(asyncio.wrap_future(future), timeout=600)
+        except asyncio.TimeoutError:
+            raise LLMRequestError(504, "TPU engine generation timed out")
+        except Exception as e:
+            raise LLMRequestError(500, f"TPU engine failure: {e}")
+        allowed = {t.function.name for t in tools} if tools else None
+        return to_message(result.text, allowed)

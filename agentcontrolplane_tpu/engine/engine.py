@@ -1,0 +1,355 @@
+"""The TPU serving engine: continuous batching over a slot KV cache.
+
+This is the in-tree replacement for the reference's delegation to LLM SaaS
+(north star: "concurrent Task/ToolCall CRs are continuously batched into a
+single decode stream with tensor-parallel allreduce over ICI").
+
+Architecture:
+
+- One **engine thread** owns the device state (params stay resident; the KV
+  cache is threaded through jitted steps with donation, so XLA updates it in
+  place). Requests arrive on a thread-safe queue from the asyncio control
+  plane and resolve ``concurrent.futures.Future``s.
+- **Admission**: a waiting request takes a free slot; its prompt is padded to
+  a power-of-two bucket and run through the jitted prefill (one compiled
+  program per bucket), which also samples the first token on-device.
+- **Decode**: one jitted step advances ALL active slots one token and samples
+  on-device — only [S] token ids cross to the host per step. Sequences join
+  at prefill and leave at EOS/stop/max-tokens; the batch never drains to
+  admit new work (no head-of-line blocking — SURVEY.md §7.4 hard-part #1).
+- **Sharding**: params/cache carry NamedShardings over a ``('tp',)`` mesh;
+  jit propagates them, XLA inserts the ICI allreduces.
+
+The scheduler's lease interaction: the control plane's per-task lease
+serializes per Task, but requests from many Tasks batch here freely — the
+lease layer never serializes the engine.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import queue
+import threading
+import time
+import uuid
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models.llama import (
+    LlamaConfig,
+    PRESETS,
+    decode_step,
+    init_kv_cache,
+    prefill,
+)
+from ..observability.metrics import REGISTRY
+from ..ops.sampling import sample
+from ..parallel.mesh import (
+    kv_cache_shardings,
+    param_shardings,
+    replicated,
+    serving_mesh,
+)
+from .tokenizer import ByteTokenizer, Tokenizer
+from .weights import sharded_init
+
+log = logging.getLogger("acp_tpu.engine")
+
+
+@dataclass(frozen=True)
+class SamplingParams:
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    max_tokens: int = 512
+
+
+@dataclass
+class GenerationResult:
+    text: str
+    tokens: list[int]
+    finish_reason: str  # "stop" | "length"
+    prompt_tokens: int
+    ttft_ms: float  # time to first token
+    latency_ms: float
+
+
+@dataclass
+class _Request:
+    rid: str
+    prompt: list[int]
+    sampling: SamplingParams
+    future: Future
+    enqueued: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Slot:
+    request: _Request
+    generated: list[int] = field(default_factory=list)
+    prompt_len: int = 0
+    first_token_at: float = 0.0
+
+
+def _next_bucket(n: int, buckets: Sequence[int]) -> int:
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+class Engine:
+    def __init__(
+        self,
+        config: LlamaConfig | str = "bench-1b",
+        params: Optional[dict] = None,
+        tokenizer: Optional[Tokenizer] = None,
+        mesh=None,
+        max_slots: int = 64,
+        max_ctx: int = 2048,
+        prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
+        seed: int = 0,
+    ):
+        if isinstance(config, str):
+            config = PRESETS[config]
+        self.config = config
+        self.tokenizer = tokenizer or ByteTokenizer()
+        self.max_slots = max_slots
+        self.max_ctx = min(max_ctx, config.max_seq_len)
+        self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_ctx] or [
+            self.max_ctx
+        ]
+        self.mesh = mesh if mesh is not None else serving_mesh()
+
+        t0 = time.monotonic()
+        if params is None:
+            from ..models.llama import init_params as _init
+
+            abstract = jax.eval_shape(lambda k: _init(config, k), jax.random.key(0))
+            shardings = param_shardings(self.mesh, config, abstract)
+            params = jax.jit(
+                lambda k: _init(config, k), out_shardings=shardings
+            )(jax.random.key(seed))
+        self.params = params
+        cache_shardings = kv_cache_shardings(self.mesh)
+        self.cache = jax.jit(
+            lambda: init_kv_cache(config, max_slots, self.max_ctx),
+            out_shardings=cache_shardings,
+        )()
+        log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
+
+        self._rng = jax.random.key(seed)
+        self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        self._slots: dict[int, _Slot] = {}
+        self._free = list(range(max_slots))
+        # host mirrors of per-slot device state
+        self._seq_lens = np.zeros(max_slots, dtype=np.int32)
+        self._last_tokens = np.zeros(max_slots, dtype=np.int32)
+        self._temps = np.zeros(max_slots, dtype=np.float32)
+        self._top_ks = np.zeros(max_slots, dtype=np.int32)
+        self._top_ps = np.ones(max_slots, dtype=np.float32)
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = False
+        self.decode_steps = 0
+        self.tokens_generated = 0
+
+        self._build_jitted()
+
+    # -- jitted programs -------------------------------------------------
+
+    def _build_jitted(self):
+        config = self.config
+
+        def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p):
+            cache, logits = prefill(params, cache, tokens, length, slot, config)
+            tok = sample(
+                logits[None], rng, temp[None], top_k[None], top_p[None]
+            )[0]
+            return cache, tok
+
+        self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
+
+        def decode_and_sample(params, cache, tokens, seq_lens, rng, temps, top_ks, top_ps):
+            cache, logits = decode_step(params, cache, tokens, seq_lens, config)
+            toks = sample(logits, rng, temps, top_ks, top_ps)
+            return cache, toks
+
+        self._jit_decode = jax.jit(decode_and_sample, donate_argnums=(1,))
+
+    # -- public API ------------------------------------------------------
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stopping = False
+        self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._stopping = True
+        self._queue.put(None)
+        self._thread.join(timeout=30)
+        self._thread = None
+
+    def submit(
+        self, prompt: str | list[int], sampling: Optional[SamplingParams] = None
+    ) -> Future:
+        """Thread-safe; returns a Future[GenerationResult]."""
+        tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        if len(tokens) >= self.max_ctx:
+            tokens = tokens[-(self.max_ctx - 1) :]
+        req = _Request(
+            rid=uuid.uuid4().hex[:8],
+            prompt=tokens,
+            sampling=sampling or SamplingParams(),
+            future=Future(),
+        )
+        if self._thread is None or self._stopping:
+            req.future.set_exception(RuntimeError("engine is not running"))
+            return req.future
+        self._queue.put(req)
+        return req.future
+
+    def generate(self, prompt: str | list[int], sampling: Optional[SamplingParams] = None) -> GenerationResult:
+        """Synchronous helper (tests/benchmarks). Requires a started engine."""
+        return self.submit(prompt, sampling).result(timeout=600)
+
+    # -- engine loop -----------------------------------------------------
+
+    def _run(self) -> None:
+        while not self._stopping:
+            admitted = self._admit(block=not self._slots)
+            if self._stopping:
+                break
+            if not self._slots:
+                if not admitted:
+                    continue
+            self._decode_once()
+        # drain: fail any queued requests
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is not None:
+                req.future.set_exception(RuntimeError("engine stopped"))
+        for slot in list(self._slots):
+            self._finish(slot, "stop")
+
+    def _admit(self, block: bool) -> bool:
+        """Move queued requests into free slots (prefill). Returns True if
+        anything was admitted."""
+        admitted = False
+        while self._free:
+            try:
+                req = self._queue.get(timeout=0.05) if (block and not admitted and not self._slots) else self._queue.get_nowait()
+            except queue.Empty:
+                break
+            if req is None:
+                self._stopping = True
+                return admitted
+            slot = self._free.pop()
+            self._prefill_into(slot, req)
+            admitted = True
+        return admitted
+
+    def _prefill_into(self, slot: int, req: _Request) -> None:
+        plen = len(req.prompt)
+        bucket = _next_bucket(plen, self.prefill_buckets)
+        tokens = np.zeros(bucket, dtype=np.int32)
+        tokens[:plen] = req.prompt
+        self._rng, step_rng = jax.random.split(self._rng)
+        s = req.sampling
+        cache, first = self._jit_prefill(
+            self.params,
+            self.cache,
+            jnp.asarray(tokens),
+            jnp.int32(plen),
+            jnp.int32(slot),
+            step_rng,
+            jnp.float32(s.temperature),
+            jnp.int32(s.top_k),
+            jnp.float32(s.top_p),
+        )
+        self.cache = cache
+        first_tok = int(first)
+        now = time.monotonic()
+        sl = _Slot(request=req, prompt_len=plen, first_token_at=now)
+        sl.generated.append(first_tok)
+        self._slots[slot] = sl
+        self._seq_lens[slot] = plen
+        self._last_tokens[slot] = first_tok
+        self._temps[slot] = s.temperature
+        self._top_ks[slot] = s.top_k
+        self._top_ps[slot] = s.top_p
+        REGISTRY.observe(
+            "acp_engine_ttft_seconds", now - req.enqueued, help="time to first token"
+        )
+        if first_tok in self.tokenizer.stop_tokens or s.max_tokens <= 1:
+            self._finish(slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length")
+
+    def _decode_once(self) -> None:
+        if not self._slots:
+            return
+        self._rng, step_rng = jax.random.split(self._rng)
+        cache, toks = self._jit_decode(
+            self.params,
+            self.cache,
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._seq_lens),
+            step_rng,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps),
+        )
+        self.cache = cache
+        toks = np.asarray(toks)
+        self.decode_steps += 1
+        active = list(self._slots.items())
+        self.tokens_generated += len(active)
+        for slot, sl in active:
+            tok = int(toks[slot])
+            self._seq_lens[slot] += 1
+            self._last_tokens[slot] = tok
+            sl.generated.append(tok)
+            s = sl.request.sampling
+            if tok in self.tokenizer.stop_tokens:
+                self._finish(slot, "stop")
+            elif len(sl.generated) >= s.max_tokens:
+                self._finish(slot, "length")
+            elif self._seq_lens[slot] + 1 >= self.max_ctx:
+                self._finish(slot, "length")
+        REGISTRY.gauge_set(
+            "acp_engine_active_slots", len(self._slots), help="occupied decode slots"
+        )
+
+    def _finish(self, slot: int, reason: str) -> None:
+        sl = self._slots.pop(slot)
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        self._free.append(slot)
+        gen = sl.generated
+        if gen and gen[-1] in self.tokenizer.stop_tokens:
+            gen = gen[:-1]
+        now = time.monotonic()
+        result = GenerationResult(
+            text=self.tokenizer.decode(gen),
+            tokens=gen,
+            finish_reason=reason,
+            prompt_tokens=sl.prompt_len,
+            ttft_ms=(sl.first_token_at - sl.request.enqueued) * 1e3,
+            latency_ms=(now - sl.request.enqueued) * 1e3,
+        )
+        if not sl.request.future.done():
+            sl.request.future.set_result(result)
+        REGISTRY.counter_add("acp_engine_requests_total", 1.0)
+        REGISTRY.counter_add("acp_engine_tokens_total", float(len(gen)))

@@ -68,8 +68,10 @@ class AnthropicClient(LLMClient):
         api_key: str,
         params: BaseConfig,
         http: Optional[httpx.AsyncClient] = None,
+        pooled: bool = False,
     ):
         self.params = params
+        self._pooled = pooled
         self._http = http or httpx.AsyncClient(
             base_url=params.base_url or DEFAULT_BASE_URL,
             headers={"x-api-key": api_key, "anthropic-version": "2023-06-01"},
@@ -133,4 +135,5 @@ class AnthropicClient(LLMClient):
         return Message(role="assistant", content=content)
 
     async def close(self) -> None:
-        await self._http.aclose()
+        if not self._pooled:
+            await self._http.aclose()

@@ -27,8 +27,10 @@ class LLMRequestError(Exception):
 
     @property
     def terminal(self) -> bool:
-        """4xx errors fail the Task terminally; everything else retries."""
-        return 400 <= self.status_code < 500
+        """4xx errors fail the Task terminally (the reference's rule,
+        task/state_machine.go:737-743) — except transient 408 (timeout) and
+        429 (rate limit), which retry."""
+        return 400 <= self.status_code < 500 and self.status_code not in (408, 429)
 
 
 class ToolFunction(BaseModel):

@@ -38,20 +38,58 @@ def resolve_secret_key(store: Store, namespace: str, ref: Optional[SecretKeyRef]
 
 class DefaultLLMClientFactory:
     """Routes on ``spec.provider``. ``tpu`` resolves to the in-process
-    serving engine's client (north star: no external provider)."""
+    serving engine's client (north star: no external provider).
+
+    HTTP clients are pooled per (provider, base_url, api_key) so an N-turn
+    tool loop reuses one TLS connection instead of handshaking per request;
+    pooled clients ignore per-request ``close()`` and are torn down by
+    ``aclose()`` at operator stop."""
 
     def __init__(self, engine=None):
         self._engine = engine
+        self._http_pool: dict[tuple, "httpx.AsyncClient"] = {}
+
+    def _pooled_http(self, key: tuple, build) -> "httpx.AsyncClient":
+        http = self._http_pool.get(key)
+        if http is None or http.is_closed:
+            http = build()
+            self._http_pool[key] = http
+        return http
 
     async def create_client(self, llm: LLM, api_key: str) -> LLMClient:
+        import httpx
+
+        from .anthropic import DEFAULT_BASE_URL as ANTHROPIC_URL
+        from .openai import DEFAULT_BASE_URLS, REQUEST_TIMEOUT
+
         provider = llm.spec.provider
         params = llm.spec.parameters
         if provider in ("openai", "mistral", "google", "vertex"):
             if provider == "vertex" and not params.base_url:
                 raise Invalid("provider vertex requires parameters.baseURL")
-            return OpenAICompatibleClient(api_key, params, provider=provider)
+            base_url = params.base_url or DEFAULT_BASE_URLS.get(
+                provider, DEFAULT_BASE_URLS["openai"]
+            )
+            http = self._pooled_http(
+                (provider, base_url, api_key),
+                lambda: httpx.AsyncClient(
+                    base_url=base_url,
+                    headers={"Authorization": f"Bearer {api_key}"},
+                    timeout=REQUEST_TIMEOUT,
+                ),
+            )
+            return OpenAICompatibleClient(api_key, params, provider=provider, http=http, pooled=True)
         if provider == "anthropic":
-            return AnthropicClient(api_key, params)
+            base_url = params.base_url or ANTHROPIC_URL
+            http = self._pooled_http(
+                ("anthropic", base_url, api_key),
+                lambda: httpx.AsyncClient(
+                    base_url=base_url,
+                    headers={"x-api-key": api_key, "anthropic-version": "2023-06-01"},
+                    timeout=30.0,
+                ),
+            )
+            return AnthropicClient(api_key, params, http=http, pooled=True)
         if provider == "tpu":
             if self._engine is None:
                 raise Invalid("provider tpu requires a serving engine")
@@ -61,6 +99,12 @@ class DefaultLLMClientFactory:
         if provider == "mock":
             return MockLLMClient()
         raise Invalid(f"unknown provider {provider!r}")
+
+    async def aclose(self) -> None:
+        for http in self._http_pool.values():
+            if not http.is_closed:
+                await http.aclose()
+        self._http_pool.clear()
 
 
 class MockLLMClientFactory:

@@ -87,9 +87,11 @@ class OpenAICompatibleClient(LLMClient):
         params: BaseConfig,
         provider: str = "openai",
         http: Optional[httpx.AsyncClient] = None,
+        pooled: bool = False,
     ):
         self.params = params
         self.provider = provider
+        self._pooled = pooled  # pooled connections outlive this client object
         base_url = params.base_url or DEFAULT_BASE_URLS.get(provider, DEFAULT_BASE_URLS["openai"])
         self._http = http or httpx.AsyncClient(
             base_url=base_url,
@@ -136,4 +138,5 @@ class OpenAICompatibleClient(LLMClient):
         return merge_choices(choices)
 
     async def close(self) -> None:
-        await self._http.aclose()
+        if not self._pooled:
+            await self._http.aclose()

@@ -1,0 +1,14 @@
+from .llama import (
+    PRESETS,
+    LlamaConfig,
+    decode_step,
+    forward,
+    init_kv_cache,
+    init_params,
+    prefill,
+)
+
+__all__ = [
+    "PRESETS", "LlamaConfig", "decode_step", "forward", "init_kv_cache",
+    "init_params", "prefill",
+]

@@ -1,0 +1,295 @@
+"""Llama model family, TPU-first pure-JAX implementation.
+
+No reference analogue (humanlayer/agentcontrolplane runs no models —
+SURVEY.md §0); this is the compute core of the in-tree ``provider: tpu``
+backend (north star: Llama-3-8B serving on v5e-8).
+
+Design choices for TPU/XLA:
+
+- Params are a plain pytree with **stacked layer weights** (leading dim =
+  n_layers) so the transformer body is one ``lax.scan`` — O(1) HLO size and
+  compile time in depth, and XLA pipelines the layer loop.
+- bf16 params/activations (MXU-native), float32 for norms/softmax/rope.
+- GQA (n_kv_heads <= n_heads), SwiGLU MLP, RMSNorm, RoPE — weight layout
+  matches HF ``LlamaForCausalLM`` so checkpoints load without surgery.
+- Three entry points: ``forward`` (full sequence — training/prefill/tests),
+  ``prefill`` (writes a slot KV cache), ``decode_step`` (one token for all
+  slots of the continuous batch).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..ops.attention import (
+    causal_attention,
+    decode_attention,
+    write_kv,
+    write_kv_token,
+)
+from ..ops.norms import rms_norm
+from ..ops.rope import apply_rope
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    norm_eps: float = 1e-5
+    rope_theta: float = 500000.0
+    max_seq_len: int = 8192
+    tie_embeddings: bool = False
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+
+# Presets: llama3-8b matches meta-llama/Meta-Llama-3-8B(-Instruct);
+# llama3.2-1b matches meta-llama/Llama-3.2-1B(-Instruct).
+PRESETS: dict[str, LlamaConfig] = {
+    "llama3-8b": LlamaConfig(),
+    "llama3.2-1b": LlamaConfig(
+        vocab_size=128256,
+        dim=2048,
+        n_layers=16,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        rope_theta=500000.0,
+        tie_embeddings=True,
+    ),
+    "llama3.2-3b": LlamaConfig(
+        vocab_size=128256,
+        dim=3072,
+        n_layers=28,
+        n_heads=24,
+        n_kv_heads=8,
+        ffn_dim=8192,
+        tie_embeddings=True,
+    ),
+    # ~125M config sized to fill a single v5e chip nicely at batch 64
+    "bench-1b": LlamaConfig(
+        vocab_size=32768,
+        dim=2048,
+        n_layers=16,
+        n_heads=16,
+        n_kv_heads=8,
+        ffn_dim=8192,
+    ),
+    # tiny config for CPU tests (matches an HF config in tests)
+    "tiny": LlamaConfig(
+        vocab_size=256,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        dtype=jnp.float32,
+    ),
+}
+
+
+def init_params(config: LlamaConfig, key: jax.Array) -> dict:
+    """Random init (serving benchmarks / tests); layout mirrors HF names."""
+    c = config
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    d, hd = c.dim, c.head_dim
+
+    def norm_init(shape, scale):
+        # truncated-normal-ish init; exact init only matters for training
+        return (
+            jax.random.normal(jax.random.fold_in(k_layers, hash(shape) % 2**31), shape)
+            * scale
+        ).astype(c.dtype)
+
+    def stacked(shape, scale):
+        return (
+            jax.random.normal(
+                jax.random.fold_in(k_layers, (hash(shape) + 1) % 2**31),
+                (c.n_layers, *shape),
+            )
+            * scale
+        ).astype(c.dtype)
+
+    scale = d**-0.5
+    params = {
+        "embed": (jax.random.normal(k_embed, (c.vocab_size, d)) * scale).astype(c.dtype),
+        "layers": {
+            "ln1": jnp.ones((c.n_layers, d), dtype=c.dtype),
+            "ln2": jnp.ones((c.n_layers, d), dtype=c.dtype),
+            "wq": stacked((d, c.n_heads * hd), scale),
+            "wk": stacked((d, c.n_kv_heads * hd), scale),
+            "wv": stacked((d, c.n_kv_heads * hd), scale),
+            "wo": stacked((c.n_heads * hd, d), scale),
+            "w1": stacked((d, c.ffn_dim), scale),  # gate_proj
+            "w3": stacked((d, c.ffn_dim), scale),  # up_proj
+            "w2": stacked((c.ffn_dim, d), c.ffn_dim**-0.5),  # down_proj
+        },
+        "norm": jnp.ones((d,), dtype=c.dtype),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = (
+            jax.random.normal(k_head, (d, c.vocab_size)) * scale
+        ).astype(c.dtype)
+    return params
+
+
+def _attn_mlp(
+    x: jax.Array,  # [B, T, D]
+    layer: dict,  # one layer's params (unstacked)
+    config: LlamaConfig,
+    positions: jax.Array,  # [B, T]
+    attn_fn,
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared block body: returns (output, k, v) where k/v are this layer's
+    new key/value tensors (for cache writes)."""
+    c = config
+    B, T, D = x.shape
+    h = rms_norm(x, layer["ln1"], c.norm_eps)
+    q = (h @ layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
+    k = (h @ layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+    v = (h @ layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+    q = apply_rope(q, positions, c.rope_theta)
+    k = apply_rope(k, positions, c.rope_theta)
+    attn = attn_fn(q, k, v)
+    x = x + attn.reshape(B, T, c.n_heads * c.head_dim) @ layer["wo"]
+    h = rms_norm(x, layer["ln2"], c.norm_eps)
+    x = x + (jax.nn.silu(h @ layer["w1"]) * (h @ layer["w3"])) @ layer["w2"]
+    return x, k, v
+
+
+def forward(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    config: LlamaConfig,
+    positions: Optional[jax.Array] = None,  # [B, T]; default arange
+    attn_impl=None,  # callable(q, k, v, positions) -> out; default dense causal
+) -> jax.Array:
+    """Full-sequence causal forward -> logits [B, T, V] (float32).
+
+    ``attn_impl`` swaps the attention op — e.g. ring attention for
+    sequence-parallel training (parallel.ring_attention)."""
+    c = config
+    B, T = tokens.shape
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    attn = attn_impl or causal_attention
+
+    def body(x, layer):
+        out, _, _ = _attn_mlp(
+            x,
+            layer,
+            c,
+            positions,
+            lambda q, k, v: attn(q, k, v, positions),
+        )
+        return out, None
+
+    x = params["embed"][tokens].astype(c.dtype)
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    return (x @ head.astype(c.dtype)).astype(jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Serving: slot KV cache
+# ---------------------------------------------------------------------------
+
+
+def init_kv_cache(config: LlamaConfig, max_slots: int, max_ctx: int) -> dict:
+    """[L, S, C, H_kv, d] per k/v, bf16."""
+    c = config
+    shape = (c.n_layers, max_slots, max_ctx, c.n_kv_heads, c.head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype=c.dtype),
+        "v": jnp.zeros(shape, dtype=c.dtype),
+    }
+
+
+def prefill(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [T] int32 (padded)
+    length: jax.Array,  # scalar int32 — true prompt length
+    slot: jax.Array,  # scalar int32
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Run the prompt through the model, writing K/V into ``slot``.
+    Returns (cache, logits_at_last_token [V])."""
+    c = config
+    T = tokens.shape[0]
+    positions = jnp.where(jnp.arange(T) < length, jnp.arange(T), -1)[None]  # [1,T]
+    x = params["embed"][tokens][None].astype(c.dtype)  # [1, T, D]
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_cache_l, v_cache_l = scanned
+        out, k, v = _attn_mlp(
+            x,
+            layer,
+            c,
+            positions,
+            lambda q, k, v: causal_attention(q, k, v, positions),
+        )
+        k_cache_l, v_cache_l = write_kv(
+            k_cache_l, v_cache_l, slot, jnp.int32(0), k[0], v[0]
+        )
+        return out, (k_cache_l, v_cache_l)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    last = x[0, length - 1]  # [D]
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits
+
+
+def decode_step(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [S] int32 — last sampled token per slot
+    seq_lens: jax.Array,  # [S] int32 — current length per slot (before this token)
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """One decode step for ALL slots (the continuous-batching hot loop).
+    Inactive slots simply compute garbage that is never read.
+    Returns (cache, logits [S, V])."""
+    c = config
+    S = tokens.shape[0]
+    positions = seq_lens[:, None]  # the new token's position, [S, 1]
+    x = params["embed"][tokens][:, None].astype(c.dtype)  # [S, 1, D]
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_cache_l, v_cache_l = scanned
+
+        def attn(q, k, v):
+            # write the new token, then attend over the slot cache
+            k_l, v_l = write_kv_token(k_cache_l, v_cache_l, seq_lens, k[:, 0], v[:, 0])
+            out = decode_attention(q[:, 0], k_l, v_l, seq_lens + 1)
+            attn.updated = (k_l, v_l)
+            return out[:, None]
+
+        out, _, _ = _attn_mlp(x, layer, c, positions, attn)
+        return out, attn.updated
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x[:, 0], params["norm"], c.norm_eps)  # [S, D]
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits

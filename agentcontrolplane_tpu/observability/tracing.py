@@ -15,6 +15,7 @@ from __future__ import annotations
 import collections
 import json
 import os
+import queue
 import secrets
 import threading
 import time
@@ -57,9 +58,30 @@ class Span:
 
 class Tracer:
     def __init__(self, max_finished: int = 4096, endpoint: Optional[str] = None):
-        self.endpoint = endpoint or os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT")
+        # endpoint: None = use env (no-op if unset); "" = explicitly disabled
+        if endpoint is None:
+            endpoint = os.environ.get("OTEL_EXPORTER_OTLP_ENDPOINT", "")
+        self.endpoint = endpoint
         self.finished: collections.deque[Span] = collections.deque(maxlen=max_finished)
         self._lock = threading.Lock()
+        # exports run on a dedicated daemon thread so span ends never block
+        # the asyncio reconcile loop
+        self._export_queue: "queue.Queue[Optional[Span]]" = queue.Queue(maxsize=1024)
+        self._export_thread: Optional[threading.Thread] = None
+
+    def _ensure_export_thread(self) -> None:
+        if self._export_thread is None or not self._export_thread.is_alive():
+            self._export_thread = threading.Thread(
+                target=self._export_loop, name="otlp-export", daemon=True
+            )
+            self._export_thread.start()
+
+    def _export_loop(self) -> None:
+        while True:
+            span = self._export_queue.get()
+            if span is None:
+                return
+            self._export(span)
 
     def start_span(
         self,
@@ -85,7 +107,11 @@ class Tracer:
         with self._lock:
             self.finished.append(span)
         if self.endpoint:
-            self._export(span)
+            self._ensure_export_thread()
+            try:
+                self._export_queue.put_nowait(span)
+            except queue.Full:
+                pass  # drop rather than block
 
     def _export(self, span: Span) -> None:
         """Best-effort OTLP/JSON export; failures are silent (no-op fallback)."""
@@ -133,4 +159,4 @@ class Tracer:
             return [s for s in self.finished if s.trace_id == trace_id]
 
 
-NOOP_TRACER = Tracer(endpoint=None)
+NOOP_TRACER = Tracer(endpoint="")  # explicitly disabled, ignores env

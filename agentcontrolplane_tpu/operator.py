@@ -138,6 +138,9 @@ class Operator:
     async def stop(self) -> None:
         await self.manager.stop()
         await self.mcp_manager.close()
+        closer = getattr(self.llm_factory, "aclose", None)
+        if closer is not None:
+            await closer()
         if self.rest_server is not None:
             await self.rest_server.stop()
         self.store.close()

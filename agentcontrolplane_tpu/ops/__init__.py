@@ -1,0 +1,15 @@
+from .attention import (
+    causal_attention,
+    decode_attention,
+    repeat_kv,
+    write_kv,
+    write_kv_token,
+)
+from .norms import rms_norm
+from .rope import apply_rope, rope_frequencies
+from .sampling import sample
+
+__all__ = [
+    "causal_attention", "decode_attention", "repeat_kv", "write_kv",
+    "write_kv_token", "rms_norm", "apply_rope", "rope_frequencies", "sample",
+]

@@ -1,0 +1,111 @@
+"""Attention ops for the serving engine and trainer.
+
+Three entry points:
+
+- ``causal_attention``       — full-sequence attention (prefill / training).
+- ``decode_attention``       — one-token-per-slot attention over the slot KV
+                               cache (the continuous-batching hot loop).
+- ``write_kv`` / ``write_kv_token`` — cache updates.
+
+The decode cache is a contiguous per-slot layout ``[S, max_ctx, H_kv, d]``:
+on TPU a decode step must stream every live K/V byte from HBM regardless of
+layout, so contiguous-slot reads beat a page-table gather (which would
+materialize an extra copy in pure XLA); page-granular allocation is what a
+Pallas kernel adds later (ops/pallas). GQA is handled by repeating KV heads.
+
+All softmax math in float32; logits capped via stable max-subtraction.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
+    """[..., H_kv, d] -> [..., H_kv * n_rep, d] (GQA)."""
+    if n_rep == 1:
+        return x
+    return jnp.repeat(x, n_rep, axis=-2)
+
+
+def causal_attention(
+    q: jax.Array,  # [B, T, H, d]
+    k: jax.Array,  # [B, T, H_kv, d]
+    v: jax.Array,  # [B, T, H_kv, d]
+    positions: jax.Array | None = None,  # [B, T] for padded/packed inputs
+) -> jax.Array:
+    """Full causal self-attention. With ``positions`` given, tokens attend
+    only to tokens with position <= their own AND valid (position >= 0)."""
+    B, T, H, d = q.shape
+    n_rep = H // k.shape[-2]
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    if positions is None:
+        mask = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None]
+    else:
+        valid = positions >= 0
+        mask = (
+            (positions[:, None, :, None] >= positions[:, None, None, :])
+            & valid[:, None, :, None]
+            & valid[:, None, None, :]
+        )
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def decode_attention(
+    q: jax.Array,  # [S, H, d] — one new token per slot
+    k_cache: jax.Array,  # [S, C, H_kv, d]
+    v_cache: jax.Array,  # [S, C, H_kv, d]
+    seq_lens: jax.Array,  # [S] int32 — tokens valid in each slot (incl. new)
+) -> jax.Array:
+    """Single-step attention against the slot cache."""
+    S, C, H_kv, d = k_cache.shape
+    n_rep = q.shape[-2] // H_kv
+    k = repeat_kv(k_cache, n_rep)  # [S, C, H, d]
+    v = repeat_kv(v_cache, n_rep)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) * scale
+    mask = jnp.arange(C)[None, None, :] < seq_lens[:, None, None]  # [S,1,C]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("shc,schd->shd", probs, v)
+
+
+def write_kv(
+    k_cache: jax.Array,  # [S, C, H_kv, d]
+    v_cache: jax.Array,
+    slot: jax.Array,  # scalar int32
+    start: jax.Array,  # scalar int32 — first position to write
+    k_new: jax.Array,  # [T, H_kv, d]
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Write a prompt's K/V into one slot starting at ``start``."""
+    k_cache = jax.lax.dynamic_update_slice(
+        k_cache, k_new[None].astype(k_cache.dtype), (slot, start, 0, 0)
+    )
+    v_cache = jax.lax.dynamic_update_slice(
+        v_cache, v_new[None].astype(v_cache.dtype), (slot, start, 0, 0)
+    )
+    return k_cache, v_cache
+
+
+def write_kv_token(
+    k_cache: jax.Array,  # [S, C, H_kv, d]
+    v_cache: jax.Array,
+    positions: jax.Array,  # [S] int32 — write position per slot
+    k_new: jax.Array,  # [S, H_kv, d]
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    """Scatter one new token's K/V into every slot (decode step)."""
+    S = k_cache.shape[0]
+    slot_idx = jnp.arange(S)
+    k_cache = k_cache.at[slot_idx, positions].set(k_new.astype(k_cache.dtype))
+    v_cache = v_cache.at[slot_idx, positions].set(v_new.astype(v_cache.dtype))
+    return k_cache, v_cache

@@ -1,0 +1,38 @@
+"""Rotary position embeddings (RoPE), TPU-friendly formulation.
+
+No reference analogue (the reference delegates model execution to SaaS —
+SURVEY.md §0); this is part of the in-tree ``provider: tpu`` serving stack.
+
+Uses the split-half convention (rotate_half), matching HF Llama so weights
+load unmodified. Frequencies are computed on the fly from integer positions —
+cheap on the VPU, avoids carrying a [max_seq, d] table through jit.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
+    """Inverse frequencies [head_dim//2] (float32)."""
+    return 1.0 / (
+        theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim)
+    )
+
+
+def apply_rope(
+    x: jax.Array,  # [..., T, H, d]
+    positions: jax.Array,  # [..., T] int32
+    theta: float = 500000.0,
+) -> jax.Array:
+    """Rotate q or k by position. Computed in float32, cast back."""
+    d = x.shape[-1]
+    inv_freq = rope_frequencies(d, theta)  # [d/2]
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, d/2]
+    cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, d/2]
+    sin = jnp.sin(angles)[..., None, :]
+    x1 = x[..., : d // 2].astype(jnp.float32)
+    x2 = x[..., d // 2 :].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)

@@ -1,0 +1,50 @@
+"""Token sampling: greedy / temperature / top-k / top-p, batched per slot.
+
+Per-slot parameters (each sequence in the continuous batch can carry its own
+LLM object's sampling config, reference ``llm_types.go:41-71``): temperature
+== 0 means greedy. All math in float32.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample(
+    logits: jax.Array,  # [S, V] float32
+    rng: jax.Array,
+    temperature: jax.Array,  # [S]
+    top_k: jax.Array,  # [S] int32, 0 = disabled
+    top_p: jax.Array,  # [S] float32, 1.0 = disabled
+) -> jax.Array:
+    """Returns sampled token ids [S]."""
+    logits = logits.astype(jnp.float32)
+    S, V = logits.shape
+
+    # top-k mask: keep the k largest (k==0 -> keep all)
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]  # [S, V]
+    k = jnp.where(top_k > 0, top_k, V)
+    kth = jnp.take_along_axis(
+        sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
+    )  # [S, 1]
+    logits = jnp.where(logits < kth, NEG_INF, logits)
+
+    # top-p (nucleus) mask over the remaining distribution
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
+    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
+    # keep tokens while cumulative prob (exclusive) < top_p
+    keep_sorted = (cumprobs - probs_sorted) < top_p[:, None]
+    # threshold = smallest logit still kept
+    thresh = jnp.min(
+        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
+    )
+    logits = jnp.where(logits < thresh, NEG_INF, logits)
+
+    greedy = jnp.argmax(logits, axis=-1)
+    temp = jnp.maximum(temperature, 1e-6)[:, None]
+    sampled = jax.random.categorical(rng, logits / temp, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

@@ -1,0 +1,15 @@
+from .mesh import (
+    kv_cache_shardings,
+    kv_cache_specs,
+    make_mesh,
+    param_shardings,
+    param_specs,
+    replicated,
+    serving_mesh,
+)
+from .ring_attention import ring_causal_attention
+
+__all__ = [
+    "kv_cache_shardings", "kv_cache_specs", "make_mesh", "param_shardings",
+    "param_specs", "replicated", "serving_mesh", "ring_causal_attention",
+]

@@ -1,0 +1,107 @@
+"""Device meshes and named-sharding rules for the serving/training stack.
+
+Axes (scaling-book conventions):
+
+- ``dp`` — data parallel (batch)
+- ``sp`` — sequence parallel (ring attention over context chunks)
+- ``tp`` — tensor parallel (heads / ffn; allreduce rides ICI)
+
+Serving uses a 1-D ``('tp',)`` mesh on a v5e-8 (8B fits with bf16 weights
+sharded 8-way); training composes ``('dp','sp','tp')``. XLA inserts the
+collectives from the NamedShardings — no hand-written NCCL-style code, per
+the TPU-first design brief.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig
+
+
+def make_mesh(
+    axes: dict[str, int] | None = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """Build a mesh. ``axes`` maps axis name -> size; -1 means "all remaining
+    devices". Default: 1-D tp mesh over all local devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    axes = dict(axes or {"tp": len(devices)})
+    names = list(axes)
+    sizes = list(axes.values())
+    if -1 in sizes:
+        known = int(np.prod([s for s in sizes if s != -1]))
+        sizes[sizes.index(-1)] = len(devices) // known
+    total = int(np.prod(sizes))
+    if total > len(devices):
+        raise ValueError(f"mesh {dict(zip(names, sizes))} needs {total} devices, have {len(devices)}")
+    grid = np.asarray(devices[:total]).reshape(sizes)
+    return Mesh(grid, tuple(names))
+
+
+def serving_mesh(tensor_parallelism: int = 0) -> Mesh:
+    n = tensor_parallelism or len(jax.devices())
+    return make_mesh({"tp": n})
+
+
+# ---------------------------------------------------------------------------
+# Sharding rules
+# ---------------------------------------------------------------------------
+
+
+def param_specs(config: LlamaConfig) -> dict:
+    """PartitionSpecs for the params pytree (megatron-style TP):
+    attention qkv and ffn in-projections column-parallel, out-projections
+    row-parallel; embeddings sharded on vocab. Layer-stacked leaves carry a
+    leading (unsharded) layer axis."""
+    return {
+        "embed": P("tp", None),  # vocab-sharded
+        "norm": P(None),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "wq": P(None, None, "tp"),
+            "wk": P(None, None, "tp"),
+            "wv": P(None, None, "tp"),
+            "wo": P(None, "tp", None),
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        },
+        "lm_head": P(None, "tp"),  # vocab-sharded output
+    }
+
+
+def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
+    """NamedShardings matching the params pytree structure (drops lm_head for
+    tied-embedding configs)."""
+    specs = param_specs(config)
+    if "lm_head" not in params_like:
+        specs = dict(specs)
+        specs.pop("lm_head")
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def kv_cache_specs() -> dict:
+    """Slot cache [L, S, C, H_kv, d]: shard KV heads over tp."""
+    return {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
+
+
+def kv_cache_shardings(mesh: Mesh) -> dict:
+    return jax.tree_util.tree_map(
+        lambda spec: NamedSharding(mesh, spec),
+        kv_cache_specs(),
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())

@@ -1,0 +1,3 @@
+from .trainer import Trainer, cross_entropy_loss
+
+__all__ = ["Trainer", "cross_entropy_loss"]

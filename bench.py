@@ -1,0 +1,88 @@
+"""Headline benchmark: continuous-batching decode throughput per chip.
+
+Runs the serving engine (the ``provider: tpu`` data plane) on the real
+device(s): 64 concurrent requests continuously batched into one decode
+stream, Llama-3-family architecture sized to the available HBM
+(``bench-1b`` ~1.1B params bf16 on a single v5e chip; the 8B flagship
+needs the full v5e-8 and loads the same way).
+
+Prints ONE JSON line:
+  {"metric": "decode_tok_s_per_chip", "value": N, "unit": "tok/s/chip",
+   "vs_baseline": N/1000}
+vs_baseline is against BASELINE.md's >1,000 tok/s/chip north-star target.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> None:
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import serving_mesh
+
+    preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")
+    n_requests = int(os.environ.get("ACP_BENCH_REQUESTS", "64"))
+    max_tokens = int(os.environ.get("ACP_BENCH_MAX_TOKENS", "128"))
+    prompt_len = int(os.environ.get("ACP_BENCH_PROMPT_LEN", "128"))
+    max_ctx = int(os.environ.get("ACP_BENCH_MAX_CTX", "1024"))
+
+    n_chips = len(jax.devices())
+    config = PRESETS[preset]
+    engine = Engine(
+        config=config,
+        tokenizer=ByteTokenizer(),
+        mesh=serving_mesh(),
+        max_slots=n_requests,
+        max_ctx=max_ctx,
+        prefill_buckets=(prompt_len, max_ctx),
+        seed=0,
+    )
+    engine.start()
+
+    prompt = list(range(1, prompt_len))  # token ids, avoids tokenizer cost
+    sampling = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=max_tokens)
+
+    # warmup: compile prefill + decode
+    engine.generate(prompt[:prompt_len], SamplingParams(temperature=0.0, max_tokens=4))
+
+    t0 = time.monotonic()
+    steps0, toks0 = engine.decode_steps, engine.tokens_generated
+    futures = [engine.submit(list(prompt), sampling) for _ in range(n_requests)]
+    results = [f.result(timeout=1200) for f in futures]
+    elapsed = time.monotonic() - t0
+    engine.stop()
+
+    total_tokens = sum(len(r.tokens) for r in results)
+    tok_s = total_tokens / elapsed
+    tok_s_chip = tok_s / n_chips
+    ttfts = sorted(r.ttft_ms for r in results)
+    p50_ttft = ttfts[len(ttfts) // 2]
+
+    print(
+        json.dumps(
+            {
+                "metric": "decode_tok_s_per_chip",
+                "value": round(tok_s_chip, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(tok_s_chip / 1000.0, 3),
+            }
+        )
+    )
+    print(
+        f"# {total_tokens} tokens in {elapsed:.2f}s on {n_chips} chip(s) "
+        f"({preset}); total {tok_s:.0f} tok/s; p50 TTFT {p50_ttft:.0f} ms "
+        f"(includes queue wait at {n_requests}-deep burst)",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -10,11 +10,17 @@ import asyncio
 import inspect
 import os
 
-# Must be set before jax is imported anywhere.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before the jax backend initializes. NOTE: this environment's
+# axon harness overrides the JAX_PLATFORMS env var, so we must force the
+# platform through jax.config (which wins) — see .claude/skills/verify.
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax
+
+if not os.environ.get("ACP_TEST_TPU"):
+    jax.config.update("jax_platforms", "cpu")
 
 import pytest
 

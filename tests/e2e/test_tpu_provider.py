@@ -1,0 +1,112 @@
+"""provider: tpu end-to-end — the north-star slice at test scale.
+
+A real Operator where the LLM seam resolves to the in-process JAX engine
+(tiny model, tp=2 over the virtual CPU mesh): concurrent Task CRs are
+continuously batched into one decode stream and every task reaches
+FinalAnswer with engine-generated text. (Output quality is meaningless with
+random weights; the invariants are flow + batching + checkpointing.)
+"""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import LLM, BaseConfig, LLMSpec, TPUProviderConfig
+from agentcontrolplane_tpu.engine.engine import Engine
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.kernel import wait_for
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+from ..fixtures import make_agent, make_task, setup_with_status
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = dataclasses.replace(
+        PRESETS["tiny"], vocab_size=512, max_seq_len=512, n_kv_heads=2
+    )
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        mesh=mesh,
+        max_slots=8,
+        max_ctx=256,
+        prefill_buckets=(128, 256),
+    )
+    eng.start()
+    yield eng
+    eng.stop()
+
+
+async def test_concurrent_tasks_served_by_tpu_engine(engine):
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.02
+    store = op.store
+    setup_with_status(
+        store,
+        LLM(
+            metadata=ObjectMeta(name="tpu-llm"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="tiny", max_tokens=12, temperature=0.0),
+                tpu=TPUProviderConfig(preset="tiny"),
+            ),
+        ),
+        lambda o: (
+            setattr(o.status, "ready", True),
+            setattr(o.status, "status", "Ready"),
+        ),
+    )
+    make_agent(store, llm="tpu-llm", system="continue the text")
+    n = 8
+    for i in range(n):
+        make_task(store, name=f"tpu-task-{i}", user_message=f"prompt {i}")
+    await op.start()
+    try:
+        done = []
+        for i in range(n):
+            t = await wait_for(
+                store, "Task", f"tpu-task-{i}", "default",
+                lambda t: t.status.phase in ("FinalAnswer", "Failed"), timeout=120,
+            )
+            done.append(t)
+        assert all(t.status.phase == "FinalAnswer" for t in done)
+        # every conversation got an engine-produced assistant turn,
+        # checkpointed in status
+        for t in done:
+            assert [m.role for m in t.status.context_window] == ["system", "user", "assistant"]
+        # the engine actually batched: it generated tokens for all tasks
+        assert engine.tokens_generated >= n
+    finally:
+        await op.stop()
+
+
+async def test_llm_controller_validates_tpu_provider(engine):
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    store = op.store
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="bad-tpu"),
+            spec=LLMSpec(provider="tpu", parameters=BaseConfig()),  # no tpu block
+        )
+    )
+    await op.llm_reconciler.reconcile(("LLM", "default", "bad-tpu"))
+    llm = store.get("LLM", "bad-tpu")
+    assert llm.status.status == "Error"
+    assert "requires a tpu config" in llm.status.status_detail

@@ -1,0 +1,100 @@
+"""Tokenizer, chat template, and tool-call parsing tests."""
+
+from agentcontrolplane_tpu.api.resources import Message, MessageToolCall, ToolCallFunction
+from agentcontrolplane_tpu.engine.tokenizer import (
+    BOT,
+    EOT,
+    ByteTokenizer,
+    render_prompt,
+)
+from agentcontrolplane_tpu.engine.toolparse import parse_tool_calls, to_message
+from agentcontrolplane_tpu.llmclient.base import Tool, ToolFunction
+
+
+def test_byte_tokenizer_roundtrip():
+    tok = ByteTokenizer()
+    text = f"{BOT}hello wörld{EOT}"
+    ids = tok.encode(text)
+    assert tok.decode(ids) == text
+    assert ids[0] == 256  # BOT special, single token
+    assert tok.stop_tokens
+
+
+def test_render_prompt_basic():
+    msgs = [
+        Message(role="system", content="be brief"),
+        Message(role="user", content="hi"),
+    ]
+    prompt = render_prompt(msgs, [])
+    assert prompt.startswith(BOT)
+    assert "be brief" in prompt
+    assert prompt.endswith("<|start_header_id|>assistant<|end_header_id|>\n\n")
+
+
+def test_render_prompt_injects_tools_and_serializes_calls():
+    tools = [
+        Tool(function=ToolFunction(name="web__fetch", description="fetch a url"))
+    ]
+    msgs = [
+        Message(role="system", content="sys"),
+        Message(role="user", content="get example.com"),
+        Message(
+            role="assistant",
+            content="",
+            tool_calls=[
+                MessageToolCall(
+                    id="call_1",
+                    function=ToolCallFunction(
+                        name="web__fetch", arguments='{"url": "https://example.com"}'
+                    ),
+                )
+            ],
+        ),
+        Message(role="tool", content="<html></html>", tool_call_id="call_1"),
+    ]
+    prompt = render_prompt(msgs, tools)
+    assert "web__fetch" in prompt  # schema in system prompt
+    assert '"name": "web__fetch"' in prompt  # serialized call turn
+    assert "<|start_header_id|>ipython<|end_header_id|>" in prompt  # tool result turn
+
+
+def test_parse_whole_text_json():
+    calls = parse_tool_calls('{"name": "web__fetch", "arguments": {"url": "x"}}')
+    assert len(calls) == 1
+    assert calls[0].function.name == "web__fetch"
+    assert calls[0].function.arguments == '{"url": "x"}'
+
+
+def test_parse_with_preamble_and_fences():
+    text = 'Sure! I will fetch it:\n```json\n{"name": "web__fetch", "arguments": {"url": "x"}}\n```'
+    calls = parse_tool_calls(text)
+    assert len(calls) == 1
+    text2 = 'Let me call {"name": "a__b", "arguments": {}} now'
+    assert parse_tool_calls(text2)[0].function.name == "a__b"
+
+
+def test_parse_arguments_as_string():
+    calls = parse_tool_calls('{"name": "t__x", "arguments": "{\\"k\\": 1}"}')
+    assert calls[0].function.arguments == '{"k": 1}'
+
+
+def test_plain_text_is_not_a_tool_call():
+    assert parse_tool_calls("the answer is 42") == []
+    msg = to_message("the answer is 42")
+    assert msg.content == "the answer is 42" and not msg.tool_calls
+
+
+def test_unknown_tool_names_fall_back_to_content():
+    msg = to_message(
+        '{"name": "hallucinated__tool", "arguments": {}}', allowed_tools={"web__fetch"}
+    )
+    assert not msg.tool_calls  # hallucinated name doesn't break the state machine
+    assert "hallucinated__tool" in msg.content
+
+
+def test_tool_calls_beat_content():
+    msg = to_message(
+        'Here you go: {"name": "web__fetch", "arguments": {"url": "x"}}',
+        allowed_tools={"web__fetch"},
+    )
+    assert msg.tool_calls and msg.content == ""
