@@ -1,0 +1,27 @@
+# Dev workflow (the reference's Makefile orchestrates kind clusters and
+# kustomize deploys; standalone TPU-native operation needs only python).
+
+PY ?= python
+
+.PHONY: test test-unit test-e2e bench run lint dryrun
+
+test:
+	$(PY) -m pytest tests/ -x -q
+
+test-unit:
+	$(PY) -m pytest tests/ -x -q --ignore=tests/e2e
+
+test-e2e:
+	$(PY) -m pytest tests/e2e -x -q
+
+bench:
+	$(PY) bench.py
+
+dryrun:
+	$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
+
+run:
+	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db
+
+lint:
+	$(PY) -m compileall -q agentcontrolplane_tpu

@@ -1,0 +1,86 @@
+"""YAML/JSON manifest loading — the kubectl-apply equivalent.
+
+The reference's UX is ``kubectl apply -f`` against CRDs
+(``acp/config/samples/``); ours is the same declarative shape against the
+in-tree store, via the CLI (``acp-tpu apply -f``) or
+``POST /v1/apply``. Field names accept both snake_case and k8s-style
+camelCase (see api.meta.APIModel).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Iterable
+
+import yaml
+
+from ..kernel.errors import Invalid
+from .meta import ObjectMeta, Resource
+from .resources import KINDS
+
+
+def resource_from_manifest(doc: dict[str, Any]) -> Resource:
+    if not isinstance(doc, dict):
+        raise Invalid("manifest must be a mapping")
+    kind = doc.get("kind")
+    if not kind or kind not in KINDS:
+        raise Invalid(f"unknown kind {kind!r} (known: {sorted(KINDS)})")
+    meta = doc.get("metadata") or {}
+    if not meta.get("name"):
+        raise Invalid(f"{kind} manifest requires metadata.name")
+    body = {
+        "kind": kind,
+        "metadata": meta,
+        "spec": doc.get("spec") or {},
+    }
+    if doc.get("status") is not None:
+        body["status"] = doc["status"]
+    try:
+        return KINDS[kind].model_validate(body)
+    except Exception as e:
+        raise Invalid(f"invalid {kind} manifest: {e}") from e
+
+
+def load_manifests(text: str) -> list[Resource]:
+    """Parse a (multi-document) YAML string into resources."""
+    out: list[Resource] = []
+    for doc in yaml.safe_load_all(text):
+        if doc is None:
+            continue
+        if isinstance(doc, list):
+            out.extend(resource_from_manifest(d) for d in doc)
+        else:
+            out.append(resource_from_manifest(doc))
+    return out
+
+
+def apply_resources(store, resources: Iterable[Resource]) -> list[tuple[str, Resource]]:
+    """Create-or-update (kubectl apply semantics): spec and labels are taken
+    from the manifest; status and system metadata are preserved."""
+    results: list[tuple[str, Resource]] = []
+    for res in resources:
+        existing = store.try_get(res.kind, res.metadata.name, res.metadata.namespace)
+        if existing is None:
+            results.append(("created", store.create(res)))
+            continue
+        existing.spec = res.spec
+        existing.metadata.labels = dict(res.metadata.labels)
+        existing.metadata.annotations = dict(res.metadata.annotations)
+        results.append(("configured", store.update(existing)))
+    return results
+
+
+def resource_to_manifest(res: Resource, include_status: bool = True) -> dict[str, Any]:
+    doc: dict[str, Any] = {
+        "kind": res.kind,
+        "metadata": res.metadata.model_dump(exclude_none=True),
+        "spec": res.spec.model_dump(exclude_none=True) if hasattr(res, "spec") else {},
+    }
+    if include_status and hasattr(res, "status"):
+        doc["status"] = res.status.model_dump(exclude_none=True)
+    return doc
+
+
+def dump_manifests(resources: Iterable[Resource]) -> str:
+    return yaml.safe_dump_all(
+        [resource_to_manifest(r) for r in resources], sort_keys=False
+    )

@@ -15,9 +15,18 @@ import uuid
 from typing import Optional
 
 from pydantic import BaseModel, ConfigDict, Field
+from pydantic.alias_generators import to_camel
 
 
-class OwnerReference(BaseModel):
+class APIModel(BaseModel):
+    """Base for every API model: python code uses snake_case, while YAML/JSON
+    manifests may use k8s-style camelCase (``apiKeyFrom``) — both are
+    accepted on input; storage/serialization stays snake_case."""
+
+    model_config = ConfigDict(populate_by_name=True, alias_generator=to_camel)
+
+
+class OwnerReference(APIModel):
     """Reference to an owning object; owned objects are garbage-collected.
 
     Mirrors the reference's use of metav1.OwnerReference when a Task creates
@@ -30,9 +39,7 @@ class OwnerReference(BaseModel):
     controller: bool = True
 
 
-class ObjectMeta(BaseModel):
-    model_config = ConfigDict(populate_by_name=True)
-
+class ObjectMeta(APIModel):
     name: str
     namespace: str = "default"
     uid: str = Field(default_factory=lambda: uuid.uuid4().hex)
@@ -45,14 +52,12 @@ class ObjectMeta(BaseModel):
     deletion_timestamp: Optional[float] = None
 
 
-class Resource(BaseModel):
+class Resource(APIModel):
     """Base class for every API object (the reference's CRD equivalent).
 
     Subclasses set ``kind`` as a class-level default and define ``spec`` and
     ``status`` pydantic models.
     """
-
-    model_config = ConfigDict(populate_by_name=True)
 
     kind: str = ""
     metadata: ObjectMeta

@@ -25,21 +25,21 @@ from __future__ import annotations
 
 from typing import Any, Literal, Optional
 
-from pydantic import BaseModel, ConfigDict, Field
+from pydantic import Field
 
-from .meta import ObjectMeta, Resource, new_meta
+from .meta import APIModel, ObjectMeta, Resource, new_meta
 
 # ---------------------------------------------------------------------------
 # Shared message model (reference: task_types.go:56-97)
 # ---------------------------------------------------------------------------
 
 
-class ToolCallFunction(BaseModel):
+class ToolCallFunction(APIModel):
     name: str
     arguments: str = "{}"  # JSON-encoded arguments, as in OpenAI tool calls
 
 
-class MessageToolCall(BaseModel):
+class MessageToolCall(APIModel):
     id: str
     function: ToolCallFunction
     type: str = "function"
@@ -48,7 +48,7 @@ class MessageToolCall(BaseModel):
 Role = Literal["system", "user", "assistant", "tool"]
 
 
-class Message(BaseModel):
+class Message(APIModel):
     """One message of a context window (task_types.go:56-97)."""
 
     role: Role
@@ -58,7 +58,7 @@ class Message(BaseModel):
     name: Optional[str] = None
 
 
-class SpanContext(BaseModel):
+class SpanContext(APIModel):
     """Persisted trace root so one logical trace spans many reconciles
     (reference: task_types.go:99-106, task/state_machine.go:122-137)."""
 
@@ -71,7 +71,7 @@ class SpanContext(BaseModel):
 # ---------------------------------------------------------------------------
 
 
-class SecretSpec(BaseModel):
+class SecretSpec(APIModel):
     data: dict[str, str] = Field(default_factory=dict)
 
 
@@ -80,7 +80,7 @@ class Secret(Resource):
     spec: SecretSpec = Field(default_factory=SecretSpec)
 
 
-class SecretKeyRef(BaseModel):
+class SecretKeyRef(APIModel):
     """APIKeySource (llm_types.go:34-38) / env-from-secret (mcpserver_types.go:41-61)."""
 
     name: str
@@ -94,7 +94,7 @@ class SecretKeyRef(BaseModel):
 LLMProvider = Literal["openai", "anthropic", "mistral", "google", "vertex", "tpu", "mock"]
 
 
-class BaseConfig(BaseModel):
+class BaseConfig(APIModel):
     """Common sampling parameters (llm_types.go:41-71)."""
 
     model: str = ""
@@ -107,7 +107,7 @@ class BaseConfig(BaseModel):
     presence_penalty: Optional[float] = None
 
 
-class TPUProviderConfig(BaseModel):
+class TPUProviderConfig(APIModel):
     """In-tree TPU serving backend config (no reference analogue; north star).
 
     ``checkpoint`` is a local HF-format checkpoint directory (safetensors +
@@ -125,7 +125,7 @@ class TPUProviderConfig(BaseModel):
     quantization: Optional[Literal["int8"]] = None
 
 
-class LLMSpec(BaseModel):
+class LLMSpec(APIModel):
     provider: LLMProvider
     api_key_from: Optional[SecretKeyRef] = None
     parameters: BaseConfig = Field(default_factory=BaseConfig)
@@ -134,7 +134,7 @@ class LLMSpec(BaseModel):
     provider_config: dict[str, Any] = Field(default_factory=dict)
 
 
-class LLMStatus(BaseModel):
+class LLMStatus(APIModel):
     ready: bool = False
     status: Literal["", "Ready", "Error", "Pending"] = ""
     status_detail: str = ""
@@ -151,17 +151,17 @@ class LLM(Resource):
 # ---------------------------------------------------------------------------
 
 
-class SlackChannelConfig(BaseModel):
+class SlackChannelConfig(APIModel):
     channel_or_user_id: str = ""
     context_about_channel_or_user: str = ""
 
 
-class EmailChannelConfig(BaseModel):
+class EmailChannelConfig(APIModel):
     address: str = ""
     context_about_user: str = ""
 
 
-class ContactChannelSpec(BaseModel):
+class ContactChannelSpec(APIModel):
     type: Literal["slack", "email"]
     api_key_from: Optional[SecretKeyRef] = None
     channel_api_key_from: Optional[SecretKeyRef] = None
@@ -170,7 +170,7 @@ class ContactChannelSpec(BaseModel):
     email: Optional[EmailChannelConfig] = None
 
 
-class ContactChannelStatus(BaseModel):
+class ContactChannelStatus(APIModel):
     ready: bool = False
     status: Literal["", "Ready", "Error", "Pending"] = ""
     status_detail: str = ""
@@ -187,13 +187,13 @@ class ContactChannel(Resource):
 # ---------------------------------------------------------------------------
 
 
-class EnvVar(BaseModel):
+class EnvVar(APIModel):
     name: str
     value: Optional[str] = None
     value_from: Optional[SecretKeyRef] = None
 
 
-class MCPServerSpec(BaseModel):
+class MCPServerSpec(APIModel):
     transport: Literal["stdio", "http"]
     command: Optional[str] = None
     args: list[str] = Field(default_factory=list)
@@ -204,13 +204,13 @@ class MCPServerSpec(BaseModel):
     approval_contact_channel: Optional[str] = None
 
 
-class MCPTool(BaseModel):
+class MCPTool(APIModel):
     name: str
     description: str = ""
     input_schema: dict[str, Any] = Field(default_factory=dict)
 
 
-class MCPServerStatus(BaseModel):
+class MCPServerStatus(APIModel):
     connected: bool = False
     status: Literal["", "Ready", "Error", "Pending"] = ""
     status_detail: str = ""
@@ -228,11 +228,11 @@ class MCPServer(Resource):
 # ---------------------------------------------------------------------------
 
 
-class LocalObjectRef(BaseModel):
+class LocalObjectRef(APIModel):
     name: str
 
 
-class AgentSpec(BaseModel):
+class AgentSpec(APIModel):
     llm_ref: LocalObjectRef
     system: str
     description: str = ""  # used in the delegate-tool description
@@ -241,17 +241,17 @@ class AgentSpec(BaseModel):
     sub_agents: list[LocalObjectRef] = Field(default_factory=list)
 
 
-class ResolvedMCPServer(BaseModel):
+class ResolvedMCPServer(APIModel):
     name: str
     tools: list[str] = Field(default_factory=list)
 
 
-class ResolvedSubAgent(BaseModel):
+class ResolvedSubAgent(APIModel):
     name: str
     description: str = ""
 
 
-class AgentStatus(BaseModel):
+class AgentStatus(APIModel):
     """Caches *resolved* dependencies (agent_types.go:53-102)."""
 
     ready: bool = False
@@ -300,7 +300,7 @@ LABEL_AGENT = "acp.tpu/agent"
 LABEL_V1BETA3 = "acp.tpu/v1beta3"
 
 
-class TaskSpec(BaseModel):
+class TaskSpec(APIModel):
     agent_ref: LocalObjectRef
     # Exactly one of user_message / context_window (task_types.go:24-54).
     user_message: Optional[str] = None
@@ -310,7 +310,7 @@ class TaskSpec(BaseModel):
     thread_id: Optional[str] = None
 
 
-class TaskStatus(BaseModel):
+class TaskStatus(APIModel):
     phase: TaskPhase = ""
     status: Literal["", "Ready", "Error", "Pending"] = ""
     status_detail: str = ""
@@ -369,7 +369,7 @@ ToolCallPhase = Literal[
 ]
 
 
-class ToolCallSpec(BaseModel):
+class ToolCallSpec(APIModel):
     tool_call_id: str
     task_ref: LocalObjectRef
     tool_ref: LocalObjectRef  # name is "server__tool" / "delegate_to_agent__x" / channel tool
@@ -377,7 +377,7 @@ class ToolCallSpec(BaseModel):
     arguments: str = "{}"
 
 
-class ToolCallStatus(BaseModel):
+class ToolCallStatus(APIModel):
     phase: ToolCallPhase = ""
     status: Literal["", "Ready", "Error", "Pending", "Succeeded"] = ""
     status_detail: str = ""
@@ -400,7 +400,7 @@ class ToolCall(Resource):
 # ---------------------------------------------------------------------------
 
 
-class EventSpec(BaseModel):
+class EventSpec(APIModel):
     involved_kind: str = ""
     involved_name: str = ""
     involved_uid: str = ""
@@ -421,7 +421,7 @@ class Event(Resource):
 # ---------------------------------------------------------------------------
 
 
-class LeaseSpec(BaseModel):
+class LeaseSpec(APIModel):
     holder_identity: str = ""
     lease_duration_seconds: float = 30.0
     acquire_time: float = 0.0

@@ -1,0 +1,278 @@
+"""acp-tpu CLI: run the operator; kubectl-style resource management.
+
+The reference's operational surface is kubectl + Makefile/kind
+(``Makefile:36-100``, ``acp/config/samples``); standalone TPU-native
+operation replaces that with one binary:
+
+  acp-tpu run [--db state.db] [--port 8082] [--leader-elect]
+              [--tpu-preset llama3-8b | --tpu-checkpoint /path/to/hf]
+  acp-tpu apply -f manifests.yaml [--server URL]
+  acp-tpu get <Kind> [name] [-o yaml]
+  acp-tpu delete <Kind> <name>
+  acp-tpu events
+  acp-tpu approvals [approve|reject <call-id> [--comment ...]]
+  acp-tpu contacts [respond <call-id> <text>]
+  acp-tpu task create <agent> <message> [--follow]
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+DEFAULT_SERVER = os.environ.get("ACP_TPU_SERVER", "http://127.0.0.1:8082")
+
+
+def _client(args):
+    import httpx
+
+    return httpx.Client(base_url=args.server, timeout=30.0)
+
+
+def cmd_run(args) -> int:
+    from .operator import Operator, OperatorOptions
+    from .utils import setup_logging
+
+    setup_logging(os.environ.get("ACP_TPU_LOG_LEVEL", "INFO"))
+
+    engine = None
+    if args.tpu_preset or args.tpu_checkpoint:
+        from .engine.engine import Engine
+        from .engine.tokenizer import ByteTokenizer, HFTokenizer
+
+        if args.tpu_checkpoint:
+            from .engine.weights import load_safetensors_dir
+
+            params, config = load_safetensors_dir(args.tpu_checkpoint)
+            tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
+            tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
+            engine = Engine(config=config, params=params, tokenizer=tokenizer,
+                            max_slots=args.tpu_slots, max_ctx=args.tpu_ctx)
+        else:
+            engine = Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(),
+                            max_slots=args.tpu_slots, max_ctx=args.tpu_ctx)
+        engine.start()
+
+    options = OperatorOptions(
+        db_path=args.db,
+        identity=args.identity or f"acp-tpu-{os.getpid()}",
+        leader_election=args.leader_elect,
+        api_port=args.port,
+        engine=engine,
+    )
+
+    async def main():
+        op = Operator(options)
+        await op.start()
+        print(f"operator running; REST API on :{args.port}", flush=True)
+        try:
+            await asyncio.Event().wait()
+        except asyncio.CancelledError:
+            pass
+        finally:
+            await op.stop()
+            if engine is not None:
+                engine.stop()
+
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_apply(args) -> int:
+    with open(args.filename) as f:
+        text = f.read()
+    with _client(args) as http:
+        resp = http.post("/v1/apply", content=text)
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        for item in resp.json():
+            print(f"{item['kind'].lower()}/{item['name']} {item['action']}")
+    return 0
+
+
+def cmd_get(args) -> int:
+    import yaml
+
+    with _client(args) as http:
+        if args.name:
+            resp = http.get(f"/v1/resources/{args.kind}/{args.name}")
+            if resp.status_code != 200:
+                print(f"error: {resp.text}", file=sys.stderr)
+                return 1
+            docs = [resp.json()]
+        else:
+            resp = http.get(f"/v1/resources/{args.kind}")
+            if resp.status_code != 200:
+                print(f"error: {resp.text}", file=sys.stderr)
+                return 1
+            docs = resp.json()
+    if args.output == "yaml":
+        print(yaml.safe_dump_all(docs, sort_keys=False), end="")
+    else:
+        rows = [
+            (
+                d["metadata"]["name"],
+                (d.get("status") or {}).get("phase")
+                or (d.get("status") or {}).get("status", ""),
+                (d.get("status") or {}).get("status_detail", "")[:60],
+            )
+            for d in docs
+        ]
+        width = max([len(r[0]) for r in rows], default=4) + 2
+        print(f"{'NAME':<{width}}{'STATUS':<14}DETAIL")
+        for name, status, detail in rows:
+            print(f"{name:<{width}}{status:<14}{detail}")
+    return 0
+
+
+def cmd_delete(args) -> int:
+    with _client(args) as http:
+        resp = http.delete(f"/v1/resources/{args.kind}/{args.name}")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        print(f"{args.kind.lower()}/{args.name} deleted")
+    return 0
+
+
+def cmd_events(args) -> int:
+    with _client(args) as http:
+        resp = http.get("/v1/events")
+        for e in resp.json():
+            print(f"{e['type']:<8}{e['reason']:<28}{e['involved']:<36}{e['message']}")
+    return 0
+
+
+def cmd_approvals(args) -> int:
+    with _client(args) as http:
+        if args.action == "list" or args.action is None:
+            for a in http.get("/v1/approvals").json():
+                print(f"{a['callId']:<16}{a['fn']:<32}{json.dumps(a['kwargs'])[:60]}")
+            return 0
+        if not args.call_id:
+            print("error: approvals approve/reject requires a call-id", file=sys.stderr)
+            return 2
+        resp = http.post(
+            f"/v1/approvals/{args.call_id}/{args.action}",
+            params={"comment": args.comment or ""},
+        )
+        print(resp.json() if resp.status_code == 200 else resp.text)
+        return 0 if resp.status_code == 200 else 1
+
+
+def cmd_contacts(args) -> int:
+    with _client(args) as http:
+        if args.action == "list" or args.action is None:
+            for c in http.get("/v1/contacts").json():
+                print(f"{c['callId']:<16}{c['message'][:80]}")
+            return 0
+        if not args.call_id or args.text is None:
+            print("error: contacts respond requires <call-id> <text>", file=sys.stderr)
+            return 2
+        resp = http.post(
+            f"/v1/contacts/{args.call_id}/respond", json={"response": args.text}
+        )
+        print(resp.json() if resp.status_code == 200 else resp.text)
+        return 0 if resp.status_code == 200 else 1
+
+
+def cmd_task_create(args) -> int:
+    with _client(args) as http:
+        resp = http.post(
+            "/v1/tasks", json={"agentName": args.agent, "userMessage": args.message}
+        )
+        if resp.status_code != 201:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        task = resp.json()
+        print(f"task/{task['name']} created")
+        if not args.follow:
+            return 0
+        last_phase = ""
+        while True:
+            resp = http.get(f"/v1/tasks/{task['name']}")
+            if resp.status_code != 200:
+                print(f"error: {resp.text}", file=sys.stderr)
+                return 1
+            t = resp.json()
+            if t["phase"] != last_phase:
+                print(f"  phase: {t['phase']}  {t.get('statusDetail', '')}")
+                last_phase = t["phase"]
+            if t["phase"] in ("FinalAnswer", "Failed"):
+                print(t.get("output") or t.get("error", ""))
+                return 0 if t["phase"] == "FinalAnswer" else 1
+            time.sleep(0.5)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="acp-tpu", description=__doc__)
+    p.add_argument("--server", default=DEFAULT_SERVER, help="operator REST URL")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    run = sub.add_parser("run", help="run the operator")
+    run.add_argument("--db", default=None, help="sqlite state path (default: in-memory)")
+    run.add_argument("--port", type=int, default=8082)
+    run.add_argument("--identity", default=None)
+    run.add_argument("--leader-elect", action="store_true")
+    run.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
+    run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
+    run.add_argument("--tpu-slots", type=int, default=64)
+    run.add_argument("--tpu-ctx", type=int, default=2048)
+    run.set_defaults(fn=cmd_run)
+
+    ap = sub.add_parser("apply", help="apply manifests")
+    ap.add_argument("-f", "--filename", required=True)
+    ap.set_defaults(fn=cmd_apply)
+
+    get = sub.add_parser("get", help="get resources")
+    get.add_argument("kind")
+    get.add_argument("name", nargs="?")
+    get.add_argument("-o", "--output", choices=["table", "yaml"], default="table")
+    get.set_defaults(fn=cmd_get)
+
+    de = sub.add_parser("delete", help="delete a resource")
+    de.add_argument("kind")
+    de.add_argument("name")
+    de.set_defaults(fn=cmd_delete)
+
+    ev = sub.add_parser("events", help="execution history")
+    ev.set_defaults(fn=cmd_events)
+
+    apr = sub.add_parser("approvals", help="pending human approvals")
+    apr.add_argument("action", nargs="?", choices=["list", "approve", "reject"])
+    apr.add_argument("call_id", nargs="?")
+    apr.add_argument("--comment", default="")
+    apr.set_defaults(fn=cmd_approvals)
+
+    con = sub.add_parser("contacts", help="pending human contacts")
+    con.add_argument("action", nargs="?", choices=["list", "respond"])
+    con.add_argument("call_id", nargs="?")
+    con.add_argument("text", nargs="?")
+    con.set_defaults(fn=cmd_contacts)
+
+    task = sub.add_parser("task", help="task operations")
+    tsub = task.add_subparsers(dest="task_command", required=True)
+    tc = tsub.add_parser("create")
+    tc.add_argument("agent")
+    tc.add_argument("message")
+    tc.add_argument("--follow", action="store_true")
+    tc.set_defaults(fn=cmd_task_create)
+
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

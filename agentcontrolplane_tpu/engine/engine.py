@@ -115,8 +115,10 @@ class Engine:
         max_slots: int = 64,
         max_ctx: int = 2048,
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
+        decode_block_size: int = 8,
         seed: int = 0,
     ):
+        self.decode_block_size = max(1, decode_block_size)
         if isinstance(config, str):
             config = PRESETS[config]
         self.config = config
@@ -176,12 +178,28 @@ class Engine:
 
         self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
 
-        def decode_and_sample(params, cache, tokens, seq_lens, rng, temps, top_ks, top_ps):
-            cache, logits = decode_step(params, cache, tokens, seq_lens, config)
-            toks = sample(logits, rng, temps, top_ks, top_ps)
+        def decode_block(params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps):
+            """K decode steps in ONE dispatch (lax.scan), amortizing host
+            round trips — the tunnel/dispatch overhead dominates single-step
+            decode otherwise. Inactive slots neither advance nor write.
+            Returns the [K, S] token block; the host truncates each slot at
+            its first stop token."""
+
+            def step(carry, _):
+                cache, tokens, seq_lens, rng = carry
+                rng, sub = jax.random.split(rng)
+                cache, logits = decode_step(params, cache, tokens, seq_lens, config)
+                next_toks = sample(logits, sub, temps, top_ks, top_ps)
+                next_toks = jnp.where(active, next_toks, tokens)
+                seq_lens = seq_lens + active.astype(jnp.int32)
+                return (cache, next_toks, seq_lens, rng), next_toks
+
+            (cache, tokens, seq_lens, rng), toks = jax.lax.scan(
+                step, (cache, tokens, seq_lens, rng), None, length=self.decode_block_size
+            )
             return cache, toks
 
-        self._jit_decode = jax.jit(decode_and_sample, donate_argnums=(1,))
+        self._jit_decode = jax.jit(decode_block, donate_argnums=(1,))
 
     # -- public API ------------------------------------------------------
 
@@ -300,34 +318,43 @@ class Engine:
     def _decode_once(self) -> None:
         if not self._slots:
             return
+        active_mask = np.zeros(self.max_slots, dtype=bool)
+        for slot in self._slots:
+            active_mask[slot] = True
         self._rng, step_rng = jax.random.split(self._rng)
-        cache, toks = self._jit_decode(
+        cache, tok_block = self._jit_decode(
             self.params,
             self.cache,
             jnp.asarray(self._last_tokens),
             jnp.asarray(self._seq_lens),
+            jnp.asarray(active_mask),
             step_rng,
             jnp.asarray(self._temps),
             jnp.asarray(self._top_ks),
             jnp.asarray(self._top_ps),
         )
         self.cache = cache
-        toks = np.asarray(toks)
-        self.decode_steps += 1
+        tok_block = np.asarray(tok_block)  # [K, S]
+        K = tok_block.shape[0]
+        self.decode_steps += K
         active = list(self._slots.items())
-        self.tokens_generated += len(active)
         for slot, sl in active:
-            tok = int(toks[slot])
-            self._seq_lens[slot] += 1
-            self._last_tokens[slot] = tok
-            sl.generated.append(tok)
             s = sl.request.sampling
-            if tok in self.tokenizer.stop_tokens:
-                self._finish(slot, "stop")
-            elif len(sl.generated) >= s.max_tokens:
-                self._finish(slot, "length")
-            elif self._seq_lens[slot] + 1 >= self.max_ctx:
-                self._finish(slot, "length")
+            done = None
+            for k in range(K):
+                tok = int(tok_block[k, slot])
+                self._seq_lens[slot] += 1
+                self._last_tokens[slot] = tok
+                sl.generated.append(tok)
+                self.tokens_generated += 1
+                if tok in self.tokenizer.stop_tokens:
+                    done = "stop"
+                    break
+                if len(sl.generated) >= s.max_tokens or self._seq_lens[slot] + 1 >= self.max_ctx:
+                    done = "length"
+                    break
+            if done is not None:
+                self._finish(slot, done)
         REGISTRY.gauge_set(
             "acp_engine_active_slots", len(self._slots), help="occupied decode slots"
         )

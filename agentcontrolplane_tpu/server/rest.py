@@ -110,6 +110,10 @@ class RestServer:
         r.add_get("/v1/agents/{name}", self.get_agent)
         r.add_delete("/v1/agents/{name}", self.delete_agent)
         r.add_post("/v1/beta3/events", self.handle_v1beta3_event)
+        r.add_post("/v1/apply", self.apply_manifests)
+        r.add_get("/v1/resources/{kind}", self.list_resources)
+        r.add_get("/v1/resources/{kind}/{name}", self.get_resource)
+        r.add_delete("/v1/resources/{kind}/{name}", self.delete_resource)
         r.add_get("/v1/approvals", self.list_approvals)
         r.add_post("/v1/approvals/{call_id}/approve", self.approve)
         r.add_post("/v1/approvals/{call_id}/reject", self.reject)
@@ -380,6 +384,73 @@ class RestServer:
         created = self.store.create(task)
         return web.json_response({"taskName": created.name, "channel": channel_name}, status=201)
 
+    # -- generic resources (kubectl-equivalent; no single reference file,
+    #    spans the reference's kubectl+CRD UX) ----------------------------
+
+    async def apply_manifests(self, request: web.Request) -> web.Response:
+        from ..api.manifests import apply_resources, load_manifests
+
+        try:
+            resources = load_manifests((await request.read()).decode())
+        except Exception as e:  # yaml errors surface as Invalid-ish
+            return _json_error(400, str(e))
+        try:
+            results = apply_resources(self.store, resources)
+        except Invalid as e:
+            return _json_error(400, str(e))
+        except Exception as e:
+            return _json_error(500, f"apply failed: {e}")
+        return web.json_response(
+            [
+                {"kind": r.kind, "name": r.metadata.name, "action": action}
+                for action, r in results
+            ]
+        )
+
+    async def list_resources(self, request: web.Request) -> web.Response:
+        from ..api.manifests import resource_to_manifest
+        from ..api.resources import KINDS
+
+        kind = request.match_info["kind"]
+        if kind not in KINDS:
+            return _json_error(404, f"unknown kind {kind!r}")
+        ns = request.query.get("namespace", "default")
+        selector = None
+        if request.query.get("labelSelector"):
+            selector = dict(
+                part.split("=", 1)
+                for part in request.query["labelSelector"].split(",")
+                if "=" in part
+            )
+        objs = self.store.list(kind, ns, label_selector=selector)
+        return web.json_response([resource_to_manifest(o) for o in objs])
+
+    async def get_resource(self, request: web.Request) -> web.Response:
+        from ..api.manifests import resource_to_manifest
+        from ..api.resources import KINDS
+
+        kind = request.match_info["kind"]
+        if kind not in KINDS:
+            return _json_error(404, f"unknown kind {kind!r}")
+        ns = request.query.get("namespace", "default")
+        obj = self.store.try_get(kind, request.match_info["name"], ns)
+        if obj is None:
+            return _json_error(404, "not found")
+        return web.json_response(resource_to_manifest(obj))
+
+    async def delete_resource(self, request: web.Request) -> web.Response:
+        from ..api.resources import KINDS
+
+        kind = request.match_info["kind"]
+        if kind not in KINDS:
+            return _json_error(404, f"unknown kind {kind!r}")
+        ns = request.query.get("namespace", "default")
+        try:
+            self.store.delete(kind, request.match_info["name"], ns)
+        except NotFound:
+            return _json_error(404, "not found")
+        return web.json_response({"deleted": request.match_info["name"]})
+
     # -- in-tree human interaction (no reference analogue) ----------------
 
     async def list_approvals(self, request: web.Request) -> web.Response:
@@ -430,8 +501,8 @@ class RestServer:
             body = json.loads(await request.read())
         except json.JSONDecodeError as e:
             return _json_error(400, str(e))
-        if "response" not in body:
-            return _json_error(400, "response is required")
+        if not isinstance(body.get("response"), str):
+            return _json_error(400, "response (string) is required")
         b.respond(call_id, body["response"])
         return web.json_response({"callId": call_id})
 

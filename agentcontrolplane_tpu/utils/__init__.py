@@ -1,0 +1,3 @@
+from .logging import setup_logging
+
+__all__ = ["setup_logging"]

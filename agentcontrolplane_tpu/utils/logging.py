@@ -1,0 +1,28 @@
+"""Structured logging setup (the reference uses zap with V-levels,
+``cmd/main.go:96-117``; ours is stdlib logging with a key=value formatter)."""
+
+from __future__ import annotations
+
+import logging
+import sys
+import time
+
+
+class KVFormatter(logging.Formatter):
+    def format(self, record: logging.LogRecord) -> str:
+        ts = time.strftime("%H:%M:%S", time.localtime(record.created))
+        base = f"{ts} {record.levelname:<7} {record.name}: {record.getMessage()}"
+        if record.exc_info:
+            base += "\n" + self.formatException(record.exc_info)
+        return base
+
+
+def setup_logging(level: str = "INFO") -> None:
+    handler = logging.StreamHandler(sys.stderr)
+    handler.setFormatter(KVFormatter())
+    root = logging.getLogger()
+    root.handlers[:] = [handler]
+    root.setLevel(level.upper())
+    # quiet noisy third parties
+    for noisy in ("httpx", "aiohttp", "jax"):
+        logging.getLogger(noisy).setLevel(logging.WARNING)

@@ -1,0 +1,145 @@
+"""Manifest loading, /v1/apply + generic resource endpoints, CLI commands."""
+
+import pytest
+
+from agentcontrolplane_tpu.api.manifests import (
+    apply_resources,
+    dump_manifests,
+    load_manifests,
+    resource_from_manifest,
+)
+from agentcontrolplane_tpu.kernel.errors import Invalid
+
+from .test_rest import RestHarness
+
+GETTING_STARTED = open("examples/getting-started.yaml").read()
+
+
+def test_load_manifests_camel_case(store):
+    resources = load_manifests(GETTING_STARTED)
+    kinds = [r.kind for r in resources]
+    assert kinds == ["Secret", "LLM", "Agent", "Task"]
+    llm = resources[1]
+    assert llm.spec.api_key_from.name == "openai-key"
+    task = resources[3]
+    assert task.spec.agent_ref.name == "my-assistant"
+
+
+def test_apply_create_then_configure(store):
+    resources = load_manifests(GETTING_STARTED)
+    results = apply_resources(store, resources)
+    assert [a for a, _ in results] == ["created"] * 4
+
+    # mutate a spec and set some status to prove status survives re-apply
+    llm = store.get("LLM", "gpt-4o")
+    llm.status.ready = True
+    llm.status.status = "Ready"
+    store.update_status(llm)
+
+    text = GETTING_STARTED.replace("model: gpt-4o", "model: gpt-4o-mini")
+    results = apply_resources(store, load_manifests(text))
+    assert [a for a, _ in results] == ["configured"] * 4
+    llm = store.get("LLM", "gpt-4o")
+    assert llm.spec.parameters.model == "gpt-4o-mini"
+    assert llm.status.ready  # status preserved by apply
+
+
+def test_manifest_validation_errors(store):
+    with pytest.raises(Invalid, match="unknown kind"):
+        resource_from_manifest({"kind": "Nope", "metadata": {"name": "x"}})
+    with pytest.raises(Invalid, match="metadata.name"):
+        resource_from_manifest({"kind": "Task", "metadata": {}})
+    with pytest.raises(Invalid, match="invalid Task"):
+        resource_from_manifest({"kind": "Task", "metadata": {"name": "t"}, "spec": {}})
+
+
+def test_dump_roundtrip(store):
+    resources = load_manifests(GETTING_STARTED)
+    text = dump_manifests(resources)
+    again = load_manifests(text)
+    assert [r.metadata.name for r in again] == [r.metadata.name for r in resources]
+
+
+async def test_apply_endpoint_and_generic_resources():
+    async with RestHarness() as h:
+        resp = await h.http.post(f"{h.base}/v1/apply", data=GETTING_STARTED)
+        assert resp.status == 200
+        actions = await resp.json()
+        assert {(a["kind"], a["action"]) for a in actions} == {
+            ("Secret", "created"), ("LLM", "created"),
+            ("Agent", "created"), ("Task", "created"),
+        }
+        resp = await h.http.get(f"{h.base}/v1/resources/Agent/my-assistant")
+        body = await resp.json()
+        assert body["spec"]["llm_ref"]["name"] == "gpt-4o"
+
+        resp = await h.http.get(f"{h.base}/v1/resources/Task?labelSelector=acp.tpu/agent=x")
+        assert await resp.json() == []  # selector filters
+
+        resp = await h.http.delete(f"{h.base}/v1/resources/Task/hello-world-1")
+        assert resp.status == 200
+        resp = await h.http.get(f"{h.base}/v1/resources/Task/hello-world-1")
+        assert resp.status == 404
+
+        resp = await h.http.post(f"{h.base}/v1/apply", data="kind: Nope\nmetadata: {name: x}")
+        assert resp.status == 400
+
+
+def test_cli_get_apply_against_live_server(tmp_path):
+    """Drive the CLI main() against a live operator REST server."""
+    import asyncio
+    import threading
+
+    from agentcontrolplane_tpu.cli import main as cli_main
+    from agentcontrolplane_tpu.llmclient import MockLLMClient, MockLLMClientFactory, assistant
+    from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+    started = threading.Event()
+    stop = None
+    port = {}
+
+    def server_thread():
+        nonlocal stop
+
+        async def run():
+            nonlocal stop
+            mock = MockLLMClient(script=[assistant("Paris")])
+            op = Operator(
+                options=OperatorOptions(enable_rest=True, api_port=0, llm_probe=False,
+                                        verify_channel_credentials=False),
+                llm_factory=MockLLMClientFactory(mock),
+            )
+            op.task_reconciler.requeue_delay = 0.02
+            await op.start()
+            while not op.rest_server.bound_port:
+                await asyncio.sleep(0.01)
+            port["p"] = op.rest_server.bound_port
+            stop = asyncio.Event()
+            started.set()
+            await stop.wait()
+            await op.stop()
+
+        loop = asyncio.new_event_loop()
+        threads_loop["loop"] = loop
+        loop.run_until_complete(run())
+
+    threads_loop = {}
+    t = threading.Thread(target=server_thread, daemon=True)
+    t.start()
+    assert started.wait(10)
+    server = f"http://127.0.0.1:{port['p']}"
+
+    manifest = tmp_path / "m.yaml"
+    manifest.write_text(GETTING_STARTED)
+    assert cli_main(["--server", server, "apply", "-f", str(manifest)]) == 0
+    assert cli_main(["--server", server, "get", "Agent"]) == 0
+    assert cli_main(["--server", server, "get", "LLM", "gpt-4o", "-o", "yaml"]) == 0
+    # the scripted mock answers the task created by `task create --follow`
+    assert (
+        cli_main(["--server", server, "task", "create", "my-assistant", "hi", "--follow"]) == 0
+    )
+    assert cli_main(["--server", server, "events"]) == 0
+    assert cli_main(["--server", server, "delete", "Task", "hello-world-1"]) == 0
+
+    threads_loop["loop"].call_soon_threadsafe(stop.set)
+    t.join(timeout=10)
