@@ -116,9 +116,16 @@ class Engine:
         max_ctx: int = 2048,
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
         decode_block_size: int = 8,
+        kv_layout: str = "slot",  # "slot" | "paged"
+        page_size: int = 16,
+        kv_pages: int = 0,  # paged: total pages (0 = slot-equivalent capacity)
         seed: int = 0,
     ):
         self.decode_block_size = max(1, decode_block_size)
+        if kv_layout not in ("slot", "paged"):
+            raise ValueError(f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
+        self.kv_layout = kv_layout
+        self.page_size = page_size
         if isinstance(config, str):
             config = PRESETS[config]
         self.config = config
@@ -140,11 +147,51 @@ class Engine:
                 lambda k: _init(config, k), out_shardings=shardings
             )(jax.random.key(seed))
         self.params = params
-        cache_shardings = kv_cache_shardings(self.mesh)
-        self.cache = jax.jit(
-            lambda: init_kv_cache(config, max_slots, self.max_ctx),
-            out_shardings=cache_shardings,
-        )()
+        if self.kv_layout == "slot":
+            cache_shardings = kv_cache_shardings(self.mesh)
+            self.cache = jax.jit(
+                lambda: init_kv_cache(config, max_slots, self.max_ctx),
+                out_shardings=cache_shardings,
+            )()
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..models.llama import init_paged_cache
+            from ..ops.paged import PageAllocator
+
+            if self.max_ctx % self.page_size:
+                raise ValueError(
+                    f"page_size {self.page_size} must divide max_ctx {self.max_ctx}"
+                )
+            bad = [b for b in self.prefill_buckets if b % self.page_size]
+            if bad:
+                raise ValueError(
+                    f"prefill buckets {bad} are not multiples of page_size {self.page_size}"
+                )
+            self.max_pages_per_seq = self.max_ctx // self.page_size
+            self.num_pages = kv_pages or (max_slots * self.max_pages_per_seq + 1)
+            page_shardings = {
+                "k": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
+                "v": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
+            }
+            self.cache = jax.jit(
+                lambda: init_paged_cache(config, self.num_pages, self.page_size),
+                out_shardings=page_shardings,
+            )()
+            self._allocator = PageAllocator(self.num_pages)
+            self._slot_pages: dict[int, list[int]] = {}
+            from ..ops.paged import TRASH_PAGE
+
+            self._block_tables = np.full(
+                (max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
+            )
+            # Compiled pallas path only on a real TPU with tp=1: with tp>1
+            # the kernel needs a shard_map wrapper over the head-sharded
+            # pages (GSPMD treats pallas_call as opaque) — until that lands,
+            # tp>1 uses the exact XLA reference path. CPU always uses the
+            # reference (interpret-mode kernel equivalence is in tests).
+            tp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
+            self._use_pallas = jax.default_backend() == "tpu" and tp_size == 1
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
         self._rng = jax.random.key(seed)
@@ -167,39 +214,63 @@ class Engine:
     # -- jitted programs -------------------------------------------------
 
     def _build_jitted(self):
+        """Two jitted programs per layout: prefill+first-sample, and the
+        K-step decode block (one dispatch advances all slots K tokens,
+        amortizing host/tunnel round trips; inactive slots neither advance
+        nor write; the host truncates each slot's [K] tokens at its first
+        stop token). The block builder is shared across layouts — only the
+        per-step cache update differs."""
         config = self.config
 
-        def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p):
-            cache, logits = prefill(params, cache, tokens, length, slot, config)
-            tok = sample(
-                logits[None], rng, temp[None], top_k[None], top_p[None]
-            )[0]
-            return cache, tok
+        def sample_first(logits, rng, temp, top_k, top_p):
+            return sample(logits[None], rng, temp[None], top_k[None], top_p[None])[0]
 
-        self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
+        def make_decode_block(step_fn):
+            def decode_block(params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps, *extra):
+                def step(carry, _):
+                    cache, tokens, seq_lens, rng = carry
+                    rng, sub = jax.random.split(rng)
+                    cache, logits = step_fn(params, cache, tokens, seq_lens, active, *extra)
+                    next_toks = sample(logits, sub, temps, top_ks, top_ps)
+                    next_toks = jnp.where(active, next_toks, tokens)
+                    seq_lens = seq_lens + active.astype(jnp.int32)
+                    return (cache, next_toks, seq_lens, rng), next_toks
 
-        def decode_block(params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps):
-            """K decode steps in ONE dispatch (lax.scan), amortizing host
-            round trips — the tunnel/dispatch overhead dominates single-step
-            decode otherwise. Inactive slots neither advance nor write.
-            Returns the [K, S] token block; the host truncates each slot at
-            its first stop token."""
+                (cache, tokens, seq_lens, rng), toks = jax.lax.scan(
+                    step, (cache, tokens, seq_lens, rng), None, length=self.decode_block_size
+                )
+                return cache, toks
 
-            def step(carry, _):
-                cache, tokens, seq_lens, rng = carry
-                rng, sub = jax.random.split(rng)
-                cache, logits = decode_step(params, cache, tokens, seq_lens, config)
-                next_toks = sample(logits, sub, temps, top_ks, top_ps)
-                next_toks = jnp.where(active, next_toks, tokens)
-                seq_lens = seq_lens + active.astype(jnp.int32)
-                return (cache, next_toks, seq_lens, rng), next_toks
+            return jax.jit(decode_block, donate_argnums=(1,))
 
-            (cache, tokens, seq_lens, rng), toks = jax.lax.scan(
-                step, (cache, tokens, seq_lens, rng), None, length=self.decode_block_size
+        if self.kv_layout == "paged":
+            from ..models.llama import decode_step_paged, prefill_paged
+
+            use_pallas = self._use_pallas
+
+            def prefill_and_sample(params, pages, tokens, length, page_ids, rng, temp, top_k, top_p):
+                pages, logits = prefill_paged(params, pages, tokens, length, page_ids, config)
+                return pages, sample_first(logits, rng, temp, top_k, top_p)
+
+            self._jit_prefill_paged = jax.jit(prefill_and_sample, donate_argnums=(1,))
+            self._jit_decode_paged = make_decode_block(
+                lambda params, pages, tokens, seq_lens, active, block_tables: decode_step_paged(
+                    params, pages, tokens, seq_lens, block_tables, active, config,
+                    use_pallas=use_pallas,
+                )
             )
-            return cache, toks
+        else:
 
-        self._jit_decode = jax.jit(decode_block, donate_argnums=(1,))
+            def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p):
+                cache, logits = prefill(params, cache, tokens, length, slot, config)
+                return cache, sample_first(logits, rng, temp, top_k, top_p)
+
+            self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
+            self._jit_decode = make_decode_block(
+                lambda params, cache, tokens, seq_lens, active: decode_step(
+                    params, cache, tokens, seq_lens, config
+                )
+            )
 
     # -- public API ------------------------------------------------------
 
@@ -276,28 +347,67 @@ class Engine:
                 self._stopping = True
                 return admitted
             slot = self._free.pop()
-            self._prefill_into(slot, req)
+            if not self._prefill_into(slot, req):
+                break  # out of KV pages; retry after some slot finishes
             admitted = True
         return admitted
 
-    def _prefill_into(self, slot: int, req: _Request) -> None:
+    def _prefill_into(self, slot: int, req: _Request) -> bool:
         plen = len(req.prompt)
         bucket = _next_bucket(plen, self.prefill_buckets)
         tokens = np.zeros(bucket, dtype=np.int32)
         tokens[:plen] = req.prompt
         self._rng, step_rng = jax.random.split(self._rng)
         s = req.sampling
-        cache, first = self._jit_prefill(
-            self.params,
-            self.cache,
-            jnp.asarray(tokens),
-            jnp.int32(plen),
-            jnp.int32(slot),
-            step_rng,
-            jnp.float32(s.temperature),
-            jnp.int32(s.top_k),
-            jnp.float32(s.top_p),
-        )
+        if self.kv_layout == "paged":
+            from ..ops.paged import TRASH_PAGE
+
+            n_pages = -(-plen // self.page_size)
+            if n_pages > self._allocator.num_pages - 1:
+                # bigger than the entire pool: requeueing would spin forever
+                self._free.append(slot)
+                req.future.set_exception(
+                    RuntimeError(
+                        f"prompt needs {n_pages} KV pages but the pool has "
+                        f"{self._allocator.num_pages - 1}"
+                    )
+                )
+                return True  # slot is free again; keep admitting others
+            try:
+                pages = self._allocator.alloc(n_pages)
+            except MemoryError:
+                # out of KV pages: requeue and retry once slots free pages
+                self._free.append(slot)
+                self._queue.put(req)
+                return False
+            self._slot_pages[slot] = pages
+            self._block_tables[slot, :] = TRASH_PAGE
+            self._block_tables[slot, :n_pages] = pages
+            page_ids = np.full(bucket // self.page_size, TRASH_PAGE, dtype=np.int32)
+            page_ids[:n_pages] = pages
+            cache, first = self._jit_prefill_paged(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.int32(plen),
+                jnp.asarray(page_ids),
+                step_rng,
+                jnp.float32(s.temperature),
+                jnp.int32(s.top_k),
+                jnp.float32(s.top_p),
+            )
+        else:
+            cache, first = self._jit_prefill(
+                self.params,
+                self.cache,
+                jnp.asarray(tokens),
+                jnp.int32(plen),
+                jnp.int32(slot),
+                step_rng,
+                jnp.float32(s.temperature),
+                jnp.int32(s.top_k),
+                jnp.float32(s.top_p),
+            )
         self.cache = cache
         first_tok = int(first)
         now = time.monotonic()
@@ -314,25 +424,77 @@ class Engine:
         )
         if first_tok in self.tokenizer.stop_tokens or s.max_tokens <= 1:
             self._finish(slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length")
+        return True
+
+    def _ensure_pages_for_block(self) -> None:
+        """Paged mode: every active slot's table must cover the next K
+        tokens before dispatch; slots we can't cover are preempted (finished
+        at current length) — admission backpressure frees their pages."""
+        K = self.decode_block_size
+        for slot in list(self._slots):
+            needed = -(-(int(self._seq_lens[slot]) + K + 1) // self.page_size)
+            if needed > self.max_pages_per_seq:
+                # can't guarantee K in-bounds steps: finishing here keeps the
+                # kernel's page walk inside the block table
+                self._finish(slot, "length")
+                continue
+            have = len(self._slot_pages.get(slot, []))
+            if needed <= have:
+                continue
+            try:
+                new_pages = self._allocator.alloc(needed - have)
+            except MemoryError:
+                self._finish(slot, "length")  # preempted: KV pool exhausted
+                continue
+            table = self._slot_pages[slot]
+            self._block_tables[slot, have : have + len(new_pages)] = new_pages
+            table.extend(new_pages)
 
     def _decode_once(self) -> None:
         if not self._slots:
             return
+        K = self.decode_block_size
+        # Pre-finish slots that can't take K more tokens in-bounds: the block
+        # runs unconditionally on device, and paged page walks must never
+        # step past the block table (slot mode merely clamps harmlessly).
+        for slot in list(self._slots):
+            if int(self._seq_lens[slot]) + K + 1 > self.max_ctx:
+                self._finish(slot, "length")
+        if not self._slots:
+            return
+        if self.kv_layout == "paged":
+            self._ensure_pages_for_block()
+            if not self._slots:
+                return
         active_mask = np.zeros(self.max_slots, dtype=bool)
         for slot in self._slots:
             active_mask[slot] = True
         self._rng, step_rng = jax.random.split(self._rng)
-        cache, tok_block = self._jit_decode(
-            self.params,
-            self.cache,
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._seq_lens),
-            jnp.asarray(active_mask),
-            step_rng,
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks),
-            jnp.asarray(self._top_ps),
-        )
+        if self.kv_layout == "paged":
+            cache, tok_block = self._jit_decode_paged(
+                self.params,
+                self.cache,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._seq_lens),
+                jnp.asarray(active_mask),
+                step_rng,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps),
+                jnp.asarray(self._block_tables),
+            )
+        else:
+            cache, tok_block = self._jit_decode(
+                self.params,
+                self.cache,
+                jnp.asarray(self._last_tokens),
+                jnp.asarray(self._seq_lens),
+                jnp.asarray(active_mask),
+                step_rng,
+                jnp.asarray(self._temps),
+                jnp.asarray(self._top_ks),
+                jnp.asarray(self._top_ps),
+            )
         self.cache = cache
         tok_block = np.asarray(tok_block)  # [K, S]
         K = tok_block.shape[0]
@@ -364,6 +526,11 @@ class Engine:
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._free.append(slot)
+        if self.kv_layout == "paged":
+            from ..ops.paged import TRASH_PAGE
+
+            self._allocator.free(self._slot_pages.pop(slot, []))
+            self._block_tables[slot, :] = TRASH_PAGE
         gen = sl.generated
         if gen and gen[-1] in self.tokenizer.stop_tokens:
             gen = gen[:-1]

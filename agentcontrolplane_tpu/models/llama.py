@@ -259,6 +259,99 @@ def prefill(
     return {"k": new_k, "v": new_v}, logits
 
 
+# ---------------------------------------------------------------------------
+# Serving: paged KV cache (page tables; ops.paged + ops.pallas)
+# ---------------------------------------------------------------------------
+
+
+def init_paged_cache(config: LlamaConfig, num_pages: int, page_size: int) -> dict:
+    from ..ops.paged import init_kv_pages
+
+    return init_kv_pages(
+        config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim, config.dtype
+    )
+
+
+def prefill_paged(
+    params: dict,
+    pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
+    tokens: jax.Array,  # [T] int32 (padded to a multiple of page_size)
+    length: jax.Array,  # scalar int32
+    page_ids: jax.Array,  # [T // P] int32 (TRASH_PAGE beyond the prompt)
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Prompt forward writing K/V into this sequence's pages."""
+    from ..ops.paged import write_prompt_to_pages
+
+    c = config
+    T = tokens.shape[0]
+    positions = jnp.where(jnp.arange(T) < length, jnp.arange(T), -1)[None]
+    x = params["embed"][tokens][None].astype(c.dtype)
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_pages_l, v_pages_l = scanned
+        out, k, v = _attn_mlp(
+            x, layer, c, positions,
+            lambda q, k, v: causal_attention(q, k, v, positions),
+        )
+        k_pages_l, v_pages_l = write_prompt_to_pages(k_pages_l, v_pages_l, page_ids, k[0], v[0])
+        return out, (k_pages_l, v_pages_l)
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    last = x[0, length - 1]
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits
+
+
+def decode_step_paged(
+    params: dict,
+    pages: dict,
+    tokens: jax.Array,  # [S] int32
+    seq_lens: jax.Array,  # [S] int32 (length before this token)
+    block_tables: jax.Array,  # [S, max_pages] int32
+    active: jax.Array,  # [S] bool
+    config: LlamaConfig,
+    use_pallas: bool = False,
+) -> tuple[dict, jax.Array]:
+    """One decode step for all slots against the paged cache."""
+    from ..ops.paged import paged_decode_attention_reference, write_token_to_pages
+
+    c = config
+    positions = seq_lens[:, None]
+    x = params["embed"][tokens][:, None].astype(c.dtype)
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_pages_l, v_pages_l = scanned
+
+        def attn(q, k, v):
+            k_l, v_l = write_token_to_pages(
+                k_pages_l, v_pages_l, block_tables, seq_lens, active, k[:, 0], v[:, 0]
+            )
+            if use_pallas:
+                from ..ops.pallas.paged_attention import paged_decode_attention
+
+                out = paged_decode_attention(q[:, 0], k_l, v_l, block_tables, seq_lens + 1)
+            else:
+                out = paged_decode_attention_reference(
+                    q[:, 0], k_l, v_l, block_tables, seq_lens + 1
+                )
+            attn.updated = (k_l, v_l)
+            return out[:, None]
+
+        out, _, _ = _attn_mlp(x, layer, c, positions, attn)
+        return out, attn.updated
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    x = rms_norm(x[:, 0], params["norm"], c.norm_eps)
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits
+
+
 def decode_step(
     params: dict,
     cache: dict,

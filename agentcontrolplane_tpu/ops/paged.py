@@ -1,0 +1,112 @@
+"""Paged KV cache: page-table layout + pure-XLA reference ops.
+
+The north star calls for a paged KV cache: KV lives in fixed-size pages
+``[num_pages, page_size, H_kv, d]`` and each sequence owns a page list
+(block table), so HBM is allocated page-granular instead of
+max-context-granular — at 64 slots x 8k max context the slot layout wastes
+whatever contexts don't use, the paged layout doesn't.
+
+This module is the *reference* implementation (pure jnp gather/scatter,
+exact); ``ops.pallas.paged_attention`` is the TPU kernel that walks block
+tables with HBM->VMEM DMAs instead of materializing gathers. Page 0 is
+reserved as the trash page: padded writes land there, nothing reads it.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .attention import NEG_INF, repeat_kv
+
+TRASH_PAGE = 0
+
+
+def init_kv_pages(
+    n_layers: int, num_pages: int, page_size: int, n_kv_heads: int, head_dim: int, dtype
+) -> dict:
+    shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+    return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
+
+
+def write_prompt_to_pages(
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] (one layer)
+    v_pages: jax.Array,
+    page_ids: jax.Array,  # [max_prompt_pages] int32 — TRASH_PAGE beyond prompt
+    k_new: jax.Array,  # [T, H_kv, d], T = max_prompt_pages * P (padded)
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    P = k_pages.shape[1]
+    T = k_new.shape[0]
+    k_blocks = k_new.reshape(T // P, P, *k_new.shape[1:]).astype(k_pages.dtype)
+    v_blocks = v_new.reshape(T // P, P, *v_new.shape[1:]).astype(v_pages.dtype)
+    return k_pages.at[page_ids].set(k_blocks), v_pages.at[page_ids].set(v_blocks)
+
+
+def write_token_to_pages(
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [S, max_pages] int32
+    positions: jax.Array,  # [S] int32 — token position per slot
+    active: jax.Array,  # [S] bool — inactive slots write to the trash page
+    k_new: jax.Array,  # [S, H_kv, d]
+    v_new: jax.Array,
+) -> tuple[jax.Array, jax.Array]:
+    P = k_pages.shape[1]
+    S = positions.shape[0]
+    page_idx = positions // P
+    offset = positions % P
+    pages = block_tables[jnp.arange(S), page_idx]
+    pages = jnp.where(active, pages, TRASH_PAGE)
+    k_pages = k_pages.at[pages, offset].set(k_new.astype(k_pages.dtype))
+    v_pages = v_pages.at[pages, offset].set(v_new.astype(v_pages.dtype))
+    return k_pages, v_pages
+
+
+def paged_decode_attention_reference(
+    q: jax.Array,  # [S, H, d] — one new token per slot
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [S, max_pages]
+    seq_lens: jax.Array,  # [S] — valid tokens per slot (incl. the new one)
+) -> jax.Array:
+    """Exact paged attention by materializing each slot's pages (gather).
+    O(S * max_pages * P) HBM traffic + a gathered copy — the thing the
+    Pallas kernel avoids."""
+    S, H, d = q.shape
+    num_pages, P, H_kv, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    k = k_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
+    v = v_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
+    n_rep = H // H_kv
+    k = repeat_kv(k, n_rep)
+    v = repeat_kv(v, n_rep)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) * scale
+    mask = jnp.arange(max_pages * P)[None, None, :] < seq_lens[:, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("shc,schd->shd", probs, v)
+
+
+class PageAllocator:
+    """Host-side page free list (the engine thread owns it; no locking).
+    Page 0 is the reserved trash page and is never handed out."""
+
+    def __init__(self, num_pages: int):
+        self.num_pages = num_pages
+        self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    def alloc(self, n: int) -> list[int]:
+        if n > len(self._free):
+            raise MemoryError(f"out of KV pages: need {n}, have {len(self._free)}")
+        return [self._free.pop() for _ in range(n)]
+
+    def free(self, pages: list[int]) -> None:
+        for p in pages:
+            if p != TRASH_PAGE:
+                self._free.append(p)

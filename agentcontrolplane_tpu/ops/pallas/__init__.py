@@ -1,0 +1,3 @@
+from .paged_attention import paged_decode_attention
+
+__all__ = ["paged_decode_attention"]

@@ -1,0 +1,155 @@
+"""Pallas TPU kernel: paged decode attention.
+
+One new token per slot attends over its page list. The kernel walks each
+sequence's block table (scalar-prefetched so page indices are known before
+the body runs), DMAs K/V pages HBM -> VMEM with double buffering, and
+accumulates a flash-style online softmax — the gathered
+``[S, max_ctx, H, d]`` copy the pure-XLA reference materializes
+(``ops.paged.paged_decode_attention_reference``) never exists.
+
+Grid: one program per slot. Per-program working set is
+2 (double buffer) x 2 (K+V) x [page_size, H_kv * d] — a few hundred KB in
+VMEM for Llama-3-8B geometry (page 16, 8 KV heads, d 128).
+
+Tested in interpreter mode on CPU against the exact reference; runs compiled
+on TPU.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _kernel(
+    # scalar prefetch
+    block_tables_ref,  # [S, max_pages] int32 (SMEM)
+    seq_lens_ref,  # [S] int32 (SMEM)
+    # inputs
+    q_ref,  # [1, H, d] (VMEM) — this program's slot
+    k_pages_ref,  # [num_pages, P, H_kv * d] (HBM/ANY)
+    v_pages_ref,  # [num_pages, P, H_kv * d]
+    # output
+    out_ref,  # [1, H, d] (VMEM)
+    # scratch
+    k_buf,  # [2, P, H_kv * d] (VMEM)
+    v_buf,  # [2, P, H_kv * d]
+    sems,  # DMA sems [2, 2]
+    *,
+    page_size: int,
+    n_kv_heads: int,
+    head_dim: int,
+    max_pages: int,
+):
+    s = pl.program_id(0)
+    seq_len = seq_lens_ref[s]
+    n_pages = jax.lax.div(seq_len + page_size - 1, page_size)
+    H = q_ref.shape[1]
+    n_rep = H // n_kv_heads
+    d = head_dim
+    P = page_size
+
+    q = q_ref[0].astype(jnp.float32)  # [H, d]
+    scale = 1.0 / (d**0.5)
+
+    def start_fetch(j, slot):
+        page = block_tables_ref[s, j]
+        pltpu.make_async_copy(k_pages_ref.at[page], k_buf.at[slot], sems.at[slot, 0]).start()
+        pltpu.make_async_copy(v_pages_ref.at[page], v_buf.at[slot], sems.at[slot, 1]).start()
+
+    def wait_fetch(j, slot):
+        page = block_tables_ref[s, j]
+        pltpu.make_async_copy(k_pages_ref.at[page], k_buf.at[slot], sems.at[slot, 0]).wait()
+        pltpu.make_async_copy(v_pages_ref.at[page], v_buf.at[slot], sems.at[slot, 1]).wait()
+
+    @pl.when(n_pages > 0)
+    def _():
+        start_fetch(0, 0)
+
+    def body(j, carry):
+        m, l, acc = carry  # [H,1], [H,1], [H,d] running online-softmax state
+        slot = jax.lax.rem(j, 2)
+        # prefetch next page into the other buffer while we wait on this one
+        @pl.when(j + 1 < n_pages)
+        def _():
+            start_fetch(j + 1, 1 - slot)
+
+        wait_fetch(j, slot)
+        k = k_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
+        v = v_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
+        if n_rep > 1:
+            k = jnp.repeat(k, n_rep, axis=1)
+            v = jnp.repeat(v, n_rep, axis=1)
+        # logits [H, P]
+        logits = jnp.einsum("hd,phd->hp", q, k) * scale
+        pos = j * P + jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+        logits = jnp.where(pos < seq_len, logits, NEG_INF)
+
+        m_blk = jnp.max(logits, axis=1, keepdims=True)  # [H,1]
+        m_new = jnp.maximum(m, m_blk)
+        p = jnp.exp(logits - m_new)  # [H,P]
+        correction = jnp.exp(m - m_new)  # [H,1]
+        l = l * correction + jnp.sum(p, axis=1, keepdims=True)
+        acc = acc * correction + jnp.einsum("hp,phd->hd", p, v)
+        return m_new, l, acc
+
+    m0 = jnp.full((H, 1), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((H, 1), dtype=jnp.float32)
+    acc0 = jnp.zeros((H, d), dtype=jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
+    out = acc / jnp.maximum(l, 1e-30)
+    out_ref[0] = out.astype(out_ref.dtype)
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [S, H, d]
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [S, max_pages] int32
+    seq_lens: jax.Array,  # [S] int32
+    interpret: bool = False,
+) -> jax.Array:
+    S, H, d = q.shape
+    num_pages, P, H_kv, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+
+    kernel = functools.partial(
+        _kernel,
+        page_size=P,
+        n_kv_heads=H_kv,
+        head_dim=d,
+        max_pages=max_pages,
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S,),
+        in_specs=[
+            pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ],
+        out_specs=pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((2, P, H_kv * d), k_pages.dtype),
+            pltpu.VMEM((2, P, H_kv * d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((2, 2)),
+        ],
+    )
+    return pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, H, d), q.dtype),
+        interpret=interpret,
+    )(
+        block_tables,
+        seq_lens,
+        q,
+        k_pages.reshape(num_pages, P, H_kv * d),
+        v_pages.reshape(num_pages, P, H_kv * d),
+    )
