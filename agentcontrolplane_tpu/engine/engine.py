@@ -50,6 +50,7 @@ from ..models.llama import (
     prefill,
 )
 from ..observability.metrics import REGISTRY
+from ..ops.paged import TRASH_PAGE
 from ..ops.sampling import sample
 from ..parallel.mesh import (
     kv_cache_shardings,
@@ -180,8 +181,6 @@ class Engine:
             )()
             self._allocator = PageAllocator(self.num_pages)
             self._slot_pages: dict[int, list[int]] = {}
-            from ..ops.paged import TRASH_PAGE
-
             self._block_tables = np.full(
                 (max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
             )
@@ -196,6 +195,11 @@ class Engine:
 
         self._rng = jax.random.key(seed)
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
+        # admission order is strict FIFO: requests the pool can't fit yet
+        # stay at the head of this deque (no starvation of large requests)
+        import collections
+
+        self._waiting: "collections.deque[_Request]" = collections.deque()
         self._slots: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
         # host mirrors of per-slot device state
@@ -323,32 +327,43 @@ class Engine:
                 if not admitted:
                     continue
             self._decode_once()
-        # drain: fail any queued requests
+        # drain: fail any queued/waiting requests
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
             if req is not None:
-                req.future.set_exception(RuntimeError("engine stopped"))
+                self._waiting.append(req)
+        while self._waiting:
+            self._waiting.popleft().future.set_exception(RuntimeError("engine stopped"))
         for slot in list(self._slots):
             self._finish(slot, "stop")
 
     def _admit(self, block: bool) -> bool:
-        """Move queued requests into free slots (prefill). Returns True if
-        anything was admitted."""
-        admitted = False
-        while self._free:
+        """Move queued requests into free slots (prefill), strictly FIFO.
+        Returns True if anything was admitted."""
+        # drain the cross-thread queue into the ordered waiting deque
+        may_block = block and not self._waiting and not self._slots
+        while True:
             try:
-                req = self._queue.get(timeout=0.05) if (block and not admitted and not self._slots) else self._queue.get_nowait()
+                req = self._queue.get(timeout=0.05) if may_block else self._queue.get_nowait()
             except queue.Empty:
                 break
+            may_block = False
             if req is None:
                 self._stopping = True
-                return admitted
+                return False
+            self._waiting.append(req)
+
+        admitted = False
+        while self._free and self._waiting:
+            req = self._waiting[0]
             slot = self._free.pop()
             if not self._prefill_into(slot, req):
-                break  # out of KV pages; retry after some slot finishes
+                # head request can't fit (KV pages); keep FIFO order and wait
+                break
+            self._waiting.popleft()
             admitted = True
         return admitted
 
@@ -360,8 +375,6 @@ class Engine:
         self._rng, step_rng = jax.random.split(self._rng)
         s = req.sampling
         if self.kv_layout == "paged":
-            from ..ops.paged import TRASH_PAGE
-
             n_pages = -(-plen // self.page_size)
             if n_pages > self._allocator.num_pages - 1:
                 # bigger than the entire pool: requeueing would spin forever
@@ -376,9 +389,10 @@ class Engine:
             try:
                 pages = self._allocator.alloc(n_pages)
             except MemoryError:
-                # out of KV pages: requeue and retry once slots free pages
+                # out of KV pages: leave the request at the head of the
+                # waiting deque (strict FIFO; no starvation) and retry once
+                # finishing slots free pages
                 self._free.append(slot)
-                self._queue.put(req)
                 return False
             self._slot_pages[slot] = pages
             self._block_tables[slot, :] = TRASH_PAGE
@@ -432,7 +446,7 @@ class Engine:
         at current length) — admission backpressure frees their pages."""
         K = self.decode_block_size
         for slot in list(self._slots):
-            needed = -(-(int(self._seq_lens[slot]) + K + 1) // self.page_size)
+            needed = -(-(int(self._seq_lens[slot]) + K) // self.page_size)
             if needed > self.max_pages_per_seq:
                 # can't guarantee K in-bounds steps: finishing here keeps the
                 # kernel's page walk inside the block table
@@ -454,11 +468,13 @@ class Engine:
         if not self._slots:
             return
         K = self.decode_block_size
-        # Pre-finish slots that can't take K more tokens in-bounds: the block
-        # runs unconditionally on device, and paged page walks must never
-        # step past the block table (slot mode merely clamps harmlessly).
+        # Pre-finish slots that can't take K more tokens in-bounds: a block
+        # starting at s0 writes positions s0..s0+K-1 and reads at most s0+K
+        # entries, so dispatch is safe iff s0 + K <= max_ctx. The block runs
+        # unconditionally on device and paged page walks must never step
+        # past the block table (slot mode merely clamps harmlessly).
         for slot in list(self._slots):
-            if int(self._seq_lens[slot]) + K + 1 > self.max_ctx:
+            if int(self._seq_lens[slot]) + K > self.max_ctx:
                 self._finish(slot, "length")
         if not self._slots:
             return
@@ -527,8 +543,6 @@ class Engine:
         self._last_tokens[slot] = 0
         self._free.append(slot)
         if self.kv_layout == "paged":
-            from ..ops.paged import TRASH_PAGE
-
             self._allocator.free(self._slot_pages.pop(slot, []))
             self._block_tables[slot, :] = TRASH_PAGE
         gen = sl.generated

@@ -1,0 +1,57 @@
+"""Pluggable external-API client registry.
+
+Rebuilt from ``acp/internal/externalAPI/main.go`` (73 LoC, mostly vestigial
+in the reference — its only registrant is the humanlayer client,
+``humanlayer/client.go:189-196``): name -> client-factory registry resolving
+credentials from Secrets, so alternative human-interaction or tool backends
+can be plugged in without touching controllers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+from .api.resources import SecretKeyRef
+from .kernel.errors import Invalid
+from .kernel.store import Store
+from .llmclient.factory import resolve_secret_key
+
+ClientFactory = Callable[[str], Any]  # api_key -> client
+
+
+class Registry:
+    def __init__(self):
+        self._factories: dict[str, ClientFactory] = {}
+
+    def register(self, name: str, factory: ClientFactory) -> None:
+        self._factories[name] = factory
+
+    def registered(self) -> list[str]:
+        return sorted(self._factories)
+
+    def get_client(
+        self,
+        name: str,
+        store: Optional[Store] = None,
+        namespace: str = "default",
+        key_ref: Optional[SecretKeyRef] = None,
+        api_key: str = "",
+    ) -> Any:
+        factory = self._factories.get(name)
+        if factory is None:
+            raise Invalid(f'no external API client registered for "{name}"')
+        if key_ref is not None and store is not None:
+            api_key = resolve_secret_key(store, namespace, key_ref)
+        return factory(api_key)
+
+
+DEFAULT_REGISTRY = Registry()
+
+
+def register_defaults(registry: Registry | None = None) -> Registry:
+    """Register the built-in clients (the reference registers humanlayer)."""
+    registry = registry or DEFAULT_REGISTRY
+    from .humanlayer.client import HTTPHumanLayerClient
+
+    registry.register("humanlayer", lambda key: HTTPHumanLayerClient(key))
+    return registry

@@ -9,6 +9,7 @@ reference has no equivalent for.
 from __future__ import annotations
 
 import threading
+from collections import deque
 from dataclasses import dataclass, field
 
 
@@ -20,11 +21,16 @@ class _Metric:
     values: dict[tuple[tuple[str, str], ...], float] = field(default_factory=dict)
 
 
+HIST_WINDOW = 4096  # bounded reservoir per series (quantiles over a window)
+
+
 class Registry:
-    def __init__(self):
+    def __init__(self, hist_window: int = HIST_WINDOW):
         self._metrics: dict[str, _Metric] = {}
-        self._hist_buckets: dict[str, list[float]] = {}
-        self._hist_data: dict[str, dict[tuple, list[float]]] = {}
+        self._hist_window = hist_window
+        self._hist_data: dict[str, dict[tuple, "deque[float]"]] = {}
+        self._hist_count: dict[str, dict[tuple, int]] = {}
+        self._hist_sum: dict[str, dict[tuple, float]] = {}
         self._lock = threading.Lock()
 
     def _get(self, name: str, help: str, type_: str) -> _Metric:
@@ -52,7 +58,15 @@ class Registry:
     def observe(self, name: str, value: float, labels: dict[str, str] | None = None, help: str = "") -> None:
         with self._lock:
             self._get(name, help, "histogram")
-            self._hist_data.setdefault(name, {}).setdefault(self._key(labels), []).append(value)
+            k = self._key(labels)
+            series = self._hist_data.setdefault(name, {})
+            if k not in series:
+                series[k] = deque(maxlen=self._hist_window)
+            series[k].append(value)
+            counts = self._hist_count.setdefault(name, {})
+            counts[k] = counts.get(k, 0) + 1
+            sums = self._hist_sum.setdefault(name, {})
+            sums[k] = sums.get(k, 0.0) + value
 
     def quantile(self, name: str, q: float, labels: dict[str, str] | None = None) -> float:
         with self._lock:
@@ -73,13 +87,13 @@ class Registry:
                 if m.type == "histogram":
                     for k, vals in self._hist_data.get(m.name, {}).items():
                         lbl = self._render_labels(k)
-                        svals = sorted(vals)
+                        svals = sorted(vals)  # windowed quantiles
                         for q in (0.5, 0.9, 0.99):
                             qk = self._render_labels(k + (("quantile", str(q)),))
                             idx = min(int(q * len(svals)), len(svals) - 1)
                             out.append(f"{m.name}{qk} {svals[idx]}")
-                        out.append(f"{m.name}_count{lbl} {len(vals)}")
-                        out.append(f"{m.name}_sum{lbl} {sum(vals)}")
+                        out.append(f"{m.name}_count{lbl} {self._hist_count[m.name][k]}")
+                        out.append(f"{m.name}_sum{lbl} {self._hist_sum[m.name][k]}")
                 else:
                     for k, v in m.values.items():
                         out.append(f"{m.name}{self._render_labels(k)} {v}")

@@ -1,0 +1,69 @@
+"""Sharded checkpoint save/restore via orbax.
+
+No reference analogue (the reference checkpoints orchestration state in
+etcd; model state lives with SaaS providers). Here fine-tuned params and
+optimizer state are saved/restored sharded — restore places each leaf
+directly onto its NamedSharding, so an 8-way-sharded model never
+materializes unsharded on one host.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional
+
+import jax
+
+
+def save_checkpoint(path: str, params: Any, opt_state: Any = None, step: int = 0) -> None:
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    ckptr = ocp.StandardCheckpointer()
+    payload = {"params": params}
+    if opt_state is not None:
+        payload["opt_state"] = opt_state
+    ckptr.save(os.path.join(path, f"step_{step}"), payload, force=True)
+    ckptr.wait_until_finished()
+    ckptr.close()
+
+
+def restore_checkpoint(
+    path: str,
+    abstract: Any,
+    step: Optional[int] = None,
+) -> Any:
+    """Restore onto the shardings carried by ``abstract`` (a pytree of
+    jax.ShapeDtypeStruct with .sharding set, e.g. from
+    ``jax.eval_shape`` + ``tree_map`` with NamedShardings)."""
+    import orbax.checkpoint as ocp
+
+    path = os.path.abspath(path)
+    if step is None:
+        steps = sorted(
+            int(d[5:])
+            for d in os.listdir(path)
+            if d.startswith("step_") and d[5:].isdigit()  # skip tmp leftovers
+        )
+        if not steps:
+            raise FileNotFoundError(f"no checkpoints under {path}")
+        step = steps[-1]
+    ckptr = ocp.StandardCheckpointer()
+    try:
+        return ckptr.restore(os.path.join(path, f"step_{step}"), target=abstract)
+    finally:
+        ckptr.close()
+
+
+def abstract_like(tree: Any, shardings: Any = None) -> Any:
+    """ShapeDtypeStruct tree (optionally with shardings) for restore."""
+    abstract = jax.tree_util.tree_map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), tree
+    )
+    if shardings is not None:
+        abstract = jax.tree_util.tree_map(
+            lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+            abstract,
+            shardings,
+        )
+    return abstract

@@ -1,0 +1,81 @@
+"""Orbax checkpoint roundtrip (sharded restore) + external API registry."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import optax
+
+from agentcontrolplane_tpu.externalapi import Registry, register_defaults
+from agentcontrolplane_tpu.kernel.errors import Invalid
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.train.checkpoint import (
+    abstract_like,
+    restore_checkpoint,
+    save_checkpoint,
+)
+from agentcontrolplane_tpu.train.trainer import Trainer
+
+TINY = PRESETS["tiny"]
+
+
+def test_checkpoint_roundtrip_sharded(tmp_path):
+    mesh = make_mesh({"dp": 1, "sp": 1, "tp": 2}, devices=jax.devices()[:2])
+    trainer = Trainer(config=TINY, mesh=mesh, optimizer=optax.adam(1e-3))
+    params, opt_state = trainer.init(jax.random.key(0))
+    tokens, mask = trainer.shard_batch(
+        np.random.default_rng(0).integers(0, TINY.vocab_size, size=(2, 16))
+    )
+    params, opt_state, loss = trainer.train_step(params, opt_state, tokens, mask)
+
+    ckpt = str(tmp_path / "ckpt")
+    save_checkpoint(ckpt, params, opt_state, step=1)
+
+    abstract = {
+        "params": abstract_like(params, trainer.param_sharding),
+        "opt_state": abstract_like(opt_state, trainer.opt_sharding),
+    }
+    restored = restore_checkpoint(ckpt, abstract)
+    r_params = restored["params"]
+    np.testing.assert_array_equal(
+        np.asarray(r_params["norm"]), np.asarray(params["norm"])
+    )
+    # restored leaves carry the requested shardings
+    leaf = r_params["layers"]["wq"]
+    assert leaf.sharding == trainer.param_sharding["layers"]["wq"]
+    # training continues from the restored state
+    p2, o2, loss2 = trainer.train_step(
+        r_params, restored["opt_state"], tokens, mask
+    )
+    assert np.isfinite(float(loss2))
+
+
+def test_registry_resolves_secret_and_unknown_errors(store):
+    from tests.fixtures import make_secret
+
+    reg = Registry()
+    seen = {}
+
+    def factory(key):
+        seen["key"] = key
+        return f"client:{key}"
+
+    reg.register("svc", factory)
+    make_secret(store, "creds", {"token": "tok-123"})
+    from agentcontrolplane_tpu.api.resources import SecretKeyRef
+
+    client = reg.get_client(
+        "svc", store=store, key_ref=SecretKeyRef(name="creds", key="token")
+    )
+    assert client == "client:tok-123"
+    assert seen["key"] == "tok-123"
+    with pytest.raises(Invalid, match="no external API client"):
+        reg.get_client("ghost")
+
+
+def test_register_defaults_has_humanlayer():
+    reg = register_defaults(Registry())
+    assert "humanlayer" in reg.registered()

@@ -1,0 +1,226 @@
+"""OpenAI-compatible + Anthropic wire-format tests against a local fake
+server (the reference's getting_started suite fakes OpenAI with httptest)."""
+
+import json
+
+import pytest
+from aiohttp import web
+
+from agentcontrolplane_tpu.api.resources import BaseConfig, Message, MessageToolCall, ToolCallFunction
+from agentcontrolplane_tpu.llmclient import (
+    AnthropicClient,
+    LLMRequestError,
+    OpenAICompatibleClient,
+    Tool,
+    ToolFunction,
+    merge_choices,
+)
+
+
+class FakeProvider:
+    def __init__(self, responder):
+        self.responder = responder
+        self.requests = []
+        self.app = web.Application()
+        self.app.router.add_post("/chat/completions", self.handle)
+        self.app.router.add_post("/v1/messages", self.handle)
+        self.runner = None
+        self.port = None
+
+    async def handle(self, request):
+        body = await request.json()
+        self.requests.append((request.path, dict(request.headers), body))
+        result = self.responder(body)
+        if isinstance(result, tuple):
+            status, payload = result
+            return web.json_response(payload, status=status)
+        return web.json_response(result)
+
+    async def __aenter__(self):
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        self.port = site._server.sockets[0].getsockname()[1]
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.runner.cleanup()
+
+    @property
+    def url(self):
+        return f"http://127.0.0.1:{self.port}"
+
+
+async def test_openai_roundtrip_with_tools():
+    def responder(body):
+        assert body["model"] == "gpt-4o"
+        assert body["messages"][0] == {"role": "system", "content": "sys"}
+        assert body["tools"][0]["function"]["name"] == "web__fetch"
+        return {
+            "choices": [
+                {
+                    "message": {
+                        "role": "assistant",
+                        "content": None,
+                        "tool_calls": [
+                            {
+                                "id": "call_9",
+                                "type": "function",
+                                "function": {"name": "web__fetch", "arguments": '{"url": "x"}'},
+                            }
+                        ],
+                    }
+                }
+            ]
+        }
+
+    async with FakeProvider(responder) as fake:
+        client = OpenAICompatibleClient(
+            "sk-test", BaseConfig(model="gpt-4o", base_url=fake.url, temperature=0.5)
+        )
+        msg = await client.send_request(
+            [Message(role="system", content="sys"), Message(role="user", content="u")],
+            [Tool(function=ToolFunction(name="web__fetch", description="d"))],
+        )
+        await client.close()
+    assert msg.tool_calls[0].function.name == "web__fetch"
+    assert msg.tool_calls[0].id == "call_9"
+    assert msg.content == ""
+    # auth header + sampling params went over the wire
+    path, headers, body = fake.requests[0]
+    assert headers["Authorization"] == "Bearer sk-test"
+    assert body["temperature"] == 0.5
+
+
+async def test_openai_tool_result_message_encoding():
+    def responder(body):
+        tool_msg = body["messages"][-1]
+        assert tool_msg == {"role": "tool", "content": "result!", "tool_call_id": "call_1"}
+        assistant = body["messages"][-2]
+        assert assistant["tool_calls"][0]["id"] == "call_1"
+        assert assistant["content"] is None
+        return {"choices": [{"message": {"role": "assistant", "content": "done"}}]}
+
+    async with FakeProvider(responder) as fake:
+        client = OpenAICompatibleClient("k", BaseConfig(model="m", base_url=fake.url))
+        msg = await client.send_request(
+            [
+                Message(role="user", content="u"),
+                Message(
+                    role="assistant",
+                    content="",
+                    tool_calls=[
+                        MessageToolCall(
+                            id="call_1",
+                            function=ToolCallFunction(name="a__b", arguments="{}"),
+                        )
+                    ],
+                ),
+                Message(role="tool", content="result!", tool_call_id="call_1"),
+            ],
+            [],
+        )
+        await client.close()
+    assert msg.content == "done"
+
+
+async def test_openai_4xx_maps_to_terminal_error():
+    async with FakeProvider(lambda b: (401, {"error": {"message": "bad key"}})) as fake:
+        client = OpenAICompatibleClient("k", BaseConfig(model="m", base_url=fake.url))
+        with pytest.raises(LLMRequestError) as exc:
+            await client.send_request([Message(role="user", content="u")], [])
+        await client.close()
+    assert exc.value.status_code == 401
+    assert exc.value.terminal
+    assert "bad key" in str(exc.value)
+
+
+async def test_openai_429_is_retryable():
+    async with FakeProvider(lambda b: (429, {"error": {"message": "slow down"}})) as fake:
+        client = OpenAICompatibleClient("k", BaseConfig(model="m", base_url=fake.url))
+        with pytest.raises(LLMRequestError) as exc:
+            await client.send_request([Message(role="user", content="u")], [])
+        await client.close()
+    assert not exc.value.terminal
+
+
+async def test_anthropic_roundtrip_tool_use():
+    def responder(body):
+        assert body["system"] == "sys"
+        assert body["messages"][0] == {"role": "user", "content": "u"}
+        assert body["tools"][0]["input_schema"]["type"] == "object"
+        return {
+            "content": [
+                {"type": "text", "text": "let me check"},
+                {"type": "tool_use", "id": "tu_1", "name": "web__fetch", "input": {"url": "x"}},
+            ]
+        }
+
+    async with FakeProvider(responder) as fake:
+        client = AnthropicClient("ak", BaseConfig(model="claude", base_url=fake.url))
+        msg = await client.send_request(
+            [Message(role="system", content="sys"), Message(role="user", content="u")],
+            [Tool(function=ToolFunction(name="web__fetch", description="d"))],
+        )
+        await client.close()
+    # tool calls beat content
+    assert msg.content == ""
+    assert msg.tool_calls[0].function.name == "web__fetch"
+    assert json.loads(msg.tool_calls[0].function.arguments) == {"url": "x"}
+    _, headers, _ = fake.requests[0]
+    assert headers["x-api-key"] == "ak"
+
+
+async def test_anthropic_tool_result_encoding():
+    def responder(body):
+        result_msg = body["messages"][-1]
+        assert result_msg["content"][0]["type"] == "tool_result"
+        assert result_msg["content"][0]["tool_use_id"] == "call_1"
+        return {"content": [{"type": "text", "text": "final"}]}
+
+    async with FakeProvider(responder) as fake:
+        client = AnthropicClient("ak", BaseConfig(model="c", base_url=fake.url))
+        msg = await client.send_request(
+            [
+                Message(role="user", content="u"),
+                Message(
+                    role="assistant",
+                    content="",
+                    tool_calls=[
+                        MessageToolCall(
+                            id="call_1",
+                            function=ToolCallFunction(name="a__b", arguments='{"k":1}'),
+                        )
+                    ],
+                ),
+                Message(role="tool", content="res", tool_call_id="call_1"),
+            ],
+            [],
+        )
+        await client.close()
+    assert msg.content == "final"
+
+
+def test_merge_choices_rules():
+    # tool calls across choices collected; content cleared
+    merged = merge_choices(
+        [
+            Message(role="assistant", content="text answer"),
+            Message(
+                role="assistant",
+                content="",
+                tool_calls=[
+                    MessageToolCall(id="1", function=ToolCallFunction(name="t__a"))
+                ],
+            ),
+        ]
+    )
+    assert merged.content == "" and len(merged.tool_calls) == 1
+    # no tool calls -> first non-empty content
+    merged = merge_choices(
+        [Message(role="assistant", content=""), Message(role="assistant", content="second")]
+    )
+    assert merged.content == "second"
+    # empty response
+    assert merge_choices([]).content == ""
