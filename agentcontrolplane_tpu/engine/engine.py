@@ -184,13 +184,11 @@ class Engine:
             self._block_tables = np.full(
                 (max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
             )
-            # Compiled pallas path only on a real TPU with tp=1: with tp>1
-            # the kernel needs a shard_map wrapper over the head-sharded
-            # pages (GSPMD treats pallas_call as opaque) — until that lands,
-            # tp>1 uses the exact XLA reference path. CPU always uses the
-            # reference (interpret-mode kernel equivalence is in tests).
-            tp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
-            self._use_pallas = jax.default_backend() == "tpu" and tp_size == 1
+            # Compiled pallas path on real TPU (tp>1 goes through the
+            # shard_map wrapper over head-sharded pages — GSPMD treats
+            # pallas_call as opaque); CPU uses the exact XLA reference
+            # (interpret-mode kernel equivalence is in tests).
+            self._use_pallas = jax.default_backend() == "tpu"
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
         self._rng = jax.random.key(seed)
@@ -257,10 +255,11 @@ class Engine:
                 return pages, sample_first(logits, rng, temp, top_k, top_p)
 
             self._jit_prefill_paged = jax.jit(prefill_and_sample, donate_argnums=(1,))
+            mesh = self.mesh
             self._jit_decode_paged = make_decode_block(
                 lambda params, pages, tokens, seq_lens, active, block_tables: decode_step_paged(
                     params, pages, tokens, seq_lens, block_tables, active, config,
-                    use_pallas=use_pallas,
+                    use_pallas=use_pallas, mesh=mesh,
                 )
             )
         else:

@@ -315,6 +315,7 @@ def decode_step_paged(
     active: jax.Array,  # [S] bool
     config: LlamaConfig,
     use_pallas: bool = False,
+    mesh=None,  # required for the pallas path when the mesh has tp > 1
 ) -> tuple[dict, jax.Array]:
     """One decode step for all slots against the paged cache."""
     from ..ops.paged import paged_decode_attention_reference, write_token_to_pages
@@ -322,6 +323,9 @@ def decode_step_paged(
     c = config
     positions = seq_lens[:, None]
     x = params["embed"][tokens][:, None].astype(c.dtype)
+    tp_size = 1
+    if mesh is not None and "tp" in mesh.axis_names:
+        tp_size = dict(zip(mesh.axis_names, mesh.devices.shape))["tp"]
 
     def body(carry, scanned):
         x = carry
@@ -331,7 +335,13 @@ def decode_step_paged(
             k_l, v_l = write_token_to_pages(
                 k_pages_l, v_pages_l, block_tables, seq_lens, active, k[:, 0], v[:, 0]
             )
-            if use_pallas:
+            if use_pallas and tp_size > 1:
+                from ..ops.pallas.paged_attention import paged_decode_attention_sharded
+
+                out = paged_decode_attention_sharded(
+                    mesh, q[:, 0], k_l, v_l, block_tables, seq_lens + 1
+                )
+            elif use_pallas:
                 from ..ops.pallas.paged_attention import paged_decode_attention
 
                 out = paged_decode_attention(q[:, 0], k_l, v_l, block_tables, seq_lens + 1)

@@ -153,3 +153,28 @@ def paged_decode_attention(
         k_pages.reshape(num_pages, P, H_kv * d),
         v_pages.reshape(num_pages, P, H_kv * d),
     )
+
+
+def paged_decode_attention_sharded(
+    mesh,
+    q: jax.Array,  # [S, H, d] — heads sharded over 'tp'
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — KV heads sharded over 'tp'
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # replicated
+    seq_lens: jax.Array,  # replicated
+    interpret: bool = False,
+) -> jax.Array:
+    """tp>1 wrapper: GSPMD treats pallas_call as opaque, so we shard_map it —
+    each shard runs the kernel over its local head slice (attention is
+    head-parallel; page tables are shared), no collectives needed."""
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P(None, "tp", None)
+    pages_spec = P(None, None, "tp", None)
+    return jax.shard_map(
+        functools.partial(paged_decode_attention, interpret=interpret),
+        mesh=mesh,
+        in_specs=(q_spec, pages_spec, pages_spec, P(None, None), P(None)),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_pages, v_pages, block_tables, seq_lens)

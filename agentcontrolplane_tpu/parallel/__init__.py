@@ -8,8 +8,10 @@ from .mesh import (
     serving_mesh,
 )
 from .ring_attention import ring_causal_attention
+from .distributed import global_mesh, initialize_distributed, is_primary, runtime_info
 
 __all__ = [
     "kv_cache_shardings", "kv_cache_specs", "make_mesh", "param_shardings",
     "param_specs", "replicated", "serving_mesh", "ring_causal_attention",
+    "global_mesh", "initialize_distributed", "is_primary", "runtime_info",
 ]

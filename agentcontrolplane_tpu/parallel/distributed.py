@@ -1,0 +1,75 @@
+"""Multi-host runtime: the distributed communication backend.
+
+The reference's "distributed system" is the kube-apiserver (SURVEY.md §0);
+its data plane has no NCCL/MPI analogue to port. Ours is JAX's distributed
+runtime: one process per host, ``jax.distributed.initialize`` forms the
+global device set, and all communication is XLA collectives generated from
+shardings — psum/all-gather/reduce-scatter over **ICI** inside a pod slice,
+DCN between slices. The mesh helpers here order axes so the
+fastest-communicating axes (tp, then sp) land on ICI-adjacent devices and
+only dp spans DCN (the scaling-book layout rule).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .mesh import make_mesh
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join (or form) the multi-host runtime. No-ops for single-process runs.
+
+    Resolution order: explicit args > JAX_COORDINATOR_ADDRESS /
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID env vars > single-process.
+    """
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "1"))
+    if process_id is None:
+        process_id = int(os.environ.get("JAX_PROCESS_ID", "0"))
+    if num_processes <= 1 or coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def global_mesh(axes: dict[str, int] | None = None) -> Mesh:
+    """Mesh over ALL processes' devices, innermost axis = most-local devices.
+
+    Axis order in ``axes`` is outermost-first; put ``dp`` first (spans DCN)
+    and ``tp`` last (rides ICI within a host's slice). Default: tp within
+    each process, dp across processes.
+    """
+    devices = jax.devices()
+    if axes is None:
+        per_proc = jax.local_device_count()
+        axes = {"dp": len(devices) // per_proc, "tp": per_proc}
+    return make_mesh(axes, devices=devices)
+
+
+def is_primary() -> bool:
+    """True on the process that should run singleton work (logging, REST)."""
+    return jax.process_index() == 0
+
+
+def runtime_info() -> dict:
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+        "backend": jax.default_backend(),
+    }

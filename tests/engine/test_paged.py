@@ -113,3 +113,23 @@ def test_page_allocator():
     assert a.free_count == 7
     with pytest.raises(MemoryError):
         a.alloc(8)
+
+
+def test_pallas_kernel_sharded_tp2_interpret():
+    """shard_map wrapper over head-sharded pages (tp=2) == reference."""
+    import jax
+
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_sharded,
+    )
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    q, k_pages, v_pages, tables, seq_lens, _ = _setup(
+        seed=2, S=3, H=8, Hkv=2, d=16, P=8, max_pages=4, num_pages=16
+    )
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    ref = paged_decode_attention_reference(q, k_pages, v_pages, tables, seq_lens)
+    out = paged_decode_attention_sharded(
+        mesh, q, k_pages, v_pages, tables, seq_lens, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
