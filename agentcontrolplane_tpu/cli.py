@@ -44,17 +44,21 @@ def cmd_run(args) -> int:
         from .engine.engine import Engine
         from .engine.tokenizer import ByteTokenizer, HFTokenizer
 
+        kw = dict(
+            max_slots=args.tpu_slots,
+            max_ctx=args.tpu_ctx,
+            kv_layout=args.tpu_kv_layout,
+            quantize=args.tpu_quantize,
+        )
         if args.tpu_checkpoint:
             from .engine.weights import load_safetensors_dir
 
             params, config = load_safetensors_dir(args.tpu_checkpoint)
             tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
             tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
-            engine = Engine(config=config, params=params, tokenizer=tokenizer,
-                            max_slots=args.tpu_slots, max_ctx=args.tpu_ctx)
+            engine = Engine(config=config, params=params, tokenizer=tokenizer, **kw)
         else:
-            engine = Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(),
-                            max_slots=args.tpu_slots, max_ctx=args.tpu_ctx)
+            engine = Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(), **kw)
         engine.start()
 
     options = OperatorOptions(
@@ -226,6 +230,8 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
     run.add_argument("--tpu-slots", type=int, default=64)
     run.add_argument("--tpu-ctx", type=int, default=2048)
+    run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
+    run.add_argument("--tpu-quantize", choices=["int8"], default=None)
     run.set_defaults(fn=cmd_run)
 
     ap = sub.add_parser("apply", help="apply manifests")

@@ -120,6 +120,7 @@ class Engine:
         kv_layout: str = "slot",  # "slot" | "paged"
         page_size: int = 16,
         kv_pages: int = 0,  # paged: total pages (0 = slot-equivalent capacity)
+        quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
     ):
         self.decode_block_size = max(1, decode_block_size)
@@ -147,6 +148,18 @@ class Engine:
             params = jax.jit(
                 lambda k: _init(config, k), out_shardings=shardings
             )(jax.random.key(seed))
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantization {quantize!r}")
+        if quantize == "int8":
+            # Quantize one stacked matrix at a time so peak memory is the
+            # bf16 params + a single int8 tensor (not a full second copy).
+            from ..ops.quant import QUANTIZABLE, quantize as _q
+
+            layers = dict(params["layers"])
+            for key in QUANTIZABLE:
+                layers[key] = jax.jit(_q)(layers[key])
+            params = {**params, "layers": layers}
+        self.quantize = quantize
         self.params = params
         if self.kv_layout == "slot":
             cache_shardings = kv_cache_shardings(self.mesh)

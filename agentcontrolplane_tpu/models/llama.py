@@ -156,18 +156,20 @@ def _attn_mlp(
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Shared block body: returns (output, k, v) where k/v are this layer's
     new key/value tensors (for cache writes)."""
+    from ..ops.quant import matmul as mm  # transparent int8 dequant
+
     c = config
     B, T, D = x.shape
     h = rms_norm(x, layer["ln1"], c.norm_eps)
-    q = (h @ layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
-    k = (h @ layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
-    v = (h @ layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+    q = mm(h, layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
+    k = mm(h, layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+    v = mm(h, layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
     q = apply_rope(q, positions, c.rope_theta)
     k = apply_rope(k, positions, c.rope_theta)
     attn = attn_fn(q, k, v)
-    x = x + attn.reshape(B, T, c.n_heads * c.head_dim) @ layer["wo"]
+    x = x + mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
     h = rms_norm(x, layer["ln2"], c.norm_eps)
-    x = x + (jax.nn.silu(h @ layer["w1"]) * (h @ layer["w3"])) @ layer["w2"]
+    x = x + mm(jax.nn.silu(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
     return x, k, v
 
 

@@ -1,0 +1,98 @@
+"""Weight-only int8 quantization for serving.
+
+Symmetric per-output-channel int8: ``W[in, out] -> (q int8, scale[out]
+f32/2)``, dequantized on the fly inside the matmul — on TPU, XLA fuses the
+int8->bf16 convert and the per-channel scale into the operand load of the
+MXU matmul, so the HBM read is half the bf16 bytes (the decode loop is
+weight-bandwidth-bound, so this is ~2x decode headroom and lets Llama-3-8B
+weights (~8GB int8) fit a single 16GB v5e chip).
+
+Activations stay bf16 (weight-only), so accuracy loss is the usual
+negligible per-channel-int8 delta.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclass
+class QuantizedTensor:
+    """int8 values + per-output-channel scales. Layout matches the bf16
+    tensor it replaces: q[..., in, out], scale[..., 1, out]."""
+
+    q: jax.Array
+    scale: jax.Array
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def dtype(self):
+        return self.q.dtype
+
+
+def quantize(w: jax.Array, axis: int = -2) -> QuantizedTensor:
+    """Per-output-channel symmetric int8 over the contraction axis
+    (``axis`` = the 'in' dimension being summed)."""
+    wf = w.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
+    return QuantizedTensor(q=q, scale=scale)
+
+
+def dequantize(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
+    return (t.q.astype(jnp.float32) * t.scale).astype(dtype)
+
+
+def matmul(x: jax.Array, w: "jax.Array | QuantizedTensor") -> jax.Array:
+    """x @ w with transparent dequantization (fused by XLA on TPU)."""
+    if isinstance(w, QuantizedTensor):
+        return x @ dequantize(w, x.dtype)
+    return x @ w
+
+
+QUANTIZABLE = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+def quantize_params(params: dict) -> dict:
+    """Quantize the stacked layer matrices (embed/lm_head/norms stay bf16:
+    the embedding gather and final projection are small next to the body)."""
+    out = dict(params)
+    out["layers"] = dict(params["layers"])
+    for key in QUANTIZABLE:
+        w = params["layers"][key]  # [L, in, out]
+        out["layers"][key] = quantize(w, axis=-2)
+    return out
+
+
+def quantized_param_shardings(shardings: dict) -> dict:
+    """Mirror a params-sharding tree for quantized layers: q inherits the
+    weight's sharding; per-channel scales inherit the out-axis sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    out = dict(shardings)
+    out["layers"] = dict(shardings["layers"])
+    for key in QUANTIZABLE:
+        s = shardings["layers"][key]
+        assert isinstance(s, NamedSharding)
+        spec = s.spec  # e.g. (None, None, 'tp') for [L, in, out]
+        scale_spec = P(spec[0], None, spec[2] if len(spec) > 2 else None)
+        out["layers"][key] = QuantizedTensor(  # type: ignore[arg-type]
+            q=s, scale=NamedSharding(s.mesh, scale_spec)
+        )
+    return out
