@@ -53,7 +53,11 @@ def cmd_run(args) -> int:
         if args.tpu_checkpoint:
             from .engine.weights import load_safetensors_dir
 
-            params, config = load_safetensors_dir(args.tpu_checkpoint)
+            # quantization happens host-side at load: the bf16 copy of a big
+            # model never reaches the device
+            params, config = load_safetensors_dir(
+                args.tpu_checkpoint, quantize=args.tpu_quantize
+            )
             tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
             tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
             engine = Engine(config=config, params=params, tokenizer=tokenizer, **kw)

@@ -21,9 +21,13 @@ from .toolparse import to_message
 
 
 class TPUEngineClient(LLMClient):
-    def __init__(self, engine: Engine, params: BaseConfig):
+    def __init__(self, engine: Engine, params: BaseConfig, force_json_tools: bool = False):
         self.engine = engine
         self.params = params
+        # LLM.spec.providerConfig["force_json_tools"]: grammar-constrain the
+        # response to a JSON object whenever tools are offered (guaranteed
+        # parseable tool calls at the cost of forbidding prose answers)
+        self.force_json_tools = force_json_tools
 
     async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
         prompt = render_prompt(messages, tools)
@@ -32,6 +36,7 @@ class TPUEngineClient(LLMClient):
             top_k=self.params.top_k or 0,
             top_p=self.params.top_p if self.params.top_p is not None else 1.0,
             max_tokens=self.params.max_tokens or 512,
+            json_only=bool(self.force_json_tools and tools),
         )
         future = self.engine.submit(prompt, sampling)
         try:

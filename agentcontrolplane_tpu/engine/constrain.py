@@ -1,0 +1,251 @@
+"""Constrained decoding: structural-JSON grammar masking.
+
+SURVEY.md §7.4 hard-part #3: the orchestrator depends on parseable tool
+calls. Prompting + defensive parsing (toolparse.py) covers the happy path;
+this module adds a hard guarantee: a per-token logit mask driven by a JSON
+pushdown automaton (nesting capped so the state space is finite), so a
+constrained generation is always a structurally valid JSON object —
+balanced containers, terminated/escaped strings, legal value starts —
+ending exactly when the top-level object closes (then only stop tokens are
+allowed).
+
+The automaton is byte-level; ``TokenTable`` lifts it to any tokenizer by
+simulating each vocab entry's bytes, yielding dense arrays the engine uses
+ON DEVICE inside the decode block:
+
+    allowed = token_trans[state] >= 0        # [V] mask for the next token
+    state'  = token_trans[state, token]      # after sampling
+
+Numbers/literals are validated loosely (digit/letter runs) — the guarantee
+is structural validity, which is what keeps the ToolCall state machine fed;
+``json.loads`` failures drop from "model rambled prose" to "malformed
+number", which the loose grammar makes vanishingly rare.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import lru_cache
+from typing import Iterable, Optional
+
+import numpy as np
+
+# modes
+START = 0  # expect '{' (or whitespace)
+EXPECT_KEY = 1  # inside object: '"' or '}'
+IN_KEY = 2
+IN_KEY_ESC = 3
+AFTER_KEY = 4  # expect ':'
+EXPECT_VALUE = 5  # after ':' / '[' / ',' in array
+IN_STRING = 6
+IN_STRING_ESC = 7
+AFTER_VALUE = 8  # expect ',' or closer
+IN_NUMBER = 9
+IN_LITERAL = 10  # true/false/null (loose letter run)
+DONE = 11
+
+_WS = b" \t\n\r"
+_NUM_START = b"-0123456789"
+_NUM_CONT = b"0123456789.eE+-"
+_LIT_START = b"tfn"
+_LIT_CONT = b"abcdefghijklmnopqrstuvwxyz"
+
+OBJ, ARR = 0, 1
+
+
+class JsonByteAutomaton:
+    """Finite automaton over bytes: state = (mode, container stack).
+    States are discovered lazily and interned to dense ids."""
+
+    def __init__(self, max_depth: int = 8):
+        self.max_depth = max_depth
+        self._ids: dict[tuple, int] = {}
+        self._states: list[tuple] = []
+        self._trans: list[np.ndarray] = []  # per state: [256] int32 next-id or -1
+        self.start = self._intern((START, ()))
+        self._build()
+
+    def _intern(self, state: tuple) -> int:
+        if state not in self._ids:
+            self._ids[state] = len(self._states)
+            self._states.append(state)
+            self._trans.append(None)  # filled by _build
+        return self._ids[state]
+
+    def _step(self, state: tuple, byte: int) -> Optional[tuple]:
+        mode, stack = state
+        ch = bytes([byte])
+
+        def close_container():
+            new_stack = stack[:-1]
+            if not new_stack:
+                return (DONE, ())
+            return (AFTER_VALUE, new_stack)
+
+        if mode == START:
+            # no leading whitespace: the first sampled token must open the
+            # object (whitespace here only burns the token budget)
+            if ch == b"{":
+                return (EXPECT_KEY, (OBJ,))
+            return None
+        if mode == EXPECT_KEY:
+            if ch in _WS:
+                return state
+            if ch == b'"':
+                return (IN_KEY, stack)
+            if ch == b"}" and stack and stack[-1] == OBJ:
+                return close_container()
+            return None
+        if mode == IN_KEY:
+            if ch == b'"':
+                return (AFTER_KEY, stack)
+            if ch == b"\\":
+                return (IN_KEY_ESC, stack)
+            if byte < 0x20:
+                return None
+            return state
+        if mode == IN_KEY_ESC:
+            return (IN_KEY, stack)
+        if mode == AFTER_KEY:
+            if ch in _WS:
+                return state
+            if ch == b":":
+                return (EXPECT_VALUE, stack)
+            return None
+        if mode == EXPECT_VALUE:
+            if ch in _WS:
+                return state
+            if ch == b'"':
+                return (IN_STRING, stack)
+            if ch == b"{":
+                if len(stack) >= self.max_depth:
+                    return None
+                return (EXPECT_KEY, stack + (OBJ,))
+            if ch == b"[":
+                if len(stack) >= self.max_depth:
+                    return None
+                return (EXPECT_VALUE, stack + (ARR,))
+            if ch == b"]" and stack and stack[-1] == ARR:
+                return close_container()  # empty array
+            if ch in _NUM_START:
+                return (IN_NUMBER, stack)
+            if ch in _LIT_START:
+                return (IN_LITERAL, stack)
+            return None
+        if mode == IN_STRING:
+            if ch == b'"':
+                return (AFTER_VALUE, stack)
+            if ch == b"\\":
+                return (IN_STRING_ESC, stack)
+            if byte < 0x20:
+                return None
+            return state
+        if mode == IN_STRING_ESC:
+            return (IN_STRING, stack)
+        if mode in (AFTER_VALUE, IN_NUMBER, IN_LITERAL):
+            # number/literal terminators fall through to AFTER_VALUE handling
+            if mode == IN_NUMBER and ch in _NUM_CONT:
+                return state
+            if mode == IN_LITERAL and ch in _LIT_CONT:
+                return state
+            if ch in _WS:
+                return (AFTER_VALUE, stack)
+            if ch == b",":
+                if stack and stack[-1] == OBJ:
+                    return (EXPECT_KEY, stack)
+                if stack and stack[-1] == ARR:
+                    return (EXPECT_VALUE, stack)
+                return None
+            if ch == b"}" and stack and stack[-1] == OBJ:
+                return close_container()
+            if ch == b"]" and stack and stack[-1] == ARR:
+                return close_container()
+            return None
+        if mode == DONE:
+            if ch in _WS:
+                return state
+            return None
+        return None
+
+    def _build(self) -> None:
+        frontier = [0]
+        while frontier:
+            sid = frontier.pop()
+            if self._trans[sid] is not None:
+                continue
+            row = np.full(256, -1, dtype=np.int32)
+            state = self._states[sid]
+            for byte in range(256):
+                nxt = self._step(state, byte)
+                if nxt is not None:
+                    nid = self._intern(nxt)
+                    row[byte] = nid
+                    if nid >= len(self._trans) or self._trans[nid] is None:
+                        while len(self._trans) < len(self._states):
+                            self._trans.append(None)
+                        frontier.append(nid)
+            self._trans[sid] = row
+
+    @property
+    def n_states(self) -> int:
+        return len(self._states)
+
+    def is_done(self, sid: int) -> bool:
+        return self._states[sid][0] == DONE
+
+    def run_bytes(self, sid: int, data: bytes) -> int:
+        """-1 if the byte run is illegal from sid."""
+        for b in data:
+            if sid < 0:
+                return -1
+            sid = int(self._trans[sid][b])
+        return sid
+
+
+@dataclass
+class TokenTable:
+    """token_trans[state, token] = next state, or -1 (forbidden).
+    DONE states allow only stop tokens (mapped to staying DONE)."""
+
+    token_trans: np.ndarray  # [n_states, vocab] int32
+    start_state: int
+
+    @property
+    def n_states(self) -> int:
+        return self.token_trans.shape[0]
+
+
+def build_token_table(
+    tokenizer,
+    max_depth: int = 8,
+) -> TokenTable:
+    """Lift the byte automaton to the tokenizer's vocab by composing per-byte
+    transition columns (vectorized over the state axis — a 128k-vocab Llama-3
+    tokenizer builds in seconds, not minutes). Requires ``token_bytes(id) ->
+    bytes | None`` (None = control/special token). int16 (state count is
+    small) to halve the on-device table."""
+    auto = JsonByteAutomaton(max_depth=max_depth)
+    vocab = tokenizer.vocab_size
+    stop = tokenizer.stop_tokens
+    byte_trans = np.stack(auto._trans)  # [n_states, 256] int32
+    n_states = auto.n_states
+    assert n_states < 2**15
+    done_mask = np.asarray([auto.is_done(s) for s in range(n_states)])
+
+    table = np.full((n_states, vocab), -1, dtype=np.int16)
+    ids = np.arange(n_states, dtype=np.int32)
+    for tok in range(vocab):
+        if tok in stop:
+            # finishing is the only legal move, available exactly at DONE
+            table[done_mask, tok] = ids[done_mask].astype(np.int16)
+            continue
+        data = tokenizer.token_bytes(tok)
+        if not data:
+            continue
+        v = ids
+        for b in data:
+            v = np.where(v >= 0, byte_trans[np.clip(v, 0, None), b], -1)
+        # DONE states admit no non-stop tokens (force immediate stop)
+        v = np.where(done_mask, -1, v)
+        table[:, tok] = v.astype(np.int16)
+    return TokenTable(token_trans=table, start_state=auto.start)

@@ -70,6 +70,9 @@ class SamplingParams:
     top_k: int = 0
     top_p: float = 1.0
     max_tokens: int = 512
+    # grammar-constrained decoding: force a structurally valid JSON object
+    # (engine/constrain.py); generation ends when the object closes
+    json_only: bool = False
 
 
 @dataclass
@@ -151,14 +154,18 @@ class Engine:
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantization {quantize!r}")
         if quantize == "int8":
-            # Quantize one stacked matrix at a time so peak memory is the
-            # bf16 params + a single int8 tensor (not a full second copy).
-            from ..ops.quant import QUANTIZABLE, quantize as _q
+            # Quantize per-matrix, dropping each bf16 original as its int8
+            # replacement lands (in-place layer-dict mutation) so peak device
+            # memory is the bf16 params + ONE extra tensor. For big
+            # checkpoints prefer load-time quantization (weights.py
+            # quantize="int8"), which never materializes bf16 at all; already
+            # -quantized leaves are skipped here.
+            from ..ops.quant import QUANTIZABLE, QuantizedTensor, quantize as _q
 
-            layers = dict(params["layers"])
+            layers = params["layers"]
             for key in QUANTIZABLE:
-                layers[key] = jax.jit(_q)(layers[key])
-            params = {**params, "layers": layers}
+                if not isinstance(layers[key], QuantizedTensor):
+                    layers[key] = jax.jit(_q)(layers[key])
         self.quantize = quantize
         self.params = params
         if self.kv_layout == "slot":
@@ -211,6 +218,7 @@ class Engine:
         import collections
 
         self._waiting: "collections.deque[_Request]" = collections.deque()
+        self._outstanding: set = set()  # undone futures; failed on crash
         self._slots: dict[int, _Slot] = {}
         self._free = list(range(max_slots))
         # host mirrors of per-slot device state
@@ -219,6 +227,13 @@ class Engine:
         self._temps = np.zeros(max_slots, dtype=np.float32)
         self._top_ks = np.zeros(max_slots, dtype=np.int32)
         self._top_ps = np.ones(max_slots, dtype=np.float32)
+        # grammar constraint: per-slot automaton state (lazy-built table)
+        self._con_states = np.zeros(max_slots, dtype=np.int32)
+        self._constrained = np.zeros(max_slots, dtype=bool)
+        # table width = MODEL vocab (logits width); tokenizer vocab may be
+        # smaller — those extra logits are simply forbidden under constraint
+        self._token_table = None
+        self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         self.decode_steps = 0
@@ -236,25 +251,48 @@ class Engine:
         stop token). The block builder is shared across layouts — only the
         per-step cache update differs."""
         config = self.config
+        NEG = jnp.float32(-1e30)
 
-        def sample_first(logits, rng, temp, top_k, top_p):
-            return sample(logits[None], rng, temp[None], top_k[None], top_p[None])[0]
+        def constrain_logits(logits, table, con_state, constrained):
+            """Mask logits to grammar-legal tokens for constrained slots."""
+            allowed = table[jnp.clip(con_state, 0, table.shape[0] - 1)] >= 0  # [S, V]
+            return jnp.where(constrained[:, None] & ~allowed, NEG, logits)
+
+        def advance_constraint(table, con_state, constrained, toks):
+            nxt = table[jnp.clip(con_state, 0, table.shape[0] - 1), toks]
+            return jnp.where(constrained, nxt, con_state)
+
+        def sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained):
+            logits = constrain_logits(
+                logits[None], table, con_state[None], constrained[None]
+            )[0]
+            tok = sample(logits[None], rng, temp[None], top_k[None], top_p[None])[0]
+            new_state = advance_constraint(
+                table, con_state[None], constrained[None], tok[None]
+            )[0]
+            return tok, new_state
 
         def make_decode_block(step_fn):
-            def decode_block(params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps, *extra):
+            def decode_block(
+                params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps,
+                table, con_states, constrained, *extra,
+            ):
                 def step(carry, _):
-                    cache, tokens, seq_lens, rng = carry
+                    cache, tokens, seq_lens, con_states, rng = carry
                     rng, sub = jax.random.split(rng)
                     cache, logits = step_fn(params, cache, tokens, seq_lens, active, *extra)
+                    logits = constrain_logits(logits, table, con_states, constrained)
                     next_toks = sample(logits, sub, temps, top_ks, top_ps)
                     next_toks = jnp.where(active, next_toks, tokens)
+                    con_states = advance_constraint(table, con_states, constrained, next_toks)
                     seq_lens = seq_lens + active.astype(jnp.int32)
-                    return (cache, next_toks, seq_lens, rng), next_toks
+                    return (cache, next_toks, seq_lens, con_states, rng), next_toks
 
-                (cache, tokens, seq_lens, rng), toks = jax.lax.scan(
-                    step, (cache, tokens, seq_lens, rng), None, length=self.decode_block_size
+                (cache, tokens, seq_lens, con_states, rng), toks = jax.lax.scan(
+                    step, (cache, tokens, seq_lens, con_states, rng), None,
+                    length=self.decode_block_size,
                 )
-                return cache, toks
+                return cache, toks, con_states
 
             return jax.jit(decode_block, donate_argnums=(1,))
 
@@ -263,9 +301,10 @@ class Engine:
 
             use_pallas = self._use_pallas
 
-            def prefill_and_sample(params, pages, tokens, length, page_ids, rng, temp, top_k, top_p):
+            def prefill_and_sample(params, pages, tokens, length, page_ids, rng, temp, top_k, top_p, table, con_state, constrained):
                 pages, logits = prefill_paged(params, pages, tokens, length, page_ids, config)
-                return pages, sample_first(logits, rng, temp, top_k, top_p)
+                tok, state = sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained)
+                return pages, tok, state
 
             self._jit_prefill_paged = jax.jit(prefill_and_sample, donate_argnums=(1,))
             mesh = self.mesh
@@ -277,9 +316,10 @@ class Engine:
             )
         else:
 
-            def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p):
+            def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p, table, con_state, constrained):
                 cache, logits = prefill(params, cache, tokens, length, slot, config)
-                return cache, sample_first(logits, rng, temp, top_k, top_p)
+                tok, state = sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained)
+                return cache, tok, state
 
             self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
             self._jit_decode = make_decode_block(
@@ -321,6 +361,8 @@ class Engine:
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
             return req.future
+        self._outstanding.add(req.future)
+        req.future.add_done_callback(self._outstanding.discard)
         self._queue.put(req)
         return req.future
 
@@ -331,14 +373,22 @@ class Engine:
     # -- engine loop -----------------------------------------------------
 
     def _run(self) -> None:
-        while not self._stopping:
-            admitted = self._admit(block=not self._slots)
-            if self._stopping:
-                break
-            if not self._slots:
-                if not admitted:
-                    continue
-            self._decode_once()
+        try:
+            while not self._stopping:
+                admitted = self._admit(block=not self._slots)
+                if self._stopping:
+                    break
+                if not self._slots:
+                    if not admitted:
+                        continue
+                self._decode_once()
+        except Exception as e:  # an engine crash must not hang callers
+            log.exception("engine loop crashed")
+            self._slots.clear()
+            self._stopping = True
+            for fut in list(self._outstanding):
+                if not fut.done():
+                    fut.set_exception(RuntimeError(f"engine crashed: {e}"))
         # drain: fail any queued/waiting requests
         while True:
             try:
@@ -348,7 +398,9 @@ class Engine:
             if req is not None:
                 self._waiting.append(req)
         while self._waiting:
-            self._waiting.popleft().future.set_exception(RuntimeError("engine stopped"))
+            fut = self._waiting.popleft().future
+            if not fut.done():  # crash handler may have failed it already
+                fut.set_exception(RuntimeError("engine stopped"))
         for slot in list(self._slots):
             self._finish(slot, "stop")
 
@@ -379,6 +431,26 @@ class Engine:
             admitted = True
         return admitted
 
+    def _get_token_table(self):
+        """Lazy-build + cache the grammar token table on device."""
+        if self._token_table is None:
+            from .constrain import build_token_table
+
+            t0 = time.monotonic()
+            table = build_token_table(self.tokenizer)
+            padded = np.full(
+                (table.token_trans.shape[0], self.config.vocab_size), -1, dtype=np.int32
+            )
+            width = min(self.config.vocab_size, table.token_trans.shape[1])
+            padded[:, :width] = table.token_trans[:, :width]
+            self._token_table = jnp.asarray(padded)
+            self._table_start = table.start_state
+            log.info(
+                "built JSON constraint table: %d states x %d tokens in %.1fs",
+                *table.token_trans.shape, time.monotonic() - t0,
+            )
+        return self._token_table
+
     def _prefill_into(self, slot: int, req: _Request) -> bool:
         plen = len(req.prompt)
         bucket = _next_bucket(plen, self.prefill_buckets)
@@ -386,6 +458,14 @@ class Engine:
         tokens[:plen] = req.prompt
         self._rng, step_rng = jax.random.split(self._rng)
         s = req.sampling
+        if s.json_only:
+            table = self._get_token_table()
+            con_state0 = jnp.int32(self._table_start)
+            constrained0 = jnp.asarray(True)
+        else:
+            table = self._token_table if self._token_table is not None else self._dummy_table
+            con_state0 = jnp.int32(0)
+            constrained0 = jnp.asarray(False)
         if self.kv_layout == "paged":
             n_pages = -(-plen // self.page_size)
             if n_pages > self._allocator.num_pages - 1:
@@ -411,7 +491,7 @@ class Engine:
             self._block_tables[slot, :n_pages] = pages
             page_ids = np.full(bucket // self.page_size, TRASH_PAGE, dtype=np.int32)
             page_ids[:n_pages] = pages
-            cache, first = self._jit_prefill_paged(
+            cache, first, con_state = self._jit_prefill_paged(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
@@ -421,9 +501,12 @@ class Engine:
                 jnp.float32(s.temperature),
                 jnp.int32(s.top_k),
                 jnp.float32(s.top_p),
+                table,
+                con_state0,
+                constrained0,
             )
         else:
-            cache, first = self._jit_prefill(
+            cache, first, con_state = self._jit_prefill(
                 self.params,
                 self.cache,
                 jnp.asarray(tokens),
@@ -433,9 +516,14 @@ class Engine:
                 jnp.float32(s.temperature),
                 jnp.int32(s.top_k),
                 jnp.float32(s.top_p),
+                table,
+                con_state0,
+                constrained0,
             )
         self.cache = cache
         first_tok = int(first)
+        self._con_states[slot] = int(con_state)
+        self._constrained[slot] = bool(s.json_only)
         now = time.monotonic()
         sl = _Slot(request=req, prompt_len=plen, first_token_at=now)
         sl.generated.append(first_tok)
@@ -498,31 +586,31 @@ class Engine:
         for slot in self._slots:
             active_mask[slot] = True
         self._rng, step_rng = jax.random.split(self._rng)
+        # the real table (a large gather operand) is only passed when some
+        # slot is actually constrained; each shape is its own jit cache entry
+        use_real = self._token_table is not None and bool(self._constrained.any())
+        table = self._token_table if use_real else self._dummy_table
+        common = (
+            jnp.asarray(self._last_tokens),
+            jnp.asarray(self._seq_lens),
+            jnp.asarray(active_mask),
+            step_rng,
+            jnp.asarray(self._temps),
+            jnp.asarray(self._top_ks),
+            jnp.asarray(self._top_ps),
+            table,
+            jnp.asarray(self._con_states),
+            jnp.asarray(self._constrained),
+        )
         if self.kv_layout == "paged":
-            cache, tok_block = self._jit_decode_paged(
-                self.params,
-                self.cache,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._seq_lens),
-                jnp.asarray(active_mask),
-                step_rng,
-                jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps),
-                jnp.asarray(self._block_tables),
+            cache, tok_block, con_states = self._jit_decode_paged(
+                self.params, self.cache, *common, jnp.asarray(self._block_tables)
             )
         else:
-            cache, tok_block = self._jit_decode(
-                self.params,
-                self.cache,
-                jnp.asarray(self._last_tokens),
-                jnp.asarray(self._seq_lens),
-                jnp.asarray(active_mask),
-                step_rng,
-                jnp.asarray(self._temps),
-                jnp.asarray(self._top_ks),
-                jnp.asarray(self._top_ps),
+            cache, tok_block, con_states = self._jit_decode(
+                self.params, self.cache, *common
             )
+        self._con_states = np.array(con_states)  # copy: jax views are read-only
         self.cache = cache
         tok_block = np.asarray(tok_block)  # [K, S]
         K = tok_block.shape[0]
@@ -553,6 +641,8 @@ class Engine:
         sl = self._slots.pop(slot)
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
+        self._con_states[slot] = 0
+        self._constrained[slot] = False
         self._free.append(slot)
         if self.kv_layout == "paged":
             self._allocator.free(self._slot_pages.pop(slot, []))

@@ -89,6 +89,12 @@ class ByteTokenizer:
             parts.append(buf.decode("utf-8", errors="replace"))
         return "".join(parts)
 
+    def token_bytes(self, token: int) -> bytes | None:
+        """Byte expansion for grammar-constrained decoding (None = special)."""
+        if 0 <= token < 256:
+            return bytes([token])
+        return None
+
 
 class HFTokenizer:
     """tokenizer.json wrapper (Llama-3 checkpoints)."""
@@ -116,6 +122,43 @@ class HFTokenizer:
 
     def decode(self, tokens: Sequence[int]) -> str:
         return self._tok.decode(list(tokens), skip_special_tokens=False)
+
+    def token_bytes(self, token: int) -> bytes | None:
+        """Byte expansion via the byte-level-BPE unicode alphabet (the GPT-2
+        char<->byte table Llama-3 tokenizers use). None for specials."""
+        s = self._tok.id_to_token(token)
+        if s is None or (s.startswith("<|") and s.endswith("|>")):
+            return None
+        table = _bytelevel_char_to_byte()
+        out = bytearray()
+        for ch in s:
+            b = table.get(ch)
+            if b is None:
+                return None  # not a byte-level token (added/special)
+            out.append(b)
+        return bytes(out)
+
+
+def _bytelevel_char_to_byte() -> dict[str, int]:
+    """Inverse of GPT-2's bytes_to_unicode mapping (standard byte-level BPE
+    alphabet)."""
+    global _BYTELEVEL_TABLE
+    if _BYTELEVEL_TABLE is None:
+        bs = list(range(ord("!"), ord("~") + 1)) + list(
+            range(ord("\xa1"), ord("\xac") + 1)
+        ) + list(range(ord("\xae"), ord("\xff") + 1))
+        cs = bs[:]
+        n = 0
+        for b in range(256):
+            if b not in bs:
+                bs.append(b)
+                cs.append(256 + n)
+                n += 1
+        _BYTELEVEL_TABLE = {chr(c): b for b, c in zip(bs, cs)}
+    return _BYTELEVEL_TABLE
+
+
+_BYTELEVEL_TABLE: dict[str, int] | None = None
 
 
 # ---------------------------------------------------------------------------

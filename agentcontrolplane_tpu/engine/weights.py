@@ -37,6 +37,11 @@ _LAYER_MAP = {
     "ln2": "model.layers.{i}.post_attention_layernorm.weight",
 }
 _TRANSPOSED = {"wq", "wk", "wv", "wo", "w1", "w2", "w3"}
+_BIAS_MAP = {
+    "bq": "model.layers.{i}.self_attn.q_proj.bias",
+    "bk": "model.layers.{i}.self_attn.k_proj.bias",
+    "bv": "model.layers.{i}.self_attn.v_proj.bias",
+}
 
 
 def config_from_hf(config_path: str) -> LlamaConfig:
@@ -53,6 +58,8 @@ def config_from_hf(config_path: str) -> LlamaConfig:
         rope_theta=hf.get("rope_theta", 500000.0),
         max_seq_len=hf.get("max_position_embeddings", 8192),
         tie_embeddings=hf.get("tie_word_embeddings", False),
+        # Qwen2 checkpoints set attention_bias (or are the qwen2 model_type)
+        qkv_bias=bool(hf.get("attention_bias", hf.get("model_type") == "qwen2")),
     )
 
 
@@ -60,16 +67,28 @@ def params_from_state_dict(
     state_dict: dict[str, Any],
     config: LlamaConfig,
     put: Optional[Callable[[str, np.ndarray], jax.Array]] = None,
+    quantize: Optional[str] = None,
 ) -> dict:
     """Build the params pytree from HF-named tensors.
 
     ``state_dict`` values may be numpy arrays or torch tensors. ``put``
     receives (pytree_path, ndarray) and returns the placed jax array —
-    the seam where sharded device_put happens.
+    the seam where sharded device_put happens. With ``quantize="int8"`` the
+    layer matrices are quantized HOST-SIDE before placement, so the bf16
+    copy of an 8B model never touches the device (16GB-chip serving path).
     """
+    from ..ops.quant import QUANTIZABLE, QuantizedTensor
+
     c = config
+    if quantize not in (None, "int8"):
+        raise ValueError(f"unsupported quantization {quantize!r}")
     if put is None:
-        put = lambda path, arr: jnp.asarray(arr, dtype=c.dtype)
+        # quantized leaves keep their exact dtypes (int8 values, f32 scales);
+        # everything else is cast to the model compute dtype
+        put = lambda path, arr: jnp.asarray(
+            arr,
+            dtype=arr.dtype if path.endswith((".q", ".scale")) else c.dtype,
+        )
 
     def get(name: str) -> np.ndarray:
         t = state_dict[name]
@@ -82,14 +101,27 @@ def params_from_state_dict(
         "norm": put("norm", get("model.norm.weight")),
         "layers": {},
     }
-    for key, pattern in _LAYER_MAP.items():
+    layer_map = dict(_LAYER_MAP)
+    if c.qkv_bias:
+        layer_map.update(_BIAS_MAP)
+    for key, pattern in layer_map.items():
         mats = []
         for i in range(c.n_layers):
             m = get(pattern.format(i=i))
             if key in _TRANSPOSED:
                 m = m.T  # HF stores [out, in]; we compute x @ W as [in, out]
             mats.append(m)
-        params["layers"][key] = put(f"layers.{key}", np.stack(mats))
+        stacked = np.stack(mats)
+        if quantize == "int8" and key in QUANTIZABLE:
+            absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
+            scale = np.maximum(absmax, 1e-8) / 127.0
+            q = np.clip(np.round(stacked / scale), -127, 127).astype(np.int8)
+            params["layers"][key] = QuantizedTensor(
+                q=put(f"layers.{key}.q", q),
+                scale=put(f"layers.{key}.scale", scale.astype(np.float32)),
+            )
+        else:
+            params["layers"][key] = put(f"layers.{key}", stacked)
     if not c.tie_embeddings:
         params["lm_head"] = put("lm_head", get("lm_head.weight").T)
     return params
@@ -99,6 +131,7 @@ def load_safetensors_dir(
     path: str,
     config: Optional[LlamaConfig] = None,
     put: Optional[Callable[[str, np.ndarray], jax.Array]] = None,
+    quantize: Optional[str] = None,
 ) -> tuple[dict, LlamaConfig]:
     """Load an HF checkpoint directory (config.json + *.safetensors)."""
     from safetensors import safe_open  # lazy: not all installs ship it
@@ -112,7 +145,7 @@ def load_safetensors_dir(
         with safe_open(os.path.join(path, fname), framework="np") as f:
             for name in f.keys():
                 tensors[name] = f.get_tensor(name)
-    params = params_from_state_dict(tensors, config, put)
+    params = params_from_state_dict(tensors, config, put, quantize=quantize)
     return params, config
 
 

@@ -95,7 +95,13 @@ class DefaultLLMClientFactory:
                 raise Invalid("provider tpu requires a serving engine")
             from ..engine.client import TPUEngineClient
 
-            return TPUEngineClient(self._engine, params)
+            return TPUEngineClient(
+                self._engine,
+                params,
+                force_json_tools=bool(
+                    llm.spec.provider_config.get("force_json_tools", False)
+                ),
+            )
         if provider == "mock":
             return MockLLMClient()
         raise Invalid(f"unknown provider {provider!r}")

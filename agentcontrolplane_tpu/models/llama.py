@@ -38,6 +38,10 @@ from ..ops.rope import apply_rope
 
 @dataclass(frozen=True)
 class LlamaConfig:
+    """Covers the Llama-architecture family: Llama-3/3.x, Mistral (same
+    block; sliding window unused at our context lengths), Qwen2/2.5
+    (``qkv_bias=True``)."""
+
     vocab_size: int = 128256
     dim: int = 4096
     n_layers: int = 32
@@ -48,6 +52,7 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     max_seq_len: int = 8192
     tie_embeddings: bool = False
+    qkv_bias: bool = False  # Qwen2-style attention input bias
     dtype: Any = jnp.bfloat16
 
     @property
@@ -86,6 +91,37 @@ PRESETS: dict[str, LlamaConfig] = {
         n_heads=16,
         n_kv_heads=8,
         ffn_dim=8192,
+    ),
+    "mistral-7b": LlamaConfig(
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        rope_theta=10000.0,
+        max_seq_len=8192,
+    ),
+    "qwen2.5-7b": LlamaConfig(
+        vocab_size=152064,
+        dim=3584,
+        n_layers=28,
+        n_heads=28,
+        n_kv_heads=4,
+        ffn_dim=18944,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+    ),
+    "qwen2.5-0.5b": LlamaConfig(
+        vocab_size=151936,
+        dim=896,
+        n_layers=24,
+        n_heads=14,
+        n_kv_heads=2,
+        ffn_dim=4864,
+        rope_theta=1000000.0,
+        qkv_bias=True,
+        tie_embeddings=True,
     ),
     # tiny config for CPU tests (matches an HF config in tests)
     "tiny": LlamaConfig(
@@ -140,6 +176,10 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         },
         "norm": jnp.ones((d,), dtype=c.dtype),
     }
+    if c.qkv_bias:
+        params["layers"]["bq"] = jnp.zeros((c.n_layers, c.n_heads * hd), dtype=c.dtype)
+        params["layers"]["bk"] = jnp.zeros((c.n_layers, c.n_kv_heads * hd), dtype=c.dtype)
+        params["layers"]["bv"] = jnp.zeros((c.n_layers, c.n_kv_heads * hd), dtype=c.dtype)
     if not c.tie_embeddings:
         params["lm_head"] = (
             jax.random.normal(k_head, (d, c.vocab_size)) * scale
@@ -161,9 +201,16 @@ def _attn_mlp(
     c = config
     B, T, D = x.shape
     h = rms_norm(x, layer["ln1"], c.norm_eps)
-    q = mm(h, layer["wq"]).reshape(B, T, c.n_heads, c.head_dim)
-    k = mm(h, layer["wk"]).reshape(B, T, c.n_kv_heads, c.head_dim)
-    v = mm(h, layer["wv"]).reshape(B, T, c.n_kv_heads, c.head_dim)
+    q = mm(h, layer["wq"])
+    k = mm(h, layer["wk"])
+    v = mm(h, layer["wv"])
+    if c.qkv_bias:
+        q = q + layer["bq"]
+        k = k + layer["bk"]
+        v = v + layer["bv"]
+    q = q.reshape(B, T, c.n_heads, c.head_dim)
+    k = k.reshape(B, T, c.n_kv_heads, c.head_dim)
+    v = v.reshape(B, T, c.n_kv_heads, c.head_dim)
     q = apply_rope(q, positions, c.rope_theta)
     k = apply_rope(k, positions, c.rope_theta)
     attn = attn_fn(q, k, v)

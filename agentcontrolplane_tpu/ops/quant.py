@@ -80,19 +80,3 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
-def quantized_param_shardings(shardings: dict) -> dict:
-    """Mirror a params-sharding tree for quantized layers: q inherits the
-    weight's sharding; per-channel scales inherit the out-axis sharding."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    out = dict(shardings)
-    out["layers"] = dict(shardings["layers"])
-    for key in QUANTIZABLE:
-        s = shardings["layers"][key]
-        assert isinstance(s, NamedSharding)
-        spec = s.spec  # e.g. (None, None, 'tp') for [L, in, out]
-        scale_spec = P(spec[0], None, spec[2] if len(spec) > 2 else None)
-        out["layers"][key] = QuantizedTensor(  # type: ignore[arg-type]
-            q=s, scale=NamedSharding(s.mesh, scale_spec)
-        )
-    return out

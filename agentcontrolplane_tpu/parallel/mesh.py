@@ -64,6 +64,9 @@ def param_specs(config: LlamaConfig) -> dict:
         "layers": {
             "ln1": P(None, None),
             "ln2": P(None, None),
+            "bq": P(None, "tp"),
+            "bk": P(None, "tp"),
+            "bv": P(None, "tp"),
             "wq": P(None, None, "tp"),
             "wk": P(None, None, "tp"),
             "wv": P(None, None, "tp"),
@@ -78,11 +81,13 @@ def param_specs(config: LlamaConfig) -> dict:
 
 def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
     """NamedShardings matching the params pytree structure (drops lm_head for
-    tied-embedding configs)."""
-    specs = param_specs(config)
+    tied-embedding configs and bias specs for bias-free architectures)."""
+    specs = dict(param_specs(config))
     if "lm_head" not in params_like:
-        specs = dict(specs)
         specs.pop("lm_head")
+    layers_like = params_like.get("layers")
+    if isinstance(layers_like, dict):
+        specs["layers"] = {k: v for k, v in specs["layers"].items() if k in layers_like}
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
         specs,

@@ -1,0 +1,96 @@
+"""Grammar-constrained decoding: automaton correctness + engine guarantee
+that constrained generations always parse as JSON."""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.constrain import (
+    JsonByteAutomaton,
+    build_token_table,
+)
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+TOK = ByteTokenizer()
+
+
+def run_text(auto, text):
+    return auto.run_bytes(auto.start, text.encode())
+
+
+def test_automaton_accepts_valid_json():
+    auto = JsonByteAutomaton()
+    for text in [
+        '{"name": "web__fetch", "arguments": {"url": "https://x.com"}}',
+        '{"a": [1, 2.5, -3e2], "b": true, "c": null, "d": {}}',
+        '{ "k" : "v with spaces and \\" escape" }',
+        "{}",
+        '{"nested": {"deep": {"deeper": [{"x": 1}]}}}',
+    ]:
+        sid = run_text(auto, text)
+        assert sid >= 0 and auto.is_done(sid), text
+
+
+def test_automaton_rejects_invalid_json():
+    auto = JsonByteAutomaton()
+    for text in [
+        "not json",
+        '{"unterminated": "string',
+        '{"a": 1,,}',
+        '{"a": 1}}',  # extra closer
+        '[1, 2]',  # top level must be an object
+        '{a: 1}',  # unquoted key
+        '{"a" 1}',  # missing colon
+    ]:
+        sid = run_text(auto, text)
+        assert sid < 0 or not auto.is_done(sid), text
+
+
+def test_automaton_depth_cap():
+    auto = JsonByteAutomaton(max_depth=3)
+    assert run_text(auto, '{"a": {"b": 1}}') >= 0
+    assert run_text(auto, '{"a": {"b": {"c": {"d": 1}}}}') < 0
+
+
+def test_token_table_byte_tokenizer():
+    table = build_token_table(TOK)
+    t = table.token_trans
+    # at start: only '{' leads anywhere
+    start_allowed = {b for b in range(256) if t[table.start_state, b] >= 0}
+    assert start_allowed == {ord("{")}
+    # specials are forbidden mid-grammar
+    assert t[table.start_state, 256:].max() < 0
+
+
+def test_engine_json_only_always_parses():
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(), mesh=mesh,
+        max_slots=2, max_ctx=128, prefill_buckets=(32, 64, 128),
+    )
+    eng.start()
+    try:
+        # a RANDOM model under hot sampling — without the grammar this is
+        # line noise; with it, every completed output must parse
+        for i in range(4):
+            r = eng.generate(
+                f"tool call {i}:",
+                SamplingParams(temperature=1.2, max_tokens=120, json_only=True),
+            )
+            if r.finish_reason == "length":
+                continue  # ran out of budget mid-object: structural prefix only
+            obj = json.loads(r.text)
+            assert isinstance(obj, dict)
+        # unconstrained requests on the same engine still work
+        r = eng.generate("plain", SamplingParams(temperature=0.0, max_tokens=5))
+        assert r.finish_reason in ("stop", "length")
+    finally:
+        eng.stop()

@@ -87,3 +87,33 @@ def test_engine_serves_int8():
 def test_engine_rejects_unknown_quantization():
     with pytest.raises(ValueError, match="unsupported quantization"):
         Engine(config=TINY, quantize="fp4", mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]))
+
+
+def test_load_time_quantization_from_state_dict():
+    """HF state dict -> int8 params without a device bf16 copy."""
+    import torch
+    from transformers import LlamaConfig as HFConfig, LlamaForCausalLM
+
+    from agentcontrolplane_tpu.engine.weights import params_from_state_dict
+
+    hf_config = HFConfig(
+        vocab_size=TINY.vocab_size, hidden_size=TINY.dim,
+        num_hidden_layers=TINY.n_layers, num_attention_heads=TINY.n_heads,
+        num_key_value_heads=TINY.n_kv_heads, intermediate_size=TINY.ffn_dim,
+        rms_norm_eps=TINY.norm_eps, rope_theta=TINY.rope_theta,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = LlamaForCausalLM(hf_config).eval()
+    dense = params_from_state_dict(model.state_dict(), TINY)
+    quant = params_from_state_dict(model.state_dict(), TINY, quantize="int8")
+    assert isinstance(quant["layers"]["wq"], QuantizedTensor)
+    assert quant["layers"]["wq"].q.dtype == jnp.int8
+    assert quant["layers"]["wq"].scale.dtype == jnp.float32
+    tokens = jnp.asarray(
+        np.random.default_rng(5).integers(0, TINY.vocab_size, size=(1, 10)),
+        dtype=jnp.int32,
+    )
+    a = np.asarray(forward(dense, tokens, TINY))
+    b = np.asarray(forward(quant, tokens, TINY))
+    assert np.corrcoef(a.ravel(), b.ravel())[0, 1] > 0.999
