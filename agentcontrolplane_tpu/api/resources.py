@@ -232,6 +232,18 @@ class LocalObjectRef(APIModel):
     name: str
 
 
+class ContextPolicy(APIModel):
+    """Long-conversation control (no reference analogue: the reference
+    stores the full window unbounded and is limited only by etcd object
+    size — SURVEY.md §5 'Long-context'). ``max_messages`` caps what is SENT
+    to the LLM (the checkpointed history in status stays complete); elided
+    spans are replaced with a marker message. Compaction respects tool-call
+    protocol boundaries (a tool result is never sent without the assistant
+    message that requested it)."""
+
+    max_messages: int = 0  # 0 = unlimited
+
+
 class AgentSpec(APIModel):
     llm_ref: LocalObjectRef
     system: str
@@ -239,6 +251,7 @@ class AgentSpec(APIModel):
     mcp_servers: list[LocalObjectRef] = Field(default_factory=list)
     human_contact_channels: list[LocalObjectRef] = Field(default_factory=list)
     sub_agents: list[LocalObjectRef] = Field(default_factory=list)
+    context_policy: Optional[ContextPolicy] = None
 
 
 class ResolvedMCPServer(APIModel):
@@ -464,7 +477,8 @@ __all__ = [
     "ContactChannel", "ContactChannelSpec", "ContactChannelStatus",
     "SlackChannelConfig", "EmailChannelConfig",
     "MCPServer", "MCPServerSpec", "MCPServerStatus", "MCPTool", "EnvVar",
-    "Agent", "AgentSpec", "AgentStatus", "ResolvedMCPServer", "ResolvedSubAgent",
+    "Agent", "AgentSpec", "AgentStatus", "ContextPolicy",
+    "ResolvedMCPServer", "ResolvedSubAgent",
     "LocalObjectRef",
     "Task", "TaskSpec", "TaskStatus", "TaskPhase",
     "ToolCall", "ToolCallSpec", "ToolCallStatus", "ToolCallPhase", "ToolType",

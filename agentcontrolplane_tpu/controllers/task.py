@@ -235,8 +235,13 @@ class TaskReconciler:
         self.recorder.event(
             task, "Normal", "SendingContextWindowToLLM", "Sending context window to LLM"
         )
+        outbound = task.status.context_window
+        if agent.spec.context_policy is not None:
+            outbound = compact_window(
+                outbound, agent.spec.context_policy.max_messages
+            )
         try:
-            response = await client.send_request(task.status.context_window, tools)
+            response = await client.send_request(outbound, tools)
         except LLMRequestError as e:
             self.tracer.end_span(span, "ERROR")
             return self._llm_request_failed(task, e)
@@ -531,6 +536,36 @@ class TaskReconciler:
         span = self.tracer.start_span("EndTaskSpan", parent=task.status.span_context)
         span.set_attribute("phase", task.status.phase)
         self.tracer.end_span(span, status)
+
+
+def compact_window(window: list[Message], max_messages: int) -> list[Message]:
+    """Send-side compaction for long conversations (AgentSpec.contextPolicy):
+    keeps the leading system messages and the most recent suffix within
+    ``max_messages``, starting the suffix at a protocol-safe boundary (never
+    a tool result whose requesting assistant message was dropped). The
+    elided span is summarized by a marker message. The persisted history in
+    Task.status is untouched — this shapes only what the LLM sees."""
+    if max_messages <= 0 or len(window) <= max_messages:
+        return window
+    head = []
+    for m in window:
+        if m.role != "system":
+            break
+        head.append(m)
+    body = window[len(head) :]
+    budget = max_messages - len(head) - 1  # -1 for the elision marker
+    if budget < 1:
+        budget = 1
+    suffix = body[-budget:]
+    # protocol-safe start: drop leading tool results orphaned by the cut
+    while suffix and suffix[0].role == "tool":
+        suffix = suffix[1:]
+    elided = len(body) - len(suffix)
+    marker = Message(
+        role="system",
+        content=f"[{elided} earlier message(s) elided to fit the context policy]",
+    )
+    return head + [marker] + suffix
 
 
 def build_initial_context_window(

@@ -1,15 +1,25 @@
 """Headline benchmark: continuous-batching decode throughput per chip.
 
 Runs the serving engine (the ``provider: tpu`` data plane) on the real
-device(s): 64 concurrent requests continuously batched into one decode
-stream, Llama-3-family architecture sized to the available HBM
-(``bench-1b`` ~1.1B params bf16 on a single v5e chip; the 8B flagship
-needs the full v5e-8 and loads the same way).
+device(s): concurrent requests continuously batched into one decode stream,
+Llama-3-family architecture sized to the available HBM (``bench-1b``
+~1.1B params bf16 on a single v5e chip; the 8B flagship needs the full
+v5e-8 — or one chip with ``ACP_BENCH_QUANTIZE=int8``).
 
 Prints ONE JSON line:
   {"metric": "decode_tok_s_per_chip", "value": N, "unit": "tok/s/chip",
    "vs_baseline": N/1000}
 vs_baseline is against BASELINE.md's >1,000 tok/s/chip north-star target.
+
+Knobs (env): ACP_BENCH_PRESET, ACP_BENCH_REQUESTS, ACP_BENCH_MAX_TOKENS,
+ACP_BENCH_PROMPT_LEN, ACP_BENCH_MAX_CTX, ACP_BENCH_BLOCK,
+ACP_BENCH_KV_LAYOUT (slot|paged), ACP_BENCH_QUANTIZE (int8),
+ACP_BENCH_DEADLINE_S (wall-clock cap; partial results are reported
+honestly), ACP_BENCH_DEVICE_TIMEOUT_S (device-probe watchdog).
+
+If the accelerator cannot be reached within the watchdog window (e.g. a
+wedged tunnel), prints value 0.0 with the failure on stderr rather than
+hanging the driver.
 """
 
 from __future__ import annotations
@@ -17,71 +27,115 @@ from __future__ import annotations
 import json
 import os
 import sys
+import threading
 import time
 
 
+def _emit(value: float, note: str) -> None:
+    print(
+        json.dumps(
+            {
+                "metric": "decode_tok_s_per_chip",
+                "value": round(value, 1),
+                "unit": "tok/s/chip",
+                "vs_baseline": round(value / 1000.0, 3),
+            }
+        ),
+        flush=True,
+    )
+    print(f"# {note}", file=sys.stderr, flush=True)
+
+
+def _probe_devices(timeout_s: float):
+    """jax.devices() in a watchdog thread — a wedged PJRT tunnel hangs it."""
+    result: dict = {}
+
+    def probe():
+        try:
+            import jax
+
+            result["devices"] = jax.devices()
+        except Exception as e:  # pragma: no cover
+            result["error"] = e
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return None
+    if "error" in result:
+        raise result["error"]
+    return result.get("devices")
+
+
 def main() -> None:
-    import jax
+    preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")
+    n_requests = int(os.environ.get("ACP_BENCH_REQUESTS", "64"))
+    max_tokens = int(os.environ.get("ACP_BENCH_MAX_TOKENS", "64"))
+    prompt_len = int(os.environ.get("ACP_BENCH_PROMPT_LEN", "128"))
+    max_ctx = int(os.environ.get("ACP_BENCH_MAX_CTX", "512"))
+    block = int(os.environ.get("ACP_BENCH_BLOCK", "16"))
+    kv_layout = os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
+    quantize = os.environ.get("ACP_BENCH_QUANTIZE") or None
+    deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "420"))
+    probe_timeout = float(os.environ.get("ACP_BENCH_DEVICE_TIMEOUT_S", "120"))
+
+    devices = _probe_devices(probe_timeout)
+    if devices is None:
+        _emit(0.0, f"FAILED: accelerator unreachable within {probe_timeout:.0f}s (wedged tunnel?)")
+        return
+    n_chips = len(devices)
 
     from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
     from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
     from agentcontrolplane_tpu.models.llama import PRESETS
     from agentcontrolplane_tpu.parallel.mesh import serving_mesh
 
-    preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")
-    n_requests = int(os.environ.get("ACP_BENCH_REQUESTS", "64"))
-    max_tokens = int(os.environ.get("ACP_BENCH_MAX_TOKENS", "128"))
-    prompt_len = int(os.environ.get("ACP_BENCH_PROMPT_LEN", "128"))
-    max_ctx = int(os.environ.get("ACP_BENCH_MAX_CTX", "1024"))
-
-    n_chips = len(jax.devices())
-    config = PRESETS[preset]
     engine = Engine(
-        config=config,
+        config=PRESETS[preset],
         tokenizer=ByteTokenizer(),
         mesh=serving_mesh(),
         max_slots=n_requests,
         max_ctx=max_ctx,
         prefill_buckets=(prompt_len, max_ctx),
+        decode_block_size=block,
+        kv_layout=kv_layout,
+        quantize=quantize,
         seed=0,
     )
     engine.start()
-
-    prompt = list(range(1, prompt_len))  # token ids, avoids tokenizer cost
+    prompt = [1 + (i % 250) for i in range(prompt_len - 1)]
     sampling = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=max_tokens)
 
-    # warmup: compile prefill + decode
-    engine.generate(prompt[:prompt_len], SamplingParams(temperature=0.0, max_tokens=4))
+    # warmup: compile prefill + decode block
+    engine.generate(prompt, SamplingParams(temperature=0.0, max_tokens=block + 1))
 
     t0 = time.monotonic()
-    steps0, toks0 = engine.decode_steps, engine.tokens_generated
+    toks0 = engine.tokens_generated
     futures = [engine.submit(list(prompt), sampling) for _ in range(n_requests)]
-    results = [f.result(timeout=1200) for f in futures]
+    deadline = t0 + deadline_s
+    done = 0
+    for f in futures:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            break
+        try:
+            f.result(timeout=remaining)
+            done += 1
+        except Exception:
+            break
     elapsed = time.monotonic() - t0
+    total_tokens = engine.tokens_generated - toks0
     engine.stop()
 
-    total_tokens = sum(len(r.tokens) for r in results)
-    tok_s = total_tokens / elapsed
-    tok_s_chip = tok_s / n_chips
-    ttfts = sorted(r.ttft_ms for r in results)
-    p50_ttft = ttfts[len(ttfts) // 2]
-
-    print(
-        json.dumps(
-            {
-                "metric": "decode_tok_s_per_chip",
-                "value": round(tok_s_chip, 1),
-                "unit": "tok/s/chip",
-                "vs_baseline": round(tok_s_chip / 1000.0, 3),
-            }
-        )
+    tok_s_chip = (total_tokens / elapsed) / max(n_chips, 1)
+    note = (
+        f"{total_tokens} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
+        f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "
+        f"{done}/{n_requests} requests completed"
+        + ("" if done == n_requests else " (deadline hit; partial but honest)")
     )
-    print(
-        f"# {total_tokens} tokens in {elapsed:.2f}s on {n_chips} chip(s) "
-        f"({preset}); total {tok_s:.0f} tok/s; p50 TTFT {p50_ttft:.0f} ms "
-        f"(includes queue wait at {n_requests}-deep burst)",
-        file=sys.stderr,
-    )
+    _emit(tok_s_chip, note)
 
 
 if __name__ == "__main__":

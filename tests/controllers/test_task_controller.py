@@ -332,3 +332,56 @@ async def test_context_window_task_spec(harness):
     assert task.status.context_window[0].role == "system"
     assert task.status.context_window[0].content == "AGENT SYS"
     assert task.status.user_msg_preview == "continuing conversation"
+
+
+def test_compact_window_protocol_safe():
+    from agentcontrolplane_tpu.api.resources import MessageToolCall, ToolCallFunction
+    from agentcontrolplane_tpu.controllers.task import compact_window
+
+    window = [Message(role="system", content="sys")]
+    for i in range(6):
+        window.append(
+            Message(
+                role="assistant", content="",
+                tool_calls=[MessageToolCall(id=f"c{i}", function=ToolCallFunction(name="t__x"))],
+            )
+        )
+        window.append(Message(role="tool", content=f"r{i}", tool_call_id=f"c{i}"))
+    window.append(Message(role="user", content="latest question"))
+
+    out = compact_window(window, max_messages=6)
+    assert len(out) <= 6
+    assert out[0].content == "sys"
+    assert "elided" in out[1].content
+    # the kept suffix never starts with an orphaned tool result
+    assert out[2].role != "tool"
+    # untouched when under the cap or policy disabled
+    assert compact_window(window, 0) == window
+    assert compact_window(window[:3], 10) == window[:3]
+
+
+async def test_context_policy_applied_to_llm_request(harness):
+    store, rec, mock, recorder = harness
+    make_llm(store)
+    agent = make_agent(store)
+    from agentcontrolplane_tpu.api.resources import ContextPolicy
+
+    agent = store.get("Agent", "test-agent")
+    agent.spec.context_policy = ContextPolicy(max_messages=4)
+    store.update(agent)
+    # fabricate a long checkpointed conversation mid-loop
+    task = make_task(store)
+    task.status.phase = "ReadyForLLM"
+    task.status.context_window = (
+        [Message(role="system", content="s")]
+        + [Message(role="user" if i % 2 == 0 else "assistant", content=f"m{i}") for i in range(10)]
+    )
+    store.update_status(task)
+    mock.script.append(assistant("done"))
+    await step(rec)
+    sent = mock.requests[0].messages
+    assert len(sent) <= 4
+    assert any("elided" in m.content for m in sent)
+    # the persisted history kept EVERYTHING (checkpoint intact) + new answer
+    stored = store.get("Task", "test-task").status.context_window
+    assert len(stored) == 12
