@@ -252,10 +252,19 @@ class Store:
                 else:
                     new["metadata"]["generation"] = cur["metadata"]["generation"]
             new["metadata"]["resource_version"] = self._next_rv()
+            # admission check: a doc that cannot round-trip through its model
+            # (e.g. a handler assigned a wrong-typed field — pydantic does not
+            # validate on assignment) must never be committed, or every
+            # subsequent read of the object would fail
+            try:
+                result = from_doc(new)
+            except Exception as e:
+                self._rv -= 1
+                raise Invalid(f"invalid object state for {key}: {e}") from e
             self._objects[key] = new
             self._backend.put(new)
             self._notify("MODIFIED", new)
-        return from_doc(new)
+        return result
 
     def update(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=False)

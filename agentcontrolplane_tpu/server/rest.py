@@ -108,7 +108,9 @@ class RestServer:
         r.add_post("/v1/agents", self.create_agent)
         r.add_get("/v1/agents", self.list_agents)
         r.add_get("/v1/agents/{name}", self.get_agent)
+        r.add_patch("/v1/agents/{name}", self.update_agent)
         r.add_delete("/v1/agents/{name}", self.delete_agent)
+        r.add_delete("/v1/tasks/{name}", self.delete_task)
         r.add_post("/v1/beta3/events", self.handle_v1beta3_event)
         r.add_post("/v1/apply", self.apply_manifests)
         r.add_get("/v1/resources/{kind}", self.list_resources)
@@ -299,6 +301,60 @@ class RestServer:
                 "validSubAgents": [s.model_dump() for s in agent.status.valid_sub_agents],
             }
         )
+
+    async def update_agent(self, request: web.Request) -> web.Response:
+        """Partial update (server.go:970-1004): systemPrompt / description /
+        mcpServers / subAgents; the agent controller revalidates."""
+        from ..kernel.errors import Conflict
+
+        ns = request.query.get("namespace", "default")
+        try:
+            body = _strict_decode(
+                await request.read(),
+                {"systemPrompt", "description", "mcpServers", "subAgents"},
+            )
+        except (Invalid, json.JSONDecodeError) as e:
+            return _json_error(400, str(e))
+        for key in ("systemPrompt", "description"):
+            if key in body and not isinstance(body[key], str):
+                return _json_error(400, f"{key} must be a string")
+        for key in ("mcpServers", "subAgents"):
+            if key in body and (
+                not isinstance(body[key], list)
+                or not all(isinstance(s, str) and s for s in body[key])
+            ):
+                return _json_error(400, f"{key} must be a list of names")
+        if body.get("systemPrompt") == "":
+            return _json_error(400, "systemPrompt cannot be empty")
+
+        for _ in range(3):  # conflict-retry against concurrent status writes
+            agent = self.store.try_get("Agent", request.match_info["name"], ns)
+            if not isinstance(agent, Agent):
+                return _json_error(404, "agent not found")
+            if "systemPrompt" in body:
+                agent.spec.system = body["systemPrompt"]
+            if "description" in body:
+                agent.spec.description = body["description"]
+            if "mcpServers" in body:
+                agent.spec.mcp_servers = [LocalObjectRef(name=s) for s in body["mcpServers"]]
+            if "subAgents" in body:
+                agent.spec.sub_agents = [LocalObjectRef(name=s) for s in body["subAgents"]]
+            try:
+                updated = self.store.update(agent)
+            except Conflict:
+                continue
+            return web.json_response(
+                {"name": updated.name, "generation": updated.metadata.generation}
+            )
+        return _json_error(409, "conflict: concurrent updates, retry")
+
+    async def delete_task(self, request: web.Request) -> web.Response:
+        ns = request.query.get("namespace", "default")
+        try:
+            self.store.delete("Task", request.match_info["name"], ns)
+        except NotFound:
+            return _json_error(404, "task not found")
+        return web.json_response({"deleted": request.match_info["name"]})
 
     async def delete_agent(self, request: web.Request) -> web.Response:
         ns = request.query.get("namespace", "default")

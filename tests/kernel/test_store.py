@@ -178,3 +178,21 @@ def test_sqlite_durability_restart_resumes(tmp_path):
     s2.create(mktask("t2"))
     assert s2.get("Task", "t2").metadata.resource_version > got.metadata.resource_version
     s2.close()
+
+
+def test_update_rejects_invalid_object_state(store):
+    """A wrong-typed assignment (pydantic doesn't validate on assignment)
+    must be rejected at admission, never persisted."""
+    from agentcontrolplane_tpu.kernel.errors import Invalid
+
+    store.create(mktask("t1"))
+    t = store.get("Task", "t1")
+    t.spec.user_message = 123  # type: ignore[assignment]
+    with pytest.raises(Invalid, match="invalid object state"):
+        store.update(t)
+    # the stored object is intact and readable
+    assert store.get("Task", "t1").spec.user_message == "hi"
+    # and the store still accepts valid writes afterwards
+    fresh = store.get("Task", "t1")
+    fresh.spec.user_message = "ok"
+    assert store.update(fresh).spec.user_message == "ok"

@@ -206,3 +206,58 @@ async def test_metrics_and_health():
         assert (await resp.json())["status"] == "ok"
         resp = await h.http.get(f"{h.base}/metrics")
         assert resp.status == 200
+
+
+async def test_update_agent_patch():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        resp = await h.http.patch(
+            f"{h.base}/v1/agents/helper",
+            json={"systemPrompt": "new prompt", "description": "d2"},
+        )
+        assert resp.status == 200
+        agent = h.store.get("Agent", "helper")
+        assert agent.spec.system == "new prompt"
+        assert agent.spec.description == "d2"
+        assert agent.metadata.generation == 2  # spec change bumped generation
+
+        resp = await h.http.patch(f"{h.base}/v1/agents/helper", json={"systemPrompt": ""})
+        assert resp.status == 400
+        resp = await h.http.patch(f"{h.base}/v1/agents/helper", json={"bogus": 1})
+        assert resp.status == 400
+        resp = await h.http.patch(f"{h.base}/v1/agents/ghost", json={"description": "x"})
+        assert resp.status == 404
+
+
+async def test_delete_task_endpoint():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        h.mock.script.append(assistant("hi"))
+        resp = await h.http.post(
+            f"{h.base}/v1/tasks", json={"agentName": "helper", "userMessage": "x"}
+        )
+        name = (await resp.json())["name"]
+        resp = await h.http.delete(f"{h.base}/v1/tasks/{name}")
+        assert resp.status == 200
+        assert h.store.try_get("Task", name) is None
+        resp = await h.http.delete(f"{h.base}/v1/tasks/{name}")
+        assert resp.status == 404
+
+
+async def test_update_agent_rejects_bad_types():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        for bad in (
+            {"systemPrompt": 123},
+            {"mcpServers": "tools"},
+            {"mcpServers": [5]},
+            {"subAgents": [""]},
+        ):
+            resp = await h.http.patch(f"{h.base}/v1/agents/helper", json=bad)
+            assert resp.status == 400, bad
+        # agent untouched and still readable
+        agent = h.store.get("Agent", "helper")
+        assert agent.spec.system == "you are a helpful assistant"
