@@ -122,6 +122,7 @@ class RestServer:
         r.add_get("/v1/contacts", self.list_contacts)
         r.add_post("/v1/contacts/{call_id}/respond", self.respond)
         r.add_get("/v1/events", self.list_events)
+        r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
         r.add_get("/readyz", self.healthz)
@@ -561,6 +562,79 @@ class RestServer:
             return _json_error(400, "response (string) is required")
         b.respond(call_id, body["response"])
         return web.json_response({"callId": call_id})
+
+    # -- OpenAI-compatible serving front door (engine-direct; no reference
+    #    analogue — lets any OpenAI client target the TPU engine) ---------
+
+    async def chat_completions(self, request: web.Request) -> web.Response:
+        if self.operator.engine is None:
+            return _json_error(503, "no TPU engine configured (run with --tpu-preset/--tpu-checkpoint)")
+        try:
+            body = json.loads(await request.read())
+            raw_messages = body["messages"]
+        except (json.JSONDecodeError, KeyError) as e:
+            return _json_error(400, f"invalid request: {e}")
+        try:
+            messages = [
+                Message(
+                    role=m["role"],
+                    content=m.get("content") or "",
+                    tool_call_id=m.get("tool_call_id"),
+                )
+                for m in raw_messages
+            ]
+        except Exception as e:
+            return _json_error(400, f"invalid messages: {e}")
+        from ..engine.client import TPUEngineClient
+        from ..llmclient.base import Tool, ToolFunction
+
+        tools = [
+            Tool(
+                function=ToolFunction(
+                    name=t["function"]["name"],
+                    description=t["function"].get("description", ""),
+                    parameters=t["function"].get("parameters") or {},
+                )
+            )
+            for t in body.get("tools") or []
+        ]
+        params = BaseConfig(
+            model=body.get("model", ""),
+            temperature=body.get("temperature"),
+            max_tokens=body.get("max_tokens"),
+            top_p=body.get("top_p"),
+        )
+        client = TPUEngineClient(self.operator.engine, params)
+        try:
+            msg = await client.send_request(messages, tools)
+        except Exception as e:
+            return _json_error(500, f"generation failed: {e}")
+        out_msg: dict[str, Any] = {"role": "assistant", "content": msg.content or None}
+        if msg.tool_calls:
+            out_msg["tool_calls"] = [
+                {
+                    "id": tc.id,
+                    "type": "function",
+                    "function": {
+                        "name": tc.function.name,
+                        "arguments": tc.function.arguments,
+                    },
+                }
+                for tc in msg.tool_calls
+            ]
+        return web.json_response(
+            {
+                "object": "chat.completion",
+                "model": body.get("model", "tpu"),
+                "choices": [
+                    {
+                        "index": 0,
+                        "message": out_msg,
+                        "finish_reason": "tool_calls" if msg.tool_calls else "stop",
+                    }
+                ],
+            }
+        )
 
     # -- observability ----------------------------------------------------
 

@@ -180,6 +180,7 @@ def test_sqlite_durability_restart_resumes(tmp_path):
     s2.close()
 
 
+@pytest.mark.filterwarnings("ignore::UserWarning")  # intentional bad value
 def test_update_rejects_invalid_object_state(store):
     """A wrong-typed assignment (pydantic doesn't validate on assignment)
     must be rejected at admission, never persisted."""

@@ -261,3 +261,60 @@ async def test_update_agent_rejects_bad_types():
         # agent untouched and still readable
         agent = h.store.get("Agent", "helper")
         assert agent.spec.system == "you are a helpful assistant"
+
+
+async def test_chat_completions_endpoint():
+    """OpenAI-compatible front door straight into the TPU engine."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    try:
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions",
+                json={
+                    "model": "tiny",
+                    "messages": [
+                        {"role": "system", "content": "s"},
+                        {"role": "user", "content": "hello"},
+                    ],
+                    "max_tokens": 8,
+                    "temperature": 0,
+                },
+            )
+            assert resp.status == 200
+            body = await resp.json()
+            assert body["object"] == "chat.completion"
+            assert body["choices"][0]["finish_reason"] in ("stop", "tool_calls")
+
+            # probes: malformed body; no messages
+            resp = await h.http.post(f"{h.base}/v1/chat/completions", data=b"{broken")
+            assert resp.status == 400
+            resp = await h.http.post(f"{h.base}/v1/chat/completions", json={"model": "x"})
+            assert resp.status == 400
+    finally:
+        eng.stop()
+
+
+async def test_chat_completions_without_engine_503():
+    async with RestHarness() as h:
+        resp = await h.http.post(
+            f"{h.base}/v1/chat/completions",
+            json={"messages": [{"role": "user", "content": "x"}]},
+        )
+        assert resp.status == 503
