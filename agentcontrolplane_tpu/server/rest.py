@@ -567,48 +567,74 @@ class RestServer:
     #    analogue — lets any OpenAI client target the TPU engine) ---------
 
     async def chat_completions(self, request: web.Request) -> web.Response:
-        if self.operator.engine is None:
+        import asyncio as _asyncio
+        import time as _time
+        import uuid as _uuid
+
+        engine = self.operator.engine
+        if engine is None:
             return _json_error(503, "no TPU engine configured (run with --tpu-preset/--tpu-checkpoint)")
+        from ..engine.engine import SamplingParams
+        from ..engine.tokenizer import render_prompt
+        from ..engine.toolparse import to_message
+        from ..llmclient.base import Tool, ToolFunction
+        from ..api.resources import MessageToolCall, ToolCallFunction
+
+        # one broad parse block: ANY malformed client input is a 400
         try:
             body = json.loads(await request.read())
-            raw_messages = body["messages"]
-        except (json.JSONDecodeError, KeyError) as e:
-            return _json_error(400, f"invalid request: {e}")
-        try:
+            if not isinstance(body, dict):
+                raise ValueError("request body must be a JSON object")
             messages = [
                 Message(
                     role=m["role"],
                     content=m.get("content") or "",
                     tool_call_id=m.get("tool_call_id"),
+                    tool_calls=[
+                        MessageToolCall(
+                            id=tc.get("id", f"call_{i}"),
+                            function=ToolCallFunction(
+                                name=tc["function"]["name"],
+                                arguments=tc["function"].get("arguments") or "{}",
+                            ),
+                        )
+                        for i, tc in enumerate(m.get("tool_calls") or [])
+                    ],
                 )
-                for m in raw_messages
+                for m in body["messages"]
             ]
-        except Exception as e:
-            return _json_error(400, f"invalid messages: {e}")
-        from ..engine.client import TPUEngineClient
-        from ..llmclient.base import Tool, ToolFunction
-
-        tools = [
-            Tool(
-                function=ToolFunction(
-                    name=t["function"]["name"],
-                    description=t["function"].get("description", ""),
-                    parameters=t["function"].get("parameters") or {},
+            tools = [
+                Tool(
+                    function=ToolFunction(
+                        name=t["function"]["name"],
+                        description=t["function"].get("description", ""),
+                        parameters=t["function"].get("parameters") or {},
+                    )
                 )
+                for t in body.get("tools") or []
+            ]
+            json_only = (body.get("response_format") or {}).get("type") == "json_object"
+            sampling = SamplingParams(
+                temperature=float(body.get("temperature") or 0.0),
+                top_p=float(body["top_p"]) if body.get("top_p") is not None else 1.0,
+                max_tokens=int(body.get("max_tokens") or 512),
+                json_only=json_only,
             )
-            for t in body.get("tools") or []
-        ]
-        params = BaseConfig(
-            model=body.get("model", ""),
-            temperature=body.get("temperature"),
-            max_tokens=body.get("max_tokens"),
-            top_p=body.get("top_p"),
-        )
-        client = TPUEngineClient(self.operator.engine, params)
+        except Exception as e:
+            return _json_error(400, f"invalid request: {e}")
+
+        prompt = render_prompt(messages, tools)
         try:
-            msg = await client.send_request(messages, tools)
+            result = await _asyncio.wait_for(
+                _asyncio.wrap_future(engine.submit(prompt, sampling)), timeout=600
+            )
+        except _asyncio.TimeoutError:
+            return _json_error(504, "generation timed out")
         except Exception as e:
             return _json_error(500, f"generation failed: {e}")
+
+        allowed = {t.function.name for t in tools} if tools else None
+        msg = to_message(result.text, allowed)
         out_msg: dict[str, Any] = {"role": "assistant", "content": msg.content or None}
         if msg.tool_calls:
             out_msg["tool_calls"] = [
@@ -624,15 +650,24 @@ class RestServer:
             ]
         return web.json_response(
             {
+                "id": f"chatcmpl-{_uuid.uuid4().hex[:24]}",
                 "object": "chat.completion",
-                "model": body.get("model", "tpu"),
+                "created": int(_time.time()),
+                "model": body.get("model") or "tpu",
                 "choices": [
                     {
                         "index": 0,
                         "message": out_msg,
-                        "finish_reason": "tool_calls" if msg.tool_calls else "stop",
+                        "finish_reason": "tool_calls" if msg.tool_calls else (
+                            "length" if result.finish_reason == "length" else "stop"
+                        ),
                     }
                 ],
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": len(result.tokens),
+                    "total_tokens": result.prompt_tokens + len(result.tokens),
+                },
             }
         )
 

@@ -300,12 +300,39 @@ async def test_chat_completions_endpoint():
             assert resp.status == 200
             body = await resp.json()
             assert body["object"] == "chat.completion"
-            assert body["choices"][0]["finish_reason"] in ("stop", "tool_calls")
+            assert body["id"].startswith("chatcmpl-")
+            assert body["usage"]["completion_tokens"] >= 1
+            assert body["usage"]["total_tokens"] > body["usage"]["completion_tokens"]
+            assert body["choices"][0]["finish_reason"] in ("stop", "tool_calls", "length")
 
-            # probes: malformed body; no messages
+            # multi-turn tool history with assistant tool_calls roundtrips
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions",
+                json={
+                    "messages": [
+                        {"role": "user", "content": "fetch x"},
+                        {"role": "assistant", "content": None, "tool_calls": [
+                            {"id": "call_1", "type": "function",
+                             "function": {"name": "web__fetch", "arguments": "{}"}}]},
+                        {"role": "tool", "content": "result", "tool_call_id": "call_1"},
+                    ],
+                    "tools": [{"type": "function", "function": {"name": "web__fetch"}}],
+                    "max_tokens": 6, "temperature": 0,
+                },
+            )
+            assert resp.status == 200
+
+            # probes: malformed body; no messages; bad tools; non-object body
             resp = await h.http.post(f"{h.base}/v1/chat/completions", data=b"{broken")
             assert resp.status == 400
             resp = await h.http.post(f"{h.base}/v1/chat/completions", json={"model": "x"})
+            assert resp.status == 400
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions",
+                json={"messages": [{"role": "user", "content": "x"}], "tools": [{}]},
+            )
+            assert resp.status == 400
+            resp = await h.http.post(f"{h.base}/v1/chat/completions", json=[1, 2])
             assert resp.status == 400
     finally:
         eng.stop()
