@@ -220,6 +220,38 @@ def cmd_task_create(args) -> int:
             time.sleep(0.5)
 
 
+def cmd_task_show(args) -> int:
+    """Print a task's checkpointed conversation (the execution state)."""
+    with _client(args) as http:
+        resp = http.get(f"/v1/tasks/{args.name}")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        t = resp.json()
+        print(f"task/{t['name']}  agent={t['agentName']}  phase={t['phase']}  {t['statusDetail']}")
+        for m in t["contextWindow"]:
+            role = m["role"].upper()
+            if m.get("tool_calls"):
+                calls = ", ".join(
+                    f"{tc['function']['name']}({tc['function']['arguments']})"
+                    for tc in m["tool_calls"]
+                )
+                print(f"  [{role}] -> {calls}")
+            else:
+                content = m.get("content", "")
+                print(f"  [{role}] {content if len(content) <= 200 else content[:197] + '...'}")
+        if t.get("error"):
+            print(f"  ERROR: {t['error']}")
+    return 0
+
+
+def cmd_engine(args) -> int:
+    with _client(args) as http:
+        resp = http.get("/v1/engine")
+        print(json.dumps(resp.json(), indent=2))
+        return 0
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="acp-tpu", description=__doc__)
     p.add_argument("--server", default=DEFAULT_SERVER, help="operator REST URL")
@@ -275,6 +307,12 @@ def build_parser() -> argparse.ArgumentParser:
     tc.add_argument("message")
     tc.add_argument("--follow", action="store_true")
     tc.set_defaults(fn=cmd_task_create)
+    ts = tsub.add_parser("show", help="print a task's conversation")
+    ts.add_argument("name")
+    ts.set_defaults(fn=cmd_task_show)
+
+    eng = sub.add_parser("engine", help="TPU engine status")
+    eng.set_defaults(fn=cmd_engine)
 
     return p
 

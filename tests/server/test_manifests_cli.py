@@ -139,7 +139,20 @@ def test_cli_get_apply_against_live_server(tmp_path):
         cli_main(["--server", server, "task", "create", "my-assistant", "hi", "--follow"]) == 0
     )
     assert cli_main(["--server", server, "events"]) == 0
+    # find the created task and show its conversation
+    import httpx as _httpx
+    tasks = _httpx.get(f"{server}/v1/tasks").json()
+    done = [t for t in tasks if t["phase"] == "FinalAnswer"]
+    assert cli_main(["--server", server, "task", "show", done[0]["name"]]) == 0
+    assert cli_main(["--server", server, "task", "show", "ghost"]) == 1
+    assert cli_main(["--server", server, "engine"]) == 0
     assert cli_main(["--server", server, "delete", "Task", "hello-world-1"]) == 0
 
     threads_loop["loop"].call_soon_threadsafe(stop.set)
     t.join(timeout=10)
+
+
+async def test_engine_status_endpoint_unconfigured():
+    async with RestHarness() as h:
+        resp = await h.http.get(f"{h.base}/v1/engine")
+        assert (await resp.json()) == {"configured": False}
