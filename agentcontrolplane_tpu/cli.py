@@ -231,15 +231,17 @@ def cmd_task_show(args) -> int:
         print(f"task/{t['name']}  agent={t['agentName']}  phase={t['phase']}  {t['statusDetail']}")
         for m in t["contextWindow"]:
             role = m["role"].upper()
+            content = m.get("content", "")
+            if content:
+                print(f"  [{role}] {content if len(content) <= 200 else content[:197] + '...'}")
             if m.get("tool_calls"):
                 calls = ", ".join(
                     f"{tc['function']['name']}({tc['function']['arguments']})"
                     for tc in m["tool_calls"]
                 )
                 print(f"  [{role}] -> {calls}")
-            else:
-                content = m.get("content", "")
-                print(f"  [{role}] {content if len(content) <= 200 else content[:197] + '...'}")
+            if not content and not m.get("tool_calls"):
+                print(f"  [{role}]")
         if t.get("error"):
             print(f"  ERROR: {t['error']}")
     return 0
@@ -248,6 +250,9 @@ def cmd_task_show(args) -> int:
 def cmd_engine(args) -> int:
     with _client(args) as http:
         resp = http.get("/v1/engine")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
         print(json.dumps(resp.json(), indent=2))
         return 0
 

@@ -370,6 +370,37 @@ class Engine:
         """Synchronous helper (tests/benchmarks). Requires a started engine."""
         return self.submit(prompt, sampling).result(timeout=600)
 
+    def stats(self) -> dict:
+        """Point-in-time status snapshot (served at /v1/engine). Reads of
+        engine-thread state are racy-but-safe: ints/lens only."""
+        out = {
+            "model": {
+                "dim": self.config.dim,
+                "layers": self.config.n_layers,
+                "vocab": self.config.vocab_size,
+                "quantize": self.quantize,
+            },
+            "kv_layout": self.kv_layout,
+            "max_slots": self.max_slots,
+            "max_ctx": self.max_ctx,
+            "active_slots": len(self._slots),
+            "waiting": len(self._waiting),
+            "decode_block_size": self.decode_block_size,
+            "decode_steps": self.decode_steps,
+            "tokens_generated": self.tokens_generated,
+            "mesh": {
+                name: int(size)
+                for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
+            },
+        }
+        if self.kv_layout == "paged":
+            out["kv_pages"] = {
+                "total": self.num_pages - 1,
+                "free": self._allocator.free_count,
+                "page_size": self.page_size,
+            }
+        return out
+
     # -- engine loop -----------------------------------------------------
 
     def _run(self) -> None:

@@ -695,33 +695,7 @@ class RestServer:
         engine = self.operator.engine
         if engine is None:
             return web.json_response({"configured": False})
-        status: dict[str, Any] = {
-            "configured": True,
-            "model": {
-                "dim": engine.config.dim,
-                "layers": engine.config.n_layers,
-                "vocab": engine.config.vocab_size,
-                "quantize": engine.quantize,
-            },
-            "kv_layout": engine.kv_layout,
-            "max_slots": engine.max_slots,
-            "max_ctx": engine.max_ctx,
-            "active_slots": len(engine._slots),
-            "decode_block_size": engine.decode_block_size,
-            "decode_steps": engine.decode_steps,
-            "tokens_generated": engine.tokens_generated,
-            "mesh": {
-                name: int(size)
-                for name, size in zip(engine.mesh.axis_names, engine.mesh.devices.shape)
-            },
-        }
-        if engine.kv_layout == "paged":
-            status["kv_pages"] = {
-                "total": engine.num_pages - 1,
-                "free": engine._allocator.free_count,
-                "page_size": engine.page_size,
-            }
-        return web.json_response(status)
+        return web.json_response({"configured": True, **engine.stats()})
 
     async def metrics(self, request: web.Request) -> web.Response:
         return web.Response(text=REGISTRY.render(), content_type="text/plain")
