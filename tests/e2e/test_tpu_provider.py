@@ -8,6 +8,7 @@ random weights; the invariants are flow + batching + checkpointing.)
 """
 
 import dataclasses
+import os
 
 import pytest
 
@@ -110,3 +111,48 @@ async def test_llm_controller_validates_tpu_provider(engine):
     llm = store.get("LLM", "bad-tpu")
     assert llm.status.status == "Error"
     assert "requires a tpu config" in llm.status.status_detail
+
+
+@pytest.mark.skipif(
+    not os.environ.get("ACP_STRESS"), reason="set ACP_STRESS=1 for the full-width run"
+)
+async def test_64_concurrent_tasks_stress(engine):
+    """BASELINE config #5 at full width: 64 concurrent Task CRs continuously
+    batched into one decode stream (tiny model; CPU). Opt-in: slow."""
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.05
+    store = op.store
+    setup_with_status(
+        store,
+        LLM(
+            metadata=ObjectMeta(name="tpu-llm"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="tiny", max_tokens=8, temperature=0.0),
+                tpu=TPUProviderConfig(preset="tiny"),
+            ),
+        ),
+        lambda o: (
+            setattr(o.status, "ready", True),
+            setattr(o.status, "status", "Ready"),
+        ),
+    )
+    make_agent(store, llm="tpu-llm", system="continue")
+    n = 64
+    for i in range(n):
+        make_task(store, name=f"stress-{i}", user_message=f"p{i}")
+    await op.start()
+    try:
+        for i in range(n):
+            t = await wait_for(
+                store, "Task", f"stress-{i}", "default",
+                lambda t: t.status.phase in ("FinalAnswer", "Failed"), timeout=600,
+            )
+            assert t.status.phase == "FinalAnswer", t.status.error
+    finally:
+        await op.stop()
