@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-unit test-e2e bench run lint dryrun
+.PHONY: test test-unit test-e2e bench run lint dryrun ci
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -24,4 +24,11 @@ run:
 	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db
 
 lint:
-	$(PY) -m compileall -q agentcontrolplane_tpu
+	@if command -v ruff >/dev/null 2>&1; then \
+		ruff check agentcontrolplane_tpu tests bench.py; \
+	else \
+		echo "ruff not installed; falling back to compileall (syntax only)"; \
+		$(PY) -m compileall -q agentcontrolplane_tpu tests bench.py; \
+	fi
+
+ci: lint test dryrun
