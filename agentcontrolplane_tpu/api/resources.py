@@ -193,12 +193,24 @@ class EnvVar(APIModel):
     value_from: Optional[SecretKeyRef] = None
 
 
+class ResourceRequirements(APIModel):
+    """Subprocess resource control (mcpserver_types.go:30-39). The reference
+    forwards these to the k8s pod spec; standalone, ``limits.memory`` is
+    enforced on the stdio subprocess via RLIMIT_AS (k8s quantity strings:
+    "512Mi", "1Gi", ...). CPU limits need cgroups and are recorded but not
+    enforced."""
+
+    requests: dict[str, str] = Field(default_factory=dict)
+    limits: dict[str, str] = Field(default_factory=dict)
+
+
 class MCPServerSpec(APIModel):
     transport: Literal["stdio", "http"]
     command: Optional[str] = None
     args: list[str] = Field(default_factory=list)
     env: list[EnvVar] = Field(default_factory=list)
     url: Optional[str] = None
+    resources: Optional[ResourceRequirements] = None
     # Gates ALL tools of this server behind human approval
     # (mcpserver_types.go:30-39).
     approval_contact_channel: Optional[str] = None

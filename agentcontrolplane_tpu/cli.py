@@ -30,7 +30,11 @@ DEFAULT_SERVER = os.environ.get("ACP_TPU_SERVER", "http://127.0.0.1:8082")
 def _client(args):
     import httpx
 
-    return httpx.Client(base_url=args.server, timeout=30.0)
+    headers = {}
+    token = getattr(args, "token", None) or os.environ.get("ACP_API_TOKEN")
+    if token:
+        headers["Authorization"] = f"Bearer {token}"
+    return httpx.Client(base_url=args.server, timeout=30.0, headers=headers)
 
 
 def cmd_run(args) -> int:
@@ -70,6 +74,7 @@ def cmd_run(args) -> int:
         identity=args.identity or f"acp-tpu-{os.getpid()}",
         leader_election=args.leader_elect,
         api_port=args.port,
+        api_token=args.api_token,
         engine=engine,
     )
 
@@ -260,6 +265,11 @@ def cmd_engine(args) -> int:
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="acp-tpu", description=__doc__)
     p.add_argument("--server", default=DEFAULT_SERVER, help="operator REST URL")
+    p.add_argument(
+        "--token",
+        default=None,
+        help="bearer token for the REST API (default: $ACP_API_TOKEN)",
+    )
     sub = p.add_subparsers(dest="command", required=True)
 
     run = sub.add_parser("run", help="run the operator")
@@ -267,6 +277,11 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--port", type=int, default=8082)
     run.add_argument("--identity", default=None)
     run.add_argument("--leader-elect", action="store_true")
+    run.add_argument(
+        "--api-token",
+        default=os.environ.get("ACP_API_TOKEN", ""),
+        help="require this bearer token on the REST API (default: $ACP_API_TOKEN)",
+    )
     run.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
     run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
     run.add_argument("--tpu-slots", type=int, default=64)

@@ -79,7 +79,7 @@ class SamplingParams:
 class GenerationResult:
     text: str
     tokens: list[int]
-    finish_reason: str  # "stop" | "length"
+    finish_reason: str  # "stop" | "length" | "cancelled"
     prompt_tokens: int
     ttft_ms: float  # time to first token
     latency_ms: float
@@ -236,6 +236,10 @@ class Engine:
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        # rids whose callers abandoned the request (client timeout/disconnect);
+        # slots are released at the next engine-loop iteration so orphaned
+        # generations don't pin capacity to max_tokens
+        self._cancelled: set[str] = set()
         self.decode_steps = 0
         self.tokens_generated = 0
 
@@ -363,8 +367,21 @@ class Engine:
             return req.future
         self._outstanding.add(req.future)
         req.future.add_done_callback(self._outstanding.discard)
+        req.future.rid = req.rid  # type: ignore[attr-defined]  # cancel() handle
         self._queue.put(req)
         return req.future
+
+    def cancel(self, future: Future) -> None:
+        """Abort the request behind a Future returned by :meth:`submit`.
+        Thread-safe and best-effort: a waiting request is failed immediately
+        on the engine thread; an active slot is freed (KV pages released) at
+        the next decode iteration with finish_reason "cancelled"."""
+        rid = getattr(future, "rid", None)
+        # accept already-CANCELLED futures: asyncio.wait_for(wrap_future(f))
+        # cancels the underlying concurrent Future before the caller's
+        # except-block runs, but the slot is still decoding
+        if rid is not None and (not future.done() or future.cancelled()):
+            self._cancelled.add(rid)
 
     def generate(self, prompt: str | list[int], sampling: Optional[SamplingParams] = None) -> GenerationResult:
         """Synchronous helper (tests/benchmarks). Requires a started engine."""
@@ -450,6 +467,24 @@ class Engine:
                 self._stopping = True
                 return False
             self._waiting.append(req)
+
+        if self._cancelled and self._waiting:
+            kept = type(self._waiting)()
+            while self._waiting:
+                r = self._waiting.popleft()
+                if r.rid in self._cancelled:
+                    self._cancelled.discard(r.rid)
+                    r.future.cancel()
+                else:
+                    kept.append(r)
+            self._waiting = kept
+        if self._cancelled:
+            # purge rids that raced _finish (request already completed):
+            # anything not waiting or active now never will be, and a stale
+            # rid could collide with a future request's rid
+            live = {r.rid for r in self._waiting}
+            live.update(sl.request.rid for sl in self._slots.values())
+            self._cancelled &= live
 
         admitted = False
         while self._free and self._waiting:
@@ -596,6 +631,10 @@ class Engine:
             table.extend(new_pages)
 
     def _decode_once(self) -> None:
+        if self._cancelled:
+            for slot, sl in list(self._slots.items()):
+                if sl.request.rid in self._cancelled:
+                    self._finish(slot, "cancelled")
         if not self._slots:
             return
         K = self.decode_block_size
@@ -670,6 +709,7 @@ class Engine:
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.pop(slot)
+        self._cancelled.discard(sl.request.rid)
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._con_states[slot] = 0

@@ -72,7 +72,13 @@ def try_acquire(
 
 
 def release(store: Store, name: str, holder: str, namespace: str = "default") -> None:
-    """Delete the lease if held by ``holder`` (best-effort)."""
+    """Delete the lease if held by ``holder`` (best-effort).
+
+    The delete is guarded by the observed resource_version: if the holder
+    outlived the TTL and another replica adopted the expired lease between
+    our get and delete, the precondition fails (Conflict) and the new
+    holder's lease survives — otherwise a third replica could acquire while
+    the adopter's work is still in flight."""
     try:
         lease = store.get("Lease", name, namespace)
     except NotFound:
@@ -80,6 +86,11 @@ def release(store: Store, name: str, holder: str, namespace: str = "default") ->
     assert isinstance(lease, Lease)
     if lease.spec.holder_identity == holder:
         try:
-            store.delete("Lease", name, namespace)
-        except NotFound:
+            store.delete(
+                "Lease",
+                name,
+                namespace,
+                resource_version=lease.metadata.resource_version,
+            )
+        except (NotFound, Conflict):
             pass

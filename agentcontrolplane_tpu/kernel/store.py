@@ -51,15 +51,19 @@ class WatchEvent:
 
 
 class Backend:
-    """Durability backend interface."""
+    """Durability backend interface. ``rv`` on put/remove is the store's
+    monotonic resource_version counter at the time of the mutation; backends
+    persist it so the counter never runs backwards across restarts (a
+    re-issued rv would defeat optimistic concurrency for clients holding
+    pre-restart objects)."""
 
     def load_all(self) -> tuple[int, list[dict[str, Any]]]:
         return 0, []
 
-    def put(self, doc: dict[str, Any]) -> None:
+    def put(self, doc: dict[str, Any], rv: int = 0) -> None:
         pass
 
-    def remove(self, key: Key) -> None:
+    def remove(self, key: Key, rv: int = 0) -> None:
         pass
 
     def close(self) -> None:
@@ -81,16 +85,22 @@ class SqliteBackend(Backend):
             " kind TEXT, namespace TEXT, name TEXT, rv INTEGER, doc TEXT,"
             " PRIMARY KEY (kind, namespace, name))"
         )
+        self._conn.execute(
+            "CREATE TABLE IF NOT EXISTS meta (k TEXT PRIMARY KEY, v INTEGER)"
+        )
         self._lock = threading.Lock()
 
     def load_all(self) -> tuple[int, list[dict[str, Any]]]:
         with self._lock:
             rows = self._conn.execute("SELECT rv, doc FROM objects").fetchall()
+            meta = self._conn.execute("SELECT v FROM meta WHERE k='rv'").fetchone()
         docs = [json.loads(doc) for _, doc in rows]
+        # the persisted counter wins: max-over-live-rows alone would re-issue
+        # rvs if the highest-rv objects were deleted before the restart
         max_rv = max((rv for rv, _ in rows), default=0)
-        return max_rv, docs
+        return max(meta[0] if meta else 0, max_rv), docs
 
-    def put(self, doc: dict[str, Any]) -> None:
+    def put(self, doc: dict[str, Any], rv: int = 0) -> None:
         meta = doc["metadata"]
         with self._lock:
             self._conn.execute(
@@ -98,12 +108,18 @@ class SqliteBackend(Backend):
                 " VALUES (?, ?, ?, ?, ?)",
                 (doc["kind"], meta["namespace"], meta["name"], meta["resource_version"], json.dumps(doc)),
             )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (k, v) VALUES ('rv', ?)", (rv,)
+            )
             self._conn.commit()
 
-    def remove(self, key: Key) -> None:
+    def remove(self, key: Key, rv: int = 0) -> None:
         with self._lock:
             self._conn.execute(
                 "DELETE FROM objects WHERE kind=? AND namespace=? AND name=?", key
+            )
+            self._conn.execute(
+                "INSERT OR REPLACE INTO meta (k, v) VALUES ('rv', ?)", (rv,)
             )
             self._conn.commit()
 
@@ -187,7 +203,7 @@ class Store:
             obj.metadata.generation = 1
             doc = self._doc(obj)
             self._objects[key] = doc
-            self._backend.put(doc)
+            self._backend.put(doc, self._rv)
             self._notify("ADDED", doc)
         return from_doc(doc)
 
@@ -262,7 +278,7 @@ class Store:
                 self._rv -= 1
                 raise Invalid(f"invalid object state for {key}: {e}") from e
             self._objects[key] = new
-            self._backend.put(new)
+            self._backend.put(new, self._rv)
             self._notify("MODIFIED", new)
         return result
 
@@ -272,13 +288,32 @@ class Store:
     def update_status(self, obj: Resource) -> Resource:
         return self._update(obj, status_only=True)
 
-    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        resource_version: Optional[int] = None,
+    ) -> None:
+        """Delete; with ``resource_version`` set, a precondition delete (k8s
+        ``Preconditions.ResourceVersion``): raises Conflict if the stored
+        object has moved on — used by lease release so a holder never deletes
+        a lease another replica adopted after expiry."""
         with self._lock:
             key = (kind, namespace, name)
-            doc = self._objects.pop(key, None)
-            if doc is None:
+            cur = self._objects.get(key)
+            if cur is None:
                 raise NotFound(f"{key} not found")
-            self._backend.remove(key)
+            if (
+                resource_version is not None
+                and cur["metadata"]["resource_version"] != resource_version
+            ):
+                raise Conflict(
+                    f"{key}: resource_version {resource_version} != "
+                    f"{cur['metadata']['resource_version']}"
+                )
+            doc = self._objects.pop(key)
+            self._backend.remove(key, self._rv)
             self._notify("DELETED", doc)
             self._gc_owned(doc["metadata"]["uid"])
 

@@ -81,7 +81,15 @@ class MCPManager:
         if server.spec.transport == "stdio":
             if not server.spec.command:
                 raise Invalid("stdio MCP server requires a command")
-            return StdioMCPClient(server.spec.command, list(server.spec.args), env)
+            mem_limit = None
+            res = server.spec.resources
+            if res is not None and res.limits.get("memory"):
+                from .stdio import parse_quantity
+
+                mem_limit = parse_quantity(res.limits["memory"])
+            return StdioMCPClient(
+                server.spec.command, list(server.spec.args), env, memory_limit=mem_limit
+            )
         if server.spec.transport == "http":
             if not server.spec.url:
                 raise Invalid("http MCP server requires a url")

@@ -13,6 +13,11 @@ import json
 import os
 from typing import Any, Optional
 
+try:  # POSIX-only; imported pre-fork (an import inside preexec_fn could
+    import resource as _resource  # deadlock on the import lock in the child)
+except ImportError:  # pragma: no cover
+    _resource = None
+
 PROTOCOL_VERSION = "2024-11-05"
 
 
@@ -20,15 +25,43 @@ class MCPError(Exception):
     pass
 
 
+def parse_quantity(q: str) -> int:
+    """k8s memory quantity -> bytes ("512Mi", "1Gi", "100M", "1024")."""
+    q = q.strip()
+    units = {
+        "Ki": 1024, "Mi": 1024**2, "Gi": 1024**3, "Ti": 1024**4,
+        "K": 1000, "M": 1000**2, "G": 1000**3, "T": 1000**4, "k": 1000,
+    }
+    for suffix, mult in units.items():
+        if q.endswith(suffix):
+            return int(float(q[: -len(suffix)]) * mult)
+    return int(float(q))
+
+
 class StdioMCPClient:
-    def __init__(self, command: str, args: list[str], env: dict[str, str] | None = None):
+    def __init__(
+        self,
+        command: str,
+        args: list[str],
+        env: dict[str, str] | None = None,
+        memory_limit: int | None = None,  # bytes (spec.resources.limits.memory)
+    ):
         self.command = command
         self.args = args
         self.env = env or {}
+        self.memory_limit = memory_limit
         self._proc: Optional[asyncio.subprocess.Process] = None
         self._id = 0
         self._lock = asyncio.Lock()
         self.server_info: dict[str, Any] = {}
+
+    def _preexec(self):
+        # child-side: apply the memory limit before exec (the standalone
+        # equivalent of the reference's pod resource limits)
+        if self.memory_limit and _resource is not None:
+            _resource.setrlimit(
+                _resource.RLIMIT_AS, (self.memory_limit, self.memory_limit)
+            )
 
     async def start(self, timeout: float = 15.0) -> None:
         env = dict(os.environ)
@@ -40,6 +73,7 @@ class StdioMCPClient:
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.DEVNULL,
             env=env,
+            preexec_fn=self._preexec if self.memory_limit else None,
         )
         result = await self._request(
             "initialize",

@@ -83,7 +83,7 @@ PRESETS: dict[str, LlamaConfig] = {
         ffn_dim=8192,
         tie_embeddings=True,
     ),
-    # ~125M config sized to fill a single v5e chip nicely at batch 64
+    # ~1.1B params — sized to fill a single v5e chip nicely at batch 64
     "bench-1b": LlamaConfig(
         vocab_size=32768,
         dim=2048,

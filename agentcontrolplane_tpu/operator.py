@@ -39,6 +39,9 @@ class OperatorOptions:
     identity: str = "acp-tpu-0"
     leader_election: bool = False
     api_port: int = 8082
+    # non-empty = require "Authorization: Bearer <token>" on every REST route
+    # except health probes (reference posture: acp/cmd/main.go:167-206)
+    api_token: str = ""
     enable_rest: bool = True
     llm_probe: bool = True
     verify_channel_credentials: bool = True

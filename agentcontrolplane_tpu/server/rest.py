@@ -61,6 +61,46 @@ def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
 
 
+# health probes stay open (the reference likewise exempts healthz/readyz from
+# its metrics authn filter, acp/cmd/main.go:306-313)
+_UNAUTHENTICATED_PATHS = {"/healthz", "/readyz"}
+
+
+def _auth_middleware(token: str):
+    """Bearer-token authn for every route except health probes — the
+    standalone stand-in for the reference's authn/authz-filtered serving
+    posture (acp/cmd/main.go:167-206). Enabled when a token is configured
+    (--api-token / ACP_API_TOKEN); default off for localhost dev."""
+    import hmac
+
+    expected = f"Bearer {token}".encode()
+
+    @web.middleware
+    async def middleware(request: web.Request, handler):
+        if request.path not in _UNAUTHENTICATED_PATHS:
+            supplied = request.headers.get("Authorization", "")
+            # compare bytes: compare_digest on str raises on non-ASCII input
+            if not hmac.compare_digest(
+                supplied.encode("utf-8", "surrogateescape"), expected
+            ):
+                return _json_error(401, "unauthorized")
+        return await handler(request)
+
+    return middleware
+
+
+def _redact_secrets(manifest: dict[str, Any]) -> dict[str, Any]:
+    """Blank Secret payloads on read endpoints. The reference never serves
+    Secret contents over its REST API at all (routes:
+    acp/internal/server/server.go:132-156; Secrets sit behind k8s RBAC);
+    we keep the object GETtable for kubectl-style UX but redact the data."""
+    if manifest.get("kind") == "Secret":
+        data = (manifest.get("spec") or {}).get("data")
+        if data:
+            manifest["spec"]["data"] = {k: "<redacted>" for k in data}
+    return manifest
+
+
 def _strict_decode(raw: bytes, allowed: set[str]) -> dict[str, Any]:
     """DisallowUnknownFields equivalent (server.go:1288-1306)."""
     body = json.loads(raw)
@@ -95,7 +135,12 @@ class RestServer:
         self.store = operator.store
         self.host = host
         self.port = port if port is not None else operator.options.api_port
-        self.app = web.Application()
+        # options only — the CLI already defaults --api-token from
+        # $ACP_API_TOKEN; a second env lookup here would silently flip auth
+        # on for embedded/test servers
+        self.api_token = operator.options.api_token
+        middlewares = [_auth_middleware(self.api_token)] if self.api_token else []
+        self.app = web.Application(middlewares=middlewares)
         self._register_routes()
         self._runner: Optional[web.AppRunner] = None
         self.bound_port: Optional[int] = None
@@ -481,7 +526,7 @@ class RestServer:
                 if "=" in part
             )
         objs = self.store.list(kind, ns, label_selector=selector)
-        return web.json_response([resource_to_manifest(o) for o in objs])
+        return web.json_response([_redact_secrets(resource_to_manifest(o)) for o in objs])
 
     async def get_resource(self, request: web.Request) -> web.Response:
         from ..api.manifests import resource_to_manifest
@@ -494,7 +539,7 @@ class RestServer:
         obj = self.store.try_get(kind, request.match_info["name"], ns)
         if obj is None:
             return _json_error(404, "not found")
-        return web.json_response(resource_to_manifest(obj))
+        return web.json_response(_redact_secrets(resource_to_manifest(obj)))
 
     async def delete_resource(self, request: web.Request) -> web.Response:
         from ..api.resources import KINDS
@@ -621,16 +666,22 @@ class RestServer:
                 max_tokens=int(body.get("max_tokens") or 512),
                 json_only=json_only,
             )
+            # render here too: a client-supplied assistant history message
+            # with unparseable tool_calls[].function.arguments is malformed
+            # *client* input and must 400, not 500
+            prompt = render_prompt(messages, tools)
         except Exception as e:
             return _json_error(400, f"invalid request: {e}")
 
-        prompt = render_prompt(messages, tools)
+        fut = engine.submit(prompt, sampling)
         try:
-            result = await _asyncio.wait_for(
-                _asyncio.wrap_future(engine.submit(prompt, sampling)), timeout=600
-            )
+            result = await _asyncio.wait_for(_asyncio.wrap_future(fut), timeout=600)
         except _asyncio.TimeoutError:
+            engine.cancel(fut)  # free the slot; don't decode for a gone caller
             return _json_error(504, "generation timed out")
+        except _asyncio.CancelledError:
+            engine.cancel(fut)  # client disconnected mid-generation
+            raise
         except Exception as e:
             return _json_error(500, f"generation failed: {e}")
 

@@ -97,3 +97,29 @@ def test_temperature_sampling_varies(engine):
 def test_long_prompt_truncated_not_crashing(engine):
     r = engine.generate("z" * 500, SamplingParams(temperature=0.0, max_tokens=4))
     assert r.prompt_tokens < 500
+
+
+def test_cancel_frees_slot_and_waiting_request(engine):
+    """cancel() aborts abandoned requests (client timeout/disconnect): an
+    active slot is released at the next decode iteration instead of decoding
+    to max_tokens; a still-waiting request is cancelled outright."""
+    import time as _time
+
+    # fill every slot with long generations, plus one waiting request
+    futs = [
+        engine.submit("spin " * 4, SamplingParams(temperature=0.7, max_tokens=10_000))
+        for _ in range(engine.max_slots + 1)
+    ]
+    for f in futs:
+        engine.cancel(f)
+    deadline = _time.monotonic() + 30
+    for f in futs:
+        try:
+            r = f.result(timeout=max(0.1, deadline - _time.monotonic()))
+            assert r.finish_reason == "cancelled"
+        except Exception:
+            assert f.cancelled()
+    # engine is healthy and capacity fully recovered
+    r = engine.generate("after", SamplingParams(temperature=0.0, max_tokens=4))
+    assert len(r.tokens) >= 1
+    assert engine.stats()["active_slots"] == 0

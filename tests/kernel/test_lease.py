@@ -37,3 +37,28 @@ def test_release_only_by_holder(store):
     lease.release(store, "l", "pod-a")
     assert store.try_get("Lease", "l") is None
     lease.release(store, "l", "pod-a")  # idempotent
+
+
+def test_release_does_not_delete_adopted_lease(store):
+    """rv-guarded release: holder A outlives its TTL, B adopts the expired
+    lease, then A's deferred release must NOT delete B's lease (it would
+    let a third replica acquire while B's work is in flight)."""
+    lease.try_acquire(store, "l", "pod-a", ttl=30, now=100.0)
+    stale = store.get("Lease", "l")  # what pod-a would observe pre-release
+    assert lease.try_acquire(store, "l", "pod-b", ttl=30, now=131.0)  # adopt
+
+    # simulate pod-a's get-then-delete racing the adoption: the precondition
+    # delete with the stale rv must be refused
+    from agentcontrolplane_tpu.kernel.errors import Conflict
+
+    try:
+        store.delete("Lease", "l", resource_version=stale.metadata.resource_version)
+        raised = False
+    except Conflict:
+        raised = True
+    assert raised
+    assert store.get("Lease", "l").spec.holder_identity == "pod-b"
+
+    # and the release() helper itself (re-gets, sees holder b) is a no-op
+    lease.release(store, "l", "pod-a")
+    assert store.get("Lease", "l").spec.holder_identity == "pod-b"

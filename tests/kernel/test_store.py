@@ -197,3 +197,37 @@ def test_update_rejects_invalid_object_state(store):
     fresh = store.get("Task", "t1")
     fresh.spec.user_message = "ok"
     assert store.update(fresh).spec.user_message == "ok"
+
+
+def test_rv_counter_survives_restart_after_deletes(tmp_path):
+    """The monotonic resource_version counter is persisted (meta table), so
+    deleting the highest-rv objects before a restart cannot cause previously
+    issued rvs to be re-issued afterwards (which would defeat optimistic
+    concurrency for clients holding pre-restart objects)."""
+    path = str(tmp_path / "state.db")
+    s1 = Store(SqliteBackend(path))
+    keep = s1.create(mktask("keep"))
+    hot = s1.create(mktask("hot"))
+    hot = s1.update_status(hot)  # bump rv further
+    high_rv = hot.metadata.resource_version
+    assert high_rv > keep.metadata.resource_version
+    s1.delete("Task", "hot")
+    s1.close()
+
+    s2 = Store(SqliteBackend(path))
+    fresh = s2.create(mktask("fresh"))
+    assert fresh.metadata.resource_version > high_rv
+    s2.close()
+
+
+def test_precondition_delete(store):
+    obj = store.create(mktask("l1"))
+    old_rv = obj.metadata.resource_version
+    obj2 = store.get("Task", "l1")
+    store.update_status(obj2)  # rv moves on
+    with pytest.raises(Conflict):
+        store.delete("Task", "l1", resource_version=old_rv)
+    assert store.try_get("Task", "l1") is not None
+    cur = store.get("Task", "l1")
+    store.delete("Task", "l1", resource_version=cur.metadata.resource_version)
+    assert store.try_get("Task", "l1") is None

@@ -147,3 +147,31 @@ def test_flatten_mixed_content():
         }
     )
     assert out == 'line1\n{"type": "image", "data": "abc"}\nline2'
+
+
+def test_parse_quantity():
+    from agentcontrolplane_tpu.mcp.stdio import parse_quantity
+
+    assert parse_quantity("512Mi") == 512 * 1024**2
+    assert parse_quantity("1Gi") == 1024**3
+    assert parse_quantity("100M") == 100_000_000
+    assert parse_quantity("2048") == 2048
+    assert parse_quantity("1.5Gi") == int(1.5 * 1024**3)
+
+
+async def test_stdio_memory_limit_applied(store):
+    """spec.resources.limits.memory (mcpserver_types.go:30-39) maps to
+    RLIMIT_AS on the stdio subprocess: a generous limit still lets the
+    server run; the client records the parsed byte count."""
+    from agentcontrolplane_tpu.api.resources import ResourceRequirements
+
+    spec = echo_server_spec(name="limited")
+    spec.spec.resources = ResourceRequirements(limits={"memory": "1Gi"})
+    mgr = MCPManager(store)
+    try:
+        conn = await mgr.connect_server(spec)
+        assert conn.client.memory_limit == 1024**3
+        out = await mgr.call_tool("limited", "echo", {"message": "hi"})
+        assert "hi" in out
+    finally:
+        await mgr.close()

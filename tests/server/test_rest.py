@@ -19,7 +19,7 @@ from ..fixtures import make_agent, make_llm
 
 
 class RestHarness:
-    def __init__(self):
+    def __init__(self, **opts):
         self.mock = MockLLMClient()
         self.operator = Operator(
             options=OperatorOptions(
@@ -27,6 +27,7 @@ class RestHarness:
                 api_port=0,  # ephemeral
                 llm_probe=False,
                 verify_channel_credentials=False,
+                **opts,
             ),
             llm_factory=MockLLMClientFactory(self.mock),
         )
@@ -334,6 +335,21 @@ async def test_chat_completions_endpoint():
             assert resp.status == 400
             resp = await h.http.post(f"{h.base}/v1/chat/completions", json=[1, 2])
             assert resp.status == 400
+            # assistant history with unparseable tool_calls arguments is
+            # malformed CLIENT input: 400, not an unhandled 500
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions",
+                json={
+                    "messages": [
+                        {"role": "user", "content": "x"},
+                        {"role": "assistant", "content": None, "tool_calls": [
+                            {"id": "c1", "type": "function",
+                             "function": {"name": "f", "arguments": "{broken"}}]},
+                        {"role": "tool", "content": "r", "tool_call_id": "c1"},
+                    ],
+                },
+            )
+            assert resp.status == 400
     finally:
         eng.stop()
 
@@ -345,3 +361,46 @@ async def test_chat_completions_without_engine_503():
             json={"messages": [{"role": "user", "content": "x"}]},
         )
         assert resp.status == 503
+
+
+async def test_secret_data_redacted_on_resource_endpoints():
+    """Generic resource GET/LIST must never serve Secret payloads (the
+    reference never exposes Secrets over REST at all; server.go:132-156)."""
+    from agentcontrolplane_tpu.api import ObjectMeta
+    from agentcontrolplane_tpu.api.resources import Secret, SecretSpec
+
+    async with RestHarness() as h:
+        h.store.create(
+            Secret(
+                metadata=ObjectMeta(name="llm-key"),
+                spec=SecretSpec(data={"api-key": "sk-super-secret"}),
+            )
+        )
+        resp = await h.http.get(f"{h.base}/v1/resources/Secret/llm-key")
+        assert resp.status == 200
+        text = await resp.text()
+        assert "sk-super-secret" not in text
+        assert (await h.http.get(f"{h.base}/v1/resources/Secret/llm-key")).status == 200
+        resp = await h.http.get(f"{h.base}/v1/resources/Secret")
+        assert "sk-super-secret" not in await resp.text()
+        body = await (await h.http.get(f"{h.base}/v1/resources/Secret/llm-key")).json()
+        assert body["spec"]["data"] == {"api-key": "<redacted>"}
+        # the controllers still read the real value from the store
+        assert h.store.get("Secret", "llm-key").spec.data["api-key"] == "sk-super-secret"
+
+
+async def test_bearer_token_auth():
+    """With api_token configured every route except health probes requires
+    Authorization: Bearer <token> (reference authn posture, cmd/main.go:167-206)."""
+    h = RestHarness(api_token="t0ps3cret")
+    async with h:
+        assert (await h.http.get(f"{h.base}/v1/tasks")).status == 401
+        assert (await h.http.get(f"{h.base}/healthz")).status == 200
+        ok = await h.http.get(
+            f"{h.base}/v1/tasks", headers={"Authorization": "Bearer t0ps3cret"}
+        )
+        assert ok.status == 200
+        bad = await h.http.get(
+            f"{h.base}/v1/tasks", headers={"Authorization": "Bearer wrong"}
+        )
+        assert bad.status == 401
