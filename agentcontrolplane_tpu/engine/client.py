@@ -21,22 +21,59 @@ from .toolparse import to_message
 
 
 class TPUEngineClient(LLMClient):
-    def __init__(self, engine: Engine, params: BaseConfig, force_json_tools: bool = False):
+    def __init__(
+        self,
+        engine: Engine,
+        params: BaseConfig,
+        force_json_tools: bool = False,
+        tool_choice: str = "auto",
+    ):
         self.engine = engine
         self.params = params
         # LLM.spec.providerConfig["force_json_tools"]: grammar-constrain the
         # response to a JSON object whenever tools are offered (guaranteed
         # parseable tool calls at the cost of forbidding prose answers)
         self.force_json_tools = force_json_tools
+        # LLM.spec.providerConfig["tool_choice"]: "auto" (default), "required"
+        # (force a call to the single offered tool; with several tools it
+        # falls back to json_only), or an explicit tool name. Forcing
+        # teacher-forces the '{"name": "X", "arguments": {' envelope and
+        # grammar-constrains the rest — the completion is ALWAYS a parseable
+        # call to X (OpenAI tool_choice parity, done TPU-side).
+        self.tool_choice = tool_choice
+
+    def _forced_call(self, tools: list[Tool]) -> tuple:
+        if not tools:
+            return ()
+        name = None
+        if self.tool_choice == "required" and len(tools) == 1:
+            name = tools[0].function.name
+        elif self.tool_choice not in ("auto", "required", "none", ""):
+            offered = {t.function.name for t in tools}
+            if self.tool_choice in offered:
+                name = self.tool_choice
+        if name is None:
+            return ()
+        import json as _json
+
+        # json.dumps escapes quotes/backslashes in exotic tool names — an
+        # unescaped name would be an illegal prefix and fail every request
+        prefix = f'{{"name": {_json.dumps(name)}, "arguments": {{'
+        return tuple(self.engine.tokenizer.encode(prefix))
 
     async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
         prompt = render_prompt(messages, tools)
+        forced = self._forced_call(tools)
+        # "required" with several tools can't force ONE envelope; it still
+        # demands a tool call, so fall back to grammar-constrained JSON
+        json_required = self.tool_choice == "required"
         sampling = SamplingParams(
             temperature=self.params.temperature or 0.0,
             top_k=self.params.top_k or 0,
             top_p=self.params.top_p if self.params.top_p is not None else 1.0,
             max_tokens=self.params.max_tokens or 512,
-            json_only=bool(self.force_json_tools and tools),
+            json_only=bool((self.force_json_tools or forced or json_required) and tools),
+            forced_prefix=forced,
         )
         future = self.engine.submit(prompt, sampling)
         try:

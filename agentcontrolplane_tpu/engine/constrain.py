@@ -16,10 +16,10 @@ ON DEVICE inside the decode block:
     allowed = token_trans[state] >= 0        # [V] mask for the next token
     state'  = token_trans[state, token]      # after sampling
 
-Numbers/literals are validated loosely (digit/letter runs) — the guarantee
-is structural validity, which is what keeps the ToolCall state machine fed;
-``json.loads`` failures drop from "model rambled prose" to "malformed
-number", which the loose grammar makes vanishingly rare.
+Literals are matched exactly (``true``/``false``/``null``) and numbers
+follow the full JSON number grammar (sign, no leading zeros, fraction,
+exponent), so a constrained generation that reaches DONE always
+``json.loads`` cleanly — the guarantee is total, not just structural.
 """
 
 from __future__ import annotations
@@ -30,7 +30,7 @@ from typing import Iterable, Optional
 
 import numpy as np
 
-# modes
+# modes (compound modes are tuples: (IN_NUMBER, sub) / (IN_LITERAL, rest))
 START = 0  # expect '{' (or whitespace)
 EXPECT_KEY = 1  # inside object: '"' or '}'
 IN_KEY = 2
@@ -40,15 +40,20 @@ EXPECT_VALUE = 5  # after ':' / '[' / ',' in array
 IN_STRING = 6
 IN_STRING_ESC = 7
 AFTER_VALUE = 8  # expect ',' or closer
-IN_NUMBER = 9
-IN_LITERAL = 10  # true/false/null (loose letter run)
+IN_NUMBER = 9  # (IN_NUMBER, sub): full JSON number DFA
+IN_LITERAL = 10  # (IN_LITERAL, rest): exact true/false/null suffix
 DONE = 11
+EXPECT_KEY_REQ = 12  # after ',' in object: '"' only (no trailing comma)
+EXPECT_VALUE_REQ = 13  # after ',' in array: value only (no trailing comma)
+IN_KEY_U = 14  # (IN_KEY_U, n): n hex digits of \uXXXX left in a key
+IN_STRING_U = 15  # (IN_STRING_U, n): same, in a value string
 
 _WS = b" \t\n\r"
-_NUM_START = b"-0123456789"
-_NUM_CONT = b"0123456789.eE+-"
-_LIT_START = b"tfn"
-_LIT_CONT = b"abcdefghijklmnopqrstuvwxyz"
+_DIGITS = b"0123456789"
+_ESCAPABLE = b'"\\/bfnrt'  # the only legal single-char escapes
+_HEX = b"0123456789abcdefABCDEF"
+# EXPECT_VALUE byte -> remaining literal suffix
+_LITERALS = {b"t": b"rue", b"f": b"alse", b"n": b"ull"}
 
 OBJ, ARR = 0, 1
 
@@ -88,12 +93,14 @@ class JsonByteAutomaton:
             if ch == b"{":
                 return (EXPECT_KEY, (OBJ,))
             return None
-        if mode == EXPECT_KEY:
+        if mode in (EXPECT_KEY, EXPECT_KEY_REQ):
             if ch in _WS:
                 return state
             if ch == b'"':
                 return (IN_KEY, stack)
-            if ch == b"}" and stack and stack[-1] == OBJ:
+            # '}' only legal for an EMPTY object — after a comma it would be
+            # a trailing comma, which json.loads rejects
+            if ch == b"}" and mode == EXPECT_KEY and stack and stack[-1] == OBJ:
                 return close_container()
             return None
         if mode == IN_KEY:
@@ -105,14 +112,23 @@ class JsonByteAutomaton:
                 return None
             return state
         if mode == IN_KEY_ESC:
-            return (IN_KEY, stack)
+            if ch in _ESCAPABLE:
+                return (IN_KEY, stack)
+            if ch == b"u":
+                return ((IN_KEY_U, 4), stack)
+            return None
+        if isinstance(mode, tuple) and mode[0] == IN_KEY_U:
+            if ch in _HEX:
+                n = mode[1] - 1
+                return (IN_KEY, stack) if n == 0 else ((IN_KEY_U, n), stack)
+            return None
         if mode == AFTER_KEY:
             if ch in _WS:
                 return state
             if ch == b":":
                 return (EXPECT_VALUE, stack)
             return None
-        if mode == EXPECT_VALUE:
+        if mode in (EXPECT_VALUE, EXPECT_VALUE_REQ):
             if ch in _WS:
                 return state
             if ch == b'"':
@@ -125,12 +141,17 @@ class JsonByteAutomaton:
                 if len(stack) >= self.max_depth:
                     return None
                 return (EXPECT_VALUE, stack + (ARR,))
-            if ch == b"]" and stack and stack[-1] == ARR:
-                return close_container()  # empty array
-            if ch in _NUM_START:
-                return (IN_NUMBER, stack)
-            if ch in _LIT_START:
-                return (IN_LITERAL, stack)
+            # ']' closes only an EMPTY array (not after a comma)
+            if ch == b"]" and mode == EXPECT_VALUE and stack and stack[-1] == ARR:
+                return close_container()
+            if ch == b"-":
+                return ((IN_NUMBER, "minus"), stack)
+            if ch == b"0":
+                return ((IN_NUMBER, "zero"), stack)
+            if ch in b"123456789":
+                return ((IN_NUMBER, "int"), stack)
+            if ch in _LITERALS:
+                return ((IN_LITERAL, _LITERALS[ch]), stack)
             return None
         if mode == IN_STRING:
             if ch == b'"':
@@ -141,25 +162,72 @@ class JsonByteAutomaton:
                 return None
             return state
         if mode == IN_STRING_ESC:
-            return (IN_STRING, stack)
-        if mode in (AFTER_VALUE, IN_NUMBER, IN_LITERAL):
-            # number/literal terminators fall through to AFTER_VALUE handling
-            if mode == IN_NUMBER and ch in _NUM_CONT:
-                return state
-            if mode == IN_LITERAL and ch in _LIT_CONT:
-                return state
+            if ch in _ESCAPABLE:
+                return (IN_STRING, stack)
+            if ch == b"u":
+                return ((IN_STRING_U, 4), stack)
+            return None
+        if isinstance(mode, tuple) and mode[0] == IN_STRING_U:
+            if ch in _HEX:
+                n = mode[1] - 1
+                return (IN_STRING, stack) if n == 0 else ((IN_STRING_U, n), stack)
+            return None
+        def after_value(ch):
+            """',' / closer / whitespace handling shared by AFTER_VALUE and
+            complete-number termination."""
             if ch in _WS:
                 return (AFTER_VALUE, stack)
             if ch == b",":
                 if stack and stack[-1] == OBJ:
-                    return (EXPECT_KEY, stack)
+                    return (EXPECT_KEY_REQ, stack)
                 if stack and stack[-1] == ARR:
-                    return (EXPECT_VALUE, stack)
+                    return (EXPECT_VALUE_REQ, stack)
                 return None
             if ch == b"}" and stack and stack[-1] == OBJ:
                 return close_container()
             if ch == b"]" and stack and stack[-1] == ARR:
                 return close_container()
+            return None
+
+        if mode == AFTER_VALUE:
+            return after_value(ch)
+        if isinstance(mode, tuple) and mode[0] == IN_LITERAL:
+            rest = mode[1]
+            if ch == rest[:1]:
+                rest = rest[1:]
+                return ((IN_LITERAL, rest), stack) if rest else (AFTER_VALUE, stack)
+            return None
+        if isinstance(mode, tuple) and mode[0] == IN_NUMBER:
+            sub = mode[1]
+            if sub == "minus":  # need first digit
+                if ch == b"0":
+                    return ((IN_NUMBER, "zero"), stack)
+                if ch in b"123456789":
+                    return ((IN_NUMBER, "int"), stack)
+                return None
+            if sub == "frac_dot":  # '.' needs at least one digit
+                return ((IN_NUMBER, "frac"), stack) if ch in _DIGITS else None
+            if sub == "exp_e":  # e/E needs sign or digit
+                if ch in b"+-":
+                    return ((IN_NUMBER, "exp_sign"), stack)
+                return ((IN_NUMBER, "exp"), stack) if ch in _DIGITS else None
+            if sub == "exp_sign":
+                return ((IN_NUMBER, "exp"), stack) if ch in _DIGITS else None
+            # complete-number states: may extend or terminate
+            if sub == "int" and ch in _DIGITS:
+                return state
+            if sub in ("zero", "int") and ch == b".":
+                return ((IN_NUMBER, "frac_dot"), stack)
+            if sub == "frac" and ch in _DIGITS:
+                return state
+            if sub == "exp" and ch in _DIGITS:
+                return state
+            if sub in ("zero", "int", "frac", "exp") and ch in b"eE":
+                if sub != "exp":
+                    return ((IN_NUMBER, "exp_e"), stack)
+                return None
+            if sub in ("zero", "int", "frac", "exp"):
+                return after_value(ch)
             return None
         if mode == DONE:
             if ch in _WS:
@@ -193,6 +261,33 @@ class JsonByteAutomaton:
     def is_done(self, sid: int) -> bool:
         return self._states[sid][0] == DONE
 
+    def min_close_distances(self) -> np.ndarray:
+        """[n_states] — minimum BYTES from each state to a DONE state
+        (reverse BFS over the byte graph). Drives budget-aware masking: with
+        k tokens left, only tokens whose next state can still close within
+        k-1 are allowed, so a constrained generation ALWAYS completes inside
+        its max_tokens (every closing byte is a single-byte token in
+        practice: quotes, digits, braces)."""
+        n = self.n_states
+        rev: list[list[int]] = [[] for _ in range(n)]
+        for s in range(n):
+            for t in set(int(x) for x in self._trans[s] if x >= 0):
+                rev[t].append(s)
+        INF = np.int32(2**15 - 1)
+        dist = np.full(n, INF, dtype=np.int32)
+        frontier = [s for s in range(n) if self.is_done(s)]
+        for s in frontier:
+            dist[s] = 0
+        while frontier:
+            nxt_frontier = []
+            for t in frontier:
+                for s in rev[t]:
+                    if dist[s] > dist[t] + 1:
+                        dist[s] = dist[t] + 1
+                        nxt_frontier.append(s)
+            frontier = nxt_frontier
+        return dist
+
     def run_bytes(self, sid: int, data: bytes) -> int:
         """-1 if the byte run is illegal from sid."""
         for b in data:
@@ -209,6 +304,8 @@ class TokenTable:
 
     token_trans: np.ndarray  # [n_states, vocab] int32
     start_state: int
+    # [n_states] min bytes to a DONE state (see min_close_distances)
+    min_close: np.ndarray = None  # type: ignore[assignment]
 
     @property
     def n_states(self) -> int:
@@ -248,4 +345,8 @@ def build_token_table(
         # DONE states admit no non-stop tokens (force immediate stop)
         v = np.where(done_mask, -1, v)
         table[:, tok] = v.astype(np.int16)
-    return TokenTable(token_trans=table, start_state=auto.start)
+    return TokenTable(
+        token_trans=table,
+        start_state=auto.start,
+        min_close=auto.min_close_distances().astype(np.int16),
+    )

@@ -28,6 +28,7 @@ lease layer never serializes the engine.
 from __future__ import annotations
 
 import dataclasses
+import heapq
 import logging
 import queue
 import threading
@@ -47,7 +48,7 @@ from ..models.llama import (
     PRESETS,
     decode_step,
     init_kv_cache,
-    prefill,
+    prefill_batch,
 )
 from ..observability.metrics import REGISTRY
 from ..ops.paged import TRASH_PAGE
@@ -69,10 +70,16 @@ class SamplingParams:
     temperature: float = 0.0
     top_k: int = 0
     top_p: float = 1.0
-    max_tokens: int = 512
+    max_tokens: int = 512  # budget for SAMPLED tokens (forced prefix is free)
     # grammar-constrained decoding: force a structurally valid JSON object
     # (engine/constrain.py); generation ends when the object closes
     json_only: bool = False
+    # teacher-forced generation prefix (token ids): prefilled with the
+    # prompt, returned as part of the output, and — with json_only — the
+    # constraint automaton is seeded past it. This is how tool_choice
+    # "required" forces the '{"name": "X", "arguments": {' envelope so the
+    # completion is guaranteed to be a parseable call to X.
+    forced_prefix: tuple = ()
 
 
 @dataclass
@@ -99,6 +106,7 @@ class _Slot:
     request: _Request
     generated: list[int] = field(default_factory=list)
     prompt_len: int = 0
+    prefix_len: int = 0  # leading forced tokens in ``generated``
     first_token_at: float = 0.0
 
 
@@ -119,6 +127,8 @@ class Engine:
         max_slots: int = 64,
         max_ctx: int = 2048,
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
+        prefill_batch_max: int = 8,  # burst admissions batch up to this many prompts
+        width_buckets: Sequence[int] = (1, 2, 4, 8),  # low-occupancy decode widths
         decode_block_size: int = 8,
         kv_layout: str = "slot",  # "slot" | "paged"
         page_size: int = 16,
@@ -141,6 +151,13 @@ class Engine:
             self.max_ctx
         ]
         self.mesh = mesh if mesh is not None else serving_mesh()
+        self.prefill_batch_max = max(1, prefill_batch_max)
+        # decode dispatch widths: smallest bucket covering the active slots
+        # (each width is its own jit cache entry; keep the set small so cold
+        # compiles stay bounded). max_slots is always a member.
+        self.width_buckets = sorted(
+            {w for w in width_buckets if 0 < w < max_slots} | {max_slots}
+        )
 
         t0 = time.monotonic()
         if params is None:
@@ -233,7 +250,11 @@ class Engine:
         # table width = MODEL vocab (logits width); tokenizer vocab may be
         # smaller — those extra logits are simply forbidden under constraint
         self._token_table = None
+        self._min_close = None
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
+        self._dummy_min_close = jnp.zeros((1,), dtype=jnp.int32)
+        # remaining sampled-token budget per slot (budget-aware constraint)
+        self._budgets = np.zeros(max_slots, dtype=np.int32)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
         # rids whose callers abandoned the request (client timeout/disconnect);
@@ -257,43 +278,57 @@ class Engine:
         config = self.config
         NEG = jnp.float32(-1e30)
 
-        def constrain_logits(logits, table, con_state, constrained):
-            """Mask logits to grammar-legal tokens for constrained slots."""
-            allowed = table[jnp.clip(con_state, 0, table.shape[0] - 1)] >= 0  # [S, V]
+        def constrain_logits(logits, table, con_state, constrained, min_close, budget):
+            """Mask logits to grammar-legal tokens for constrained slots.
+            ``budget`` [S] = sampled tokens remaining INCLUDING this one:
+            tokens are additionally restricted to those whose next state can
+            still close the JSON within budget-1, so constrained generations
+            ALWAYS complete inside max_tokens (no truncated objects)."""
+            nxt = table[jnp.clip(con_state, 0, table.shape[0] - 1)]  # [S, V]
+            allowed = nxt >= 0
+            closable = (
+                min_close[jnp.clip(nxt, 0, min_close.shape[0] - 1)]
+                <= budget[:, None] - 1
+            )
+            budget_allowed = allowed & closable
+            # if the budget is already unsatisfiable, keep plain grammar
+            # legality rather than masking everything (never sample garbage)
+            feasible = budget_allowed.any(axis=-1, keepdims=True)
+            allowed = jnp.where(feasible, budget_allowed, allowed)
             return jnp.where(constrained[:, None] & ~allowed, NEG, logits)
 
         def advance_constraint(table, con_state, constrained, toks):
             nxt = table[jnp.clip(con_state, 0, table.shape[0] - 1), toks]
             return jnp.where(constrained, nxt, con_state)
 
-        def sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained):
-            logits = constrain_logits(
-                logits[None], table, con_state[None], constrained[None]
-            )[0]
-            tok = sample(logits[None], rng, temp[None], top_k[None], top_p[None])[0]
-            new_state = advance_constraint(
-                table, con_state[None], constrained[None], tok[None]
-            )[0]
-            return tok, new_state
+        def sample_first(logits, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
+            """Constrained sampling for a [B] batch of first tokens."""
+            logits = constrain_logits(logits, table, con_states, constrained, min_close, budgets)
+            toks = sample(logits, rng, temps, top_ks, top_ps)
+            new_states = advance_constraint(table, con_states, constrained, toks)
+            return toks, new_states
 
         def make_decode_block(step_fn):
             def decode_block(
                 params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps,
-                table, con_states, constrained, *extra,
+                table, con_states, constrained, min_close, budgets, *extra,
             ):
                 def step(carry, _):
-                    cache, tokens, seq_lens, con_states, rng = carry
+                    cache, tokens, seq_lens, con_states, budgets, rng = carry
                     rng, sub = jax.random.split(rng)
                     cache, logits = step_fn(params, cache, tokens, seq_lens, active, *extra)
-                    logits = constrain_logits(logits, table, con_states, constrained)
+                    logits = constrain_logits(
+                        logits, table, con_states, constrained, min_close, budgets
+                    )
                     next_toks = sample(logits, sub, temps, top_ks, top_ps)
                     next_toks = jnp.where(active, next_toks, tokens)
                     con_states = advance_constraint(table, con_states, constrained, next_toks)
                     seq_lens = seq_lens + active.astype(jnp.int32)
-                    return (cache, next_toks, seq_lens, con_states, rng), next_toks
+                    budgets = budgets - active.astype(jnp.int32)
+                    return (cache, next_toks, seq_lens, con_states, budgets, rng), next_toks
 
-                (cache, tokens, seq_lens, con_states, rng), toks = jax.lax.scan(
-                    step, (cache, tokens, seq_lens, con_states, rng), None,
+                (cache, tokens, seq_lens, con_states, budgets, rng), toks = jax.lax.scan(
+                    step, (cache, tokens, seq_lens, con_states, budgets, rng), None,
                     length=self.decode_block_size,
                 )
                 return cache, toks, con_states
@@ -301,14 +336,14 @@ class Engine:
             return jax.jit(decode_block, donate_argnums=(1,))
 
         if self.kv_layout == "paged":
-            from ..models.llama import decode_step_paged, prefill_paged
+            from ..models.llama import decode_step_paged, prefill_paged_batch
 
             use_pallas = self._use_pallas
 
-            def prefill_and_sample(params, pages, tokens, length, page_ids, rng, temp, top_k, top_p, table, con_state, constrained):
-                pages, logits = prefill_paged(params, pages, tokens, length, page_ids, config)
-                tok, state = sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained)
-                return pages, tok, state
+            def prefill_and_sample(params, pages, tokens, lengths, page_ids, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
+                pages, logits = prefill_paged_batch(params, pages, tokens, lengths, page_ids, config)
+                toks, states = sample_first(logits, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets)
+                return pages, toks, states
 
             self._jit_prefill_paged = jax.jit(prefill_and_sample, donate_argnums=(1,))
             mesh = self.mesh
@@ -320,10 +355,10 @@ class Engine:
             )
         else:
 
-            def prefill_and_sample(params, cache, tokens, length, slot, rng, temp, top_k, top_p, table, con_state, constrained):
-                cache, logits = prefill(params, cache, tokens, length, slot, config)
-                tok, state = sample_first(logits, rng, temp, top_k, top_p, table, con_state, constrained)
-                return cache, tok, state
+            def prefill_and_sample(params, cache, tokens, lengths, slots, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
+                cache, logits = prefill_batch(params, cache, tokens, lengths, slots, config)
+                toks, states = sample_first(logits, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets)
+                return cache, toks, states
 
             self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
             self._jit_decode = make_decode_block(
@@ -354,8 +389,15 @@ class Engine:
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
-        if len(tokens) >= self.max_ctx:
-            tokens = tokens[-(self.max_ctx - 1) :]
+        s = sampling or SamplingParams()
+        prefix_len = len(s.forced_prefix)
+        # keep the prompt's TAIL and reserve room to actually generate —
+        # otherwise a context-filling prompt leaves a 1-token budget and
+        # every response (and any forced tool call) truncates immediately
+        reserve = min(s.max_tokens, max(1, self.max_ctx // 2))
+        budget = max(1, self.max_ctx - prefix_len - reserve)
+        if len(tokens) > budget:
+            tokens = tokens[-budget:]
         req = _Request(
             rid=uuid.uuid4().hex[:8],
             prompt=tokens,
@@ -479,23 +521,82 @@ class Engine:
                     kept.append(r)
             self._waiting = kept
         if self._cancelled:
-            # purge rids that raced _finish (request already completed):
-            # anything not waiting or active now never will be, and a stale
-            # rid could collide with a future request's rid
+            # purge rids that raced _finish (request already completed): a
+            # stale rid could collide with a future request's rid. A rid is
+            # live if its request is waiting, active, OR still in transit in
+            # the cross-thread queue (peeked under the queue mutex — without
+            # this, a submit-then-cancel racing the drain loses the cancel)
             live = {r.rid for r in self._waiting}
             live.update(sl.request.rid for sl in self._slots.values())
+            with self._queue.mutex:
+                live.update(r.rid for r in self._queue.queue if r is not None)
             self._cancelled &= live
 
         admitted = False
         while self._free and self._waiting:
-            req = self._waiting[0]
-            slot = self._free.pop()
-            if not self._prefill_into(slot, req):
-                # head request can't fit (KV pages); keep FIFO order and wait
-                break
-            self._waiting.popleft()
+            group = self._collect_group()
+            if not group:
+                break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
+            # power-of-two chunks keep the jit cache small: 7 -> [4, 2, 1]
+            i = 0
+            while i < len(group):
+                b = 1
+                while b * 2 <= min(len(group) - i, self.prefill_batch_max):
+                    b *= 2
+                self._prefill_group(group[i : i + b])
+                i += b
         return admitted
+
+    def _collect_group(self) -> list[tuple[_Request, int, Optional[list[int]]]]:
+        """Pop up to prefill_batch_max admissible head requests, reserving a
+        slot (and KV pages, in paged mode) for each. Strict FIFO: stop at
+        the first request that can't get pages."""
+        group: list[tuple[_Request, int, Optional[list[int]]]] = []
+        while self._waiting and self._free and len(group) < self.prefill_batch_max:
+            req = self._waiting[0]
+            s = req.sampling
+            if s.json_only and s.forced_prefix:
+                # seed the automaton past the forced prefix; an illegal
+                # prefix can never complete, so fail it up front
+                if self._seed_con_state(s.forced_prefix) < 0:
+                    self._waiting.popleft()
+                    req.future.set_exception(
+                        RuntimeError("forced_prefix is not a legal JSON prefix")
+                    )
+                    continue
+            pages: Optional[list[int]] = None
+            if self.kv_layout == "paged":
+                n_pages = -(-(len(req.prompt) + len(s.forced_prefix)) // self.page_size)
+                if n_pages > self._allocator.num_pages - 1:
+                    # bigger than the entire pool: waiting would spin forever
+                    self._waiting.popleft()
+                    req.future.set_exception(
+                        RuntimeError(
+                            f"prompt needs {n_pages} KV pages but the pool has "
+                            f"{self._allocator.num_pages - 1}"
+                        )
+                    )
+                    continue
+                try:
+                    pages = self._allocator.alloc(n_pages)
+                except MemoryError:
+                    break  # head waits for finishing slots to free pages
+            self._waiting.popleft()
+            # lowest-index slot first: keeps active slots compacted at low
+            # indices so decode width bucketing stays narrow
+            group.append((req, heapq.heappop(self._free), pages))
+        return group
+
+    def _seed_con_state(self, prefix: Sequence[int]) -> int:
+        """Walk the token table over a forced prefix; -1 = illegal."""
+        self._get_token_table()  # ensure built
+        state = self._table_start
+        for tok in prefix:
+            if state < 0 or tok >= self._token_table_np.shape[1]:
+                return -1
+            state = int(self._token_table_np[state, tok])
+        return state
 
     def _get_token_table(self):
         """Lazy-build + cache the grammar token table on device."""
@@ -510,6 +611,8 @@ class Engine:
             width = min(self.config.vocab_size, table.token_trans.shape[1])
             padded[:, :width] = table.token_trans[:, :width]
             self._token_table = jnp.asarray(padded)
+            self._token_table_np = padded  # host-side walks (prefix seeding)
+            self._min_close = jnp.asarray(table.min_close.astype(np.int32))
             self._table_start = table.start_state
             log.info(
                 "built JSON constraint table: %d states x %d tokens in %.1fs",
@@ -517,94 +620,116 @@ class Engine:
             )
         return self._token_table
 
-    def _prefill_into(self, slot: int, req: _Request) -> bool:
-        plen = len(req.prompt)
-        bucket = _next_bucket(plen, self.prefill_buckets)
-        tokens = np.zeros(bucket, dtype=np.int32)
-        tokens[:plen] = req.prompt
-        self._rng, step_rng = jax.random.split(self._rng)
-        s = req.sampling
-        if s.json_only:
+    def _prefill_group(self, chunk: list[tuple[_Request, int, Optional[list[int]]]]) -> None:
+        """One batched prefill dispatch for B already-reserved requests
+        (B = power of two <= prefill_batch_max). Burst admissions no longer
+        serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
+        batch-1 prefills."""
+        B = len(chunk)
+        full = lambda r: list(r.prompt) + list(r.sampling.forced_prefix)
+        bucket = max(
+            _next_bucket(len(r.prompt) + len(r.sampling.forced_prefix), self.prefill_buckets)
+            for r, _, _ in chunk
+        )
+        tokens = np.zeros((B, bucket), dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        slots = np.zeros(B, dtype=np.int32)
+        temps = np.zeros(B, dtype=np.float32)
+        top_ks = np.zeros(B, dtype=np.int32)
+        top_ps = np.ones(B, dtype=np.float32)
+        con_states0 = np.zeros(B, dtype=np.int32)
+        constrained0 = np.zeros(B, dtype=bool)
+        budgets = np.zeros(B, dtype=np.int32)
+        any_json = any(r.sampling.json_only for r, _, _ in chunk)
+        if any_json:
             table = self._get_token_table()
-            con_state0 = jnp.int32(self._table_start)
-            constrained0 = jnp.asarray(True)
+            min_close = self._min_close
         else:
             table = self._token_table if self._token_table is not None else self._dummy_table
-            con_state0 = jnp.int32(0)
-            constrained0 = jnp.asarray(False)
-        if self.kv_layout == "paged":
-            n_pages = -(-plen // self.page_size)
-            if n_pages > self._allocator.num_pages - 1:
-                # bigger than the entire pool: requeueing would spin forever
-                self._free.append(slot)
-                req.future.set_exception(
-                    RuntimeError(
-                        f"prompt needs {n_pages} KV pages but the pool has "
-                        f"{self._allocator.num_pages - 1}"
-                    )
+            min_close = (
+                self._min_close if self._min_close is not None else self._dummy_min_close
+            )
+        for i, (req, slot, _) in enumerate(chunk):
+            s = req.sampling
+            row = full(req)
+            plen = len(row)
+            tokens[i, :plen] = row
+            lengths[i] = plen
+            slots[i] = slot
+            temps[i] = s.temperature
+            top_ks[i] = s.top_k
+            top_ps[i] = s.top_p
+            # ctx-bounded: 1 token now + whole decode blocks that still fit
+            K = self.decode_block_size
+            budgets[i] = min(s.max_tokens, 1 + ((self.max_ctx - plen) // K) * K)
+            if s.json_only:
+                con_states0[i] = (
+                    self._seed_con_state(s.forced_prefix)
+                    if s.forced_prefix
+                    else self._table_start
                 )
-                return True  # slot is free again; keep admitting others
-            try:
-                pages = self._allocator.alloc(n_pages)
-            except MemoryError:
-                # out of KV pages: leave the request at the head of the
-                # waiting deque (strict FIFO; no starvation) and retry once
-                # finishing slots free pages
-                self._free.append(slot)
-                return False
-            self._slot_pages[slot] = pages
-            self._block_tables[slot, :] = TRASH_PAGE
-            self._block_tables[slot, :n_pages] = pages
-            page_ids = np.full(bucket // self.page_size, TRASH_PAGE, dtype=np.int32)
-            page_ids[:n_pages] = pages
-            cache, first, con_state = self._jit_prefill_paged(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.int32(plen),
-                jnp.asarray(page_ids),
-                step_rng,
-                jnp.float32(s.temperature),
-                jnp.int32(s.top_k),
-                jnp.float32(s.top_p),
-                table,
-                con_state0,
-                constrained0,
+                constrained0[i] = True
+        self._rng, step_rng = jax.random.split(self._rng)
+        common = (
+            jnp.asarray(tokens),
+            jnp.asarray(lengths),
+        )
+        tail = (
+            step_rng,
+            jnp.asarray(temps),
+            jnp.asarray(top_ks),
+            jnp.asarray(top_ps),
+            table,
+            jnp.asarray(con_states0),
+            jnp.asarray(constrained0),
+            min_close,
+            jnp.asarray(budgets),
+        )
+        if self.kv_layout == "paged":
+            page_ids = np.full((B, bucket // self.page_size), TRASH_PAGE, dtype=np.int32)
+            for i, (req, slot, pages) in enumerate(chunk):
+                assert pages is not None
+                self._slot_pages[slot] = pages
+                self._block_tables[slot, :] = TRASH_PAGE
+                self._block_tables[slot, : len(pages)] = pages
+                page_ids[i, : len(pages)] = pages
+            cache, firsts, con_states = self._jit_prefill_paged(
+                self.params, self.cache, *common, jnp.asarray(page_ids), *tail
             )
         else:
-            cache, first, con_state = self._jit_prefill(
-                self.params,
-                self.cache,
-                jnp.asarray(tokens),
-                jnp.int32(plen),
-                jnp.int32(slot),
-                step_rng,
-                jnp.float32(s.temperature),
-                jnp.int32(s.top_k),
-                jnp.float32(s.top_p),
-                table,
-                con_state0,
-                constrained0,
+            cache, firsts, con_states = self._jit_prefill(
+                self.params, self.cache, *common, jnp.asarray(slots), *tail
             )
         self.cache = cache
-        first_tok = int(first)
-        self._con_states[slot] = int(con_state)
-        self._constrained[slot] = bool(s.json_only)
+        firsts = np.asarray(firsts)
+        con_states = np.asarray(con_states)
         now = time.monotonic()
-        sl = _Slot(request=req, prompt_len=plen, first_token_at=now)
-        sl.generated.append(first_tok)
-        self._slots[slot] = sl
-        self._seq_lens[slot] = plen
-        self._last_tokens[slot] = first_tok
-        self._temps[slot] = s.temperature
-        self._top_ks[slot] = s.top_k
-        self._top_ps[slot] = s.top_p
-        REGISTRY.observe(
-            "acp_engine_ttft_seconds", now - req.enqueued, help="time to first token"
-        )
-        if first_tok in self.tokenizer.stop_tokens or s.max_tokens <= 1:
-            self._finish(slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length")
-        return True
+        for i, (req, slot, _) in enumerate(chunk):
+            s = req.sampling
+            first_tok = int(firsts[i])
+            self._con_states[slot] = int(con_states[i])
+            self._constrained[slot] = bool(s.json_only)
+            sl = _Slot(
+                request=req,
+                prompt_len=len(req.prompt),
+                prefix_len=len(s.forced_prefix),
+                first_token_at=now,
+            )
+            sl.generated.extend(s.forced_prefix)
+            sl.generated.append(first_tok)
+            self._slots[slot] = sl
+            self._seq_lens[slot] = lengths[i]
+            self._last_tokens[slot] = first_tok
+            self._temps[slot] = s.temperature
+            self._top_ks[slot] = s.top_k
+            self._top_ps[slot] = s.top_p
+            REGISTRY.observe(
+                "acp_engine_ttft_seconds", now - req.enqueued, help="time to first token"
+            )
+            if first_tok in self.tokenizer.stop_tokens or s.max_tokens <= 1:
+                self._finish(
+                    slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length"
+                )
 
     def _ensure_pages_for_block(self) -> None:
         """Paged mode: every active slot's table must cover the next K
@@ -652,37 +777,53 @@ class Engine:
             self._ensure_pages_for_block()
             if not self._slots:
                 return
-        active_mask = np.zeros(self.max_slots, dtype=bool)
+        # width bucketing: dispatch the smallest compiled width covering the
+        # active slots (allocation is lowest-slot-first, so occupancy stays
+        # compacted) — one live request doesn't pay max_slots of compute
+        max_active = max(self._slots) + 1
+        W = next(w for w in self.width_buckets if w >= max_active)
+        active_mask = np.zeros(W, dtype=bool)
         for slot in self._slots:
             active_mask[slot] = True
         self._rng, step_rng = jax.random.split(self._rng)
         # the real table (a large gather operand) is only passed when some
         # slot is actually constrained; each shape is its own jit cache entry
-        use_real = self._token_table is not None and bool(self._constrained.any())
+        use_real = self._token_table is not None and bool(self._constrained[:W].any())
         table = self._token_table if use_real else self._dummy_table
+        min_close = self._min_close if use_real else self._dummy_min_close
+        for slot, sl in self._slots.items():
+            token_left = sl.request.sampling.max_tokens - (
+                len(sl.generated) - sl.prefix_len
+            )
+            # ctx bound: the slot is force-finished once the next block can't
+            # fit, so only whole blocks of capacity remain
+            ctx_left = ((self.max_ctx - int(self._seq_lens[slot])) // K) * K
+            self._budgets[slot] = min(token_left, ctx_left)
         common = (
-            jnp.asarray(self._last_tokens),
-            jnp.asarray(self._seq_lens),
+            jnp.asarray(self._last_tokens[:W]),
+            jnp.asarray(self._seq_lens[:W]),
             jnp.asarray(active_mask),
             step_rng,
-            jnp.asarray(self._temps),
-            jnp.asarray(self._top_ks),
-            jnp.asarray(self._top_ps),
+            jnp.asarray(self._temps[:W]),
+            jnp.asarray(self._top_ks[:W]),
+            jnp.asarray(self._top_ps[:W]),
             table,
-            jnp.asarray(self._con_states),
-            jnp.asarray(self._constrained),
+            jnp.asarray(self._con_states[:W]),
+            jnp.asarray(self._constrained[:W]),
+            min_close,
+            jnp.asarray(self._budgets[:W]),
         )
         if self.kv_layout == "paged":
             cache, tok_block, con_states = self._jit_decode_paged(
-                self.params, self.cache, *common, jnp.asarray(self._block_tables)
+                self.params, self.cache, *common, jnp.asarray(self._block_tables[:W])
             )
         else:
             cache, tok_block, con_states = self._jit_decode(
                 self.params, self.cache, *common
             )
-        self._con_states = np.array(con_states)  # copy: jax views are read-only
+        self._con_states[:W] = np.asarray(con_states)
         self.cache = cache
-        tok_block = np.asarray(tok_block)  # [K, S]
+        tok_block = np.asarray(tok_block)  # [K, W]
         K = tok_block.shape[0]
         self.decode_steps += K
         active = list(self._slots.items())
@@ -698,7 +839,10 @@ class Engine:
                 if tok in self.tokenizer.stop_tokens:
                     done = "stop"
                     break
-                if len(sl.generated) >= s.max_tokens or self._seq_lens[slot] + 1 >= self.max_ctx:
+                if (
+                    len(sl.generated) - sl.prefix_len >= s.max_tokens
+                    or self._seq_lens[slot] + 1 >= self.max_ctx
+                ):
                     done = "length"
                     break
             if done is not None:
@@ -714,7 +858,7 @@ class Engine:
         self._last_tokens[slot] = 0
         self._con_states[slot] = 0
         self._constrained[slot] = False
-        self._free.append(slot)
+        heapq.heappush(self._free, slot)
         if self.kv_layout == "paged":
             self._allocator.free(self._slot_pages.pop(slot, []))
             self._block_tables[slot, :] = TRASH_PAGE

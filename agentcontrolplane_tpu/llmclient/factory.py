@@ -101,6 +101,7 @@ class DefaultLLMClientFactory:
                 force_json_tools=bool(
                     llm.spec.provider_config.get("force_json_tools", False)
                 ),
+                tool_choice=str(llm.spec.provider_config.get("tool_choice", "auto")),
             )
         if provider == "mock":
             return MockLLMClient()

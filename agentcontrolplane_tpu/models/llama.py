@@ -29,7 +29,6 @@ import jax.numpy as jnp
 from ..ops.attention import (
     causal_attention,
     decode_attention,
-    write_kv,
     write_kv_token,
 )
 from ..ops.norms import rms_norm
@@ -270,20 +269,24 @@ def init_kv_cache(config: LlamaConfig, max_slots: int, max_ctx: int) -> dict:
     }
 
 
-def prefill(
+def prefill_batch(
     params: dict,
     cache: dict,
-    tokens: jax.Array,  # [T] int32 (padded)
-    length: jax.Array,  # scalar int32 — true prompt length
-    slot: jax.Array,  # scalar int32
+    tokens: jax.Array,  # [B, T] int32 (each row padded)
+    lengths: jax.Array,  # [B] int32 — true prompt lengths
+    slots: jax.Array,  # [B] int32 — distinct target slots
     config: LlamaConfig,
 ) -> tuple[dict, jax.Array]:
-    """Run the prompt through the model, writing K/V into ``slot``.
-    Returns (cache, logits_at_last_token [V])."""
+    """Run B prompts through the model in one dispatch, writing each row's
+    K/V into its slot. Batching prefills is how burst admissions avoid
+    serializing (one compiled program per (B, T) bucket pair; the engine
+    splits admission groups into power-of-two B). Returns
+    (cache, logits_at_last_token [B, V])."""
     c = config
-    T = tokens.shape[0]
-    positions = jnp.where(jnp.arange(T) < length, jnp.arange(T), -1)[None]  # [1,T]
-    x = params["embed"][tokens][None].astype(c.dtype)  # [1, T, D]
+    B, T = tokens.shape
+    ar = jnp.arange(T)
+    positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)  # [B,T]
+    x = params["embed"][tokens].astype(c.dtype)  # [B, T, D]
 
     def body(carry, scanned):
         x = carry
@@ -295,17 +298,33 @@ def prefill(
             positions,
             lambda q, k, v: causal_attention(q, k, v, positions),
         )
-        k_cache_l, v_cache_l = write_kv(
-            k_cache_l, v_cache_l, slot, jnp.int32(0), k[0], v[0]
-        )
+        # scatter each row's [T] K/V into its slot (padded tail is garbage
+        # but never read: decode masks by seq_len)
+        k_cache_l = k_cache_l.at[slots, :T].set(k.astype(k_cache_l.dtype))
+        v_cache_l = v_cache_l.at[slots, :T].set(v.astype(v_cache_l.dtype))
         return out, (k_cache_l, v_cache_l)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
     x = rms_norm(x, params["norm"], c.norm_eps)
-    last = x[0, length - 1]  # [D]
+    last = x[jnp.arange(B), lengths - 1]  # [B, D]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
     return {"k": new_k, "v": new_v}, logits
+
+
+def prefill(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [T] int32 (padded)
+    length: jax.Array,  # scalar int32 — true prompt length
+    slot: jax.Array,  # scalar int32
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Single-prompt prefill (B=1 view of :func:`prefill_batch`)."""
+    cache, logits = prefill_batch(
+        params, cache, tokens[None], length[None], slot[None], config
+    )
+    return cache, logits[0]
 
 
 # ---------------------------------------------------------------------------
@@ -321,21 +340,22 @@ def init_paged_cache(config: LlamaConfig, num_pages: int, page_size: int) -> dic
     )
 
 
-def prefill_paged(
+def prefill_paged_batch(
     params: dict,
     pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
-    tokens: jax.Array,  # [T] int32 (padded to a multiple of page_size)
-    length: jax.Array,  # scalar int32
-    page_ids: jax.Array,  # [T // P] int32 (TRASH_PAGE beyond the prompt)
+    tokens: jax.Array,  # [B, T] int32 (rows padded to a multiple of page_size)
+    lengths: jax.Array,  # [B] int32
+    page_ids: jax.Array,  # [B, T // P] int32 (TRASH_PAGE beyond each prompt)
     config: LlamaConfig,
 ) -> tuple[dict, jax.Array]:
-    """Prompt forward writing K/V into this sequence's pages."""
-    from ..ops.paged import write_prompt_to_pages
-
+    """B prompts forward in one dispatch, each writing K/V into its own
+    pages. Rows' trash-page writes may collide — unordered garbage into the
+    never-read page 0."""
     c = config
-    T = tokens.shape[0]
-    positions = jnp.where(jnp.arange(T) < length, jnp.arange(T), -1)[None]
-    x = params["embed"][tokens][None].astype(c.dtype)
+    B, T = tokens.shape
+    ar = jnp.arange(T)
+    positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)
+    x = params["embed"][tokens].astype(c.dtype)
 
     def body(carry, scanned):
         x = carry
@@ -344,15 +364,35 @@ def prefill_paged(
             x, layer, c, positions,
             lambda q, k, v: causal_attention(q, k, v, positions),
         )
-        k_pages_l, v_pages_l = write_prompt_to_pages(k_pages_l, v_pages_l, page_ids, k[0], v[0])
+        P = k_pages_l.shape[1]
+        # [B, T, H, d] -> [B * T//P, P, H, d] blocks matched to flat page ids
+        blocks = lambda t: t.reshape(B * (T // P), P, *t.shape[2:])
+        flat_ids = page_ids.reshape(-1)
+        k_pages_l = k_pages_l.at[flat_ids].set(blocks(k).astype(k_pages_l.dtype))
+        v_pages_l = v_pages_l.at[flat_ids].set(blocks(v).astype(v_pages_l.dtype))
         return out, (k_pages_l, v_pages_l)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
     x = rms_norm(x, params["norm"], c.norm_eps)
-    last = x[0, length - 1]
+    last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
     return {"k": new_k, "v": new_v}, logits
+
+
+def prefill_paged(
+    params: dict,
+    pages: dict,
+    tokens: jax.Array,  # [T] int32 (padded to a multiple of page_size)
+    length: jax.Array,  # scalar int32
+    page_ids: jax.Array,  # [T // P] int32 (TRASH_PAGE beyond the prompt)
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Single-prompt paged prefill (B=1 view of :func:`prefill_paged_batch`)."""
+    pages, logits = prefill_paged_batch(
+        params, pages, tokens[None], length[None], page_ids[None], config
+    )
+    return pages, logits[0]
 
 
 def decode_step_paged(
@@ -414,26 +454,29 @@ def decode_step_paged(
 def decode_step(
     params: dict,
     cache: dict,
-    tokens: jax.Array,  # [S] int32 — last sampled token per slot
-    seq_lens: jax.Array,  # [S] int32 — current length per slot (before this token)
+    tokens: jax.Array,  # [W] int32 — last sampled token per slot, W <= max_slots
+    seq_lens: jax.Array,  # [W] int32 — current length per slot (before this token)
     config: LlamaConfig,
 ) -> tuple[dict, jax.Array]:
-    """One decode step for ALL slots (the continuous-batching hot loop).
-    Inactive slots simply compute garbage that is never read.
-    Returns (cache, logits [S, V])."""
+    """One decode step for slots 0..W-1 (the continuous-batching hot loop).
+    W may be narrower than the cache's slot count — width bucketing: at low
+    occupancy the engine dispatches a power-of-two W covering the active
+    slots, so one live request doesn't pay max_slots of compute. Inactive
+    slots inside W compute garbage that is never read; cache rows beyond W
+    pass through untouched. Returns (cache, logits [W, V])."""
     c = config
-    S = tokens.shape[0]
-    positions = seq_lens[:, None]  # the new token's position, [S, 1]
-    x = params["embed"][tokens][:, None].astype(c.dtype)  # [S, 1, D]
+    W = tokens.shape[0]
+    positions = seq_lens[:, None]  # the new token's position, [W, 1]
+    x = params["embed"][tokens][:, None].astype(c.dtype)  # [W, 1, D]
 
     def body(carry, scanned):
         x = carry
         layer, k_cache_l, v_cache_l = scanned
 
         def attn(q, k, v):
-            # write the new token, then attend over the slot cache
+            # write the new token, then attend over the first W cache rows
             k_l, v_l = write_kv_token(k_cache_l, v_cache_l, seq_lens, k[:, 0], v[:, 0])
-            out = decode_attention(q[:, 0], k_l, v_l, seq_lens + 1)
+            out = decode_attention(q[:, 0], k_l[:W], v_l[:W], seq_lens + 1)
             attn.updated = (k_l, v_l)
             return out[:, None]
 

@@ -1,4 +1,8 @@
 from .metrics import REGISTRY, Registry
+from .otel_metrics import MetricsExporter
 from .tracing import NOOP_TRACER, Span, Tracer, new_span_id, new_trace_id
 
-__all__ = ["REGISTRY", "Registry", "NOOP_TRACER", "Span", "Tracer", "new_span_id", "new_trace_id"]
+__all__ = [
+    "REGISTRY", "Registry", "MetricsExporter", "NOOP_TRACER", "Span", "Tracer",
+    "new_span_id", "new_trace_id",
+]

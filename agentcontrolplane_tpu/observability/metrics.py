@@ -106,5 +106,36 @@ class Registry:
         inner = ",".join(f'{name}="{value}"' for name, value in k)
         return "{" + inner + "}"
 
+    def snapshot(self) -> list[dict]:
+        """Structured dump for programmatic exporters (OTLP metrics)."""
+        out: list[dict] = []
+        with self._lock:
+            for m in self._metrics.values():
+                entry: dict = {"name": m.name, "type": m.type, "help": m.help}
+                if m.type == "histogram":
+                    series = []
+                    for k, vals in self._hist_data.get(m.name, {}).items():
+                        svals = sorted(vals)
+                        series.append(
+                            {
+                                "labels": dict(k),
+                                "count": self._hist_count[m.name][k],
+                                "sum": self._hist_sum[m.name][k],
+                                "quantiles": {
+                                    q: svals[min(int(q * len(svals)), len(svals) - 1)]
+                                    for q in (0.5, 0.9, 0.99)
+                                }
+                                if svals
+                                else {},
+                            }
+                        )
+                    entry["series"] = series
+                else:
+                    entry["series"] = [
+                        {"labels": dict(k), "value": v} for k, v in m.values.items()
+                    ]
+                out.append(entry)
+        return out
+
 
 REGISTRY = Registry()

@@ -30,7 +30,7 @@ from .kernel import Manager, SqliteBackend, Store
 from .kernel.runtime import map_owner
 from .llmclient import DefaultLLMClientFactory, LLMClientFactory
 from .mcp import MCPManager
-from .observability import NOOP_TRACER, Tracer
+from .observability import MetricsExporter, NOOP_TRACER, Tracer
 
 
 @dataclass
@@ -92,6 +92,9 @@ class Operator:
             tracer=self.tracer,
         )
         self._register_controllers()
+        # OTLP metrics push alongside traces (internal/otel/otel.go:58-80
+        # parity); silent no-op unless OTEL_EXPORTER_OTLP_ENDPOINT is set
+        self.metrics_exporter = MetricsExporter()
         self.rest_server = None
         if self.options.enable_rest:
             from .server.rest import RestServer
@@ -137,8 +140,10 @@ class Operator:
 
     async def start(self) -> None:
         await self.manager.start()
+        self.metrics_exporter.start()
 
     async def stop(self) -> None:
+        self.metrics_exporter.stop()
         await self.manager.stop()
         await self.mcp_manager.close()
         closer = getattr(self.llm_factory, "aclose", None)

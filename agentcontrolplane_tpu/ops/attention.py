@@ -99,13 +99,13 @@ def write_kv(
 def write_kv_token(
     k_cache: jax.Array,  # [S, C, H_kv, d]
     v_cache: jax.Array,
-    positions: jax.Array,  # [S] int32 — write position per slot
-    k_new: jax.Array,  # [S, H_kv, d]
+    positions: jax.Array,  # [W] int32 — write position per slot, W <= S
+    k_new: jax.Array,  # [W, H_kv, d]
     v_new: jax.Array,
 ) -> tuple[jax.Array, jax.Array]:
-    """Scatter one new token's K/V into every slot (decode step)."""
-    S = k_cache.shape[0]
-    slot_idx = jnp.arange(S)
+    """Scatter one new token's K/V into slots 0..W-1 (decode step; W < S is
+    the width-bucketed case — rows beyond W pass through untouched)."""
+    slot_idx = jnp.arange(positions.shape[0])
     k_cache = k_cache.at[slot_idx, positions].set(k_new.astype(k_cache.dtype))
     v_cache = v_cache.at[slot_idx, positions].set(v_new.astype(v_cache.dtype))
     return k_cache, v_cache

@@ -1,0 +1,100 @@
+"""Control-plane throughput: reconciles/sec and status-writes/sec at 64
+concurrent Tasks (VERDICT r1 #9 — quantify the sqlite write path so the
+kernel doesn't become the bottleneck the reference offloads to etcd).
+
+Runs entirely on CPU with a mock LLM: the measured path is watch -> workqueue
+-> reconciler -> CAS status write -> sqlite WAL commit.
+
+    python benchmarks/control_plane.py [--tasks 64] [--sync NORMAL|FULL]
+
+``--sync FULL`` restores per-commit fsync (etcd-style durability) for an A/B
+against the default WAL+NORMAL group-commit behavior.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from agentcontrolplane_tpu.kernel.store import SqliteBackend, Store
+from agentcontrolplane_tpu.kernel import wait_for
+from agentcontrolplane_tpu.llmclient import MockLLMClient, MockLLMClientFactory, assistant
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+from tests.fixtures import make_agent, make_llm, make_task
+
+
+class CountingBackend(SqliteBackend):
+    def __init__(self, path: str):
+        super().__init__(path)
+        self.puts = 0
+
+    def put(self, doc, rv=0):
+        self.puts += 1
+        super().put(doc, rv)
+
+
+async def run(n_tasks: int, sync: str) -> dict:
+    tmp = tempfile.mkdtemp(prefix="acp-cpbench-")
+    backend = CountingBackend(os.path.join(tmp, "state.db"))
+    backend._conn.execute(f"PRAGMA synchronous={sync}")
+    store = Store(backend)
+
+    # every request gets a one-turn answer (MockLLMClient falls back to its
+    # default when the script is empty)
+    mock = MockLLMClient(default=assistant("done"))
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False
+        ),
+        store=store,
+        llm_factory=MockLLMClientFactory(mock),
+    )
+    op.task_reconciler.requeue_delay = 0.01
+    make_llm(store)
+    make_agent(store, name="helper")
+
+    await op.start()
+    t0 = time.monotonic()
+    puts0 = backend.puts
+    for i in range(n_tasks):
+        make_task(store, name=f"cp-{i}", agent="helper", user_message=f"m{i}")
+    for i in range(n_tasks):
+        await wait_for(
+            store, "Task", f"cp-{i}", "default",
+            lambda t: t.status.phase in ("FinalAnswer", "Failed"), timeout=120,
+        )
+    elapsed = time.monotonic() - t0
+    writes = backend.puts - puts0
+    await op.stop()
+    return {
+        "sync": sync,
+        "tasks": n_tasks,
+        "elapsed_s": round(elapsed, 3),
+        "tasks_per_s": round(n_tasks / elapsed, 1),
+        "status_writes": writes,
+        "writes_per_s": round(writes / elapsed, 1),
+    }
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--tasks", type=int, default=64)
+    ap.add_argument("--sync", choices=["NORMAL", "FULL"], default="NORMAL")
+    args = ap.parse_args()
+    print(json.dumps(asyncio.run(run(args.tasks, args.sync))), flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,173 @@
+"""BASELINE config 2 through a real checkpoint DIRECTORY: an on-disk HF
+checkpoint (config.json + safetensors + byte-level-BPE tokenizer.json) is
+loaded by engine/weights.py, tokenized by HFTokenizer, served by the engine,
+and drives the multi-turn MCP stdio fetch loop — Task -> forced tool call ->
+ToolCall CR -> real MCP subprocess -> tool result joined back into the
+context window.
+
+This is the first place weights.py + HFTokenizer + constrain + toolparse +
+the MCP manager all meet in ONE flow (VERDICT r1 #4's shape, scaled to a
+tiny random checkpoint since real Llama weights can't ship in this image;
+the opt-in ACP_REAL_CHECKPOINT env points the same flow at a real one).
+"""
+
+import json
+import os
+import sys
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM,
+    BaseConfig,
+    LLMSpec,
+    MCPServer,
+    MCPServerSpec,
+    TPUProviderConfig,
+)
+from agentcontrolplane_tpu.engine.engine import Engine
+from agentcontrolplane_tpu.engine.tokenizer import EOS, EOT, HFTokenizer
+from agentcontrolplane_tpu.engine.weights import load_safetensors_dir
+from agentcontrolplane_tpu.kernel import wait_for
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+from ..fixtures import make_agent, make_task, setup_with_status
+
+ECHO_SERVER = os.path.join(os.path.dirname(__file__), "..", "mcp", "echo_server.py")
+
+
+@pytest.fixture(scope="module")
+def checkpoint_dir(tmp_path_factory):
+    """Generate a genuine HF checkpoint directory: trained byte-level BPE
+    tokenizer.json + LlamaForCausalLM safetensors + config.json."""
+    torch = pytest.importorskip("torch")
+    from tokenizers import Tokenizer, decoders, models, pre_tokenizers, trainers
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    path = tmp_path_factory.mktemp("ckpt")
+
+    tok = Tokenizer(models.BPE())
+    tok.pre_tokenizer = pre_tokenizers.ByteLevel(add_prefix_space=False)
+    tok.decoder = decoders.ByteLevel()
+    corpus = [
+        '{"name": "echo__echo", "arguments": {"message": "hello"}}',
+        "fetch the page and echo the result please",
+        "tool call assistant system user json",
+    ] * 50
+    trainer = trainers.BpeTrainer(
+        vocab_size=384,
+        special_tokens=[EOT, EOS],
+        initial_alphabet=pre_tokenizers.ByteLevel.alphabet(),
+    )
+    tok.train_from_iterator(corpus, trainer)
+    tok.save(str(path / "tokenizer.json"))
+
+    vocab = tok.get_vocab_size()
+    hf_config = HFConfig(
+        vocab_size=vocab,
+        hidden_size=64,
+        num_hidden_layers=2,
+        num_attention_heads=4,
+        num_key_value_heads=2,
+        intermediate_size=128,
+        rms_norm_eps=1e-5,
+        rope_theta=10000.0,
+        max_position_embeddings=512,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_config).save_pretrained(str(path), safe_serialization=True)
+    return str(path)
+
+
+async def test_checkpoint_dir_drives_mcp_fetch_loop(checkpoint_dir):
+    params, config = load_safetensors_dir(checkpoint_dir)
+    tokenizer = HFTokenizer(os.path.join(checkpoint_dir, "tokenizer.json"))
+    assert tokenizer.vocab_size == config.vocab_size
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    engine = Engine(
+        config=config,
+        params=params,
+        tokenizer=tokenizer,
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=512,
+        prefill_buckets=(256, 512),
+        decode_block_size=4,
+    )
+    engine.start()
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.02
+    op.toolcall_reconciler.poll_interval = 0.02
+    store = op.store
+    try:
+        store.create(
+            MCPServer(
+                metadata=ObjectMeta(name="echo"),
+                spec=MCPServerSpec(
+                    transport="stdio", command=sys.executable, args=[ECHO_SERVER]
+                ),
+            )
+        )
+        setup_with_status(
+            store,
+            LLM(
+                metadata=ObjectMeta(name="ckpt-llm"),
+                spec=LLMSpec(
+                    provider="tpu",
+                    parameters=BaseConfig(model="ckpt", max_tokens=48, temperature=0.8),
+                    tpu=TPUProviderConfig(preset="tiny"),
+                    # force the MCP echo tool: the loop is deterministic even
+                    # with random weights
+                    provider_config={"tool_choice": "echo__echo"},
+                ),
+            ),
+            lambda o: (
+                setattr(o.status, "ready", True),
+                setattr(o.status, "status", "Ready"),
+            ),
+        )
+        await op.start()
+        # the real MCPServer controller connects + discovers tools
+        await wait_for(
+            store, "MCPServer", "echo", "default",
+            lambda s: s.status.connected, timeout=30,
+        )
+        make_agent(
+            store, name="fetcher", llm="ckpt-llm", system="use the echo tool",
+            mcp_servers=("echo",), resolved_tools={"echo": ["echo", "env", "fail"]},
+        )
+        make_task(store, name="fetch-task", agent="fetcher", user_message="go")
+
+        def tool_result_joined(t) -> bool:
+            return any(
+                m.role == "tool" and m.content.startswith("echo:")
+                for m in t.status.context_window
+            )
+
+        t = await wait_for(
+            store, "Task", "fetch-task", "default", tool_result_joined, timeout=180,
+        )
+        # the assistant turn before the tool result is a parseable forced call
+        calls = [
+            tc
+            for m in t.status.context_window
+            if m.role == "assistant" and m.tool_calls
+            for tc in m.tool_calls
+        ]
+        assert calls and calls[0].function.name == "echo__echo"
+        json.loads(calls[0].function.arguments)
+    finally:
+        await op.stop()
+        engine.stop()

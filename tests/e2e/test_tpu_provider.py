@@ -7,6 +7,7 @@ FinalAnswer with engine-generated text. (Output quality is meaningless with
 random weights; the invariants are flow + batching + checkpointing.)
 """
 
+import asyncio
 import dataclasses
 import os
 
@@ -154,5 +155,66 @@ async def test_64_concurrent_tasks_stress(engine):
                 lambda t: t.status.phase in ("FinalAnswer", "Failed"), timeout=600,
             )
             assert t.status.phase == "FinalAnswer", t.status.error
+    finally:
+        await op.stop()
+
+
+async def test_tool_choice_required_forces_tool_call(engine):
+    """tool_choice "required" (LLM.spec.providerConfig): the engine
+    teacher-forces the tool-call envelope and grammar-constrains the rest,
+    so even a RANDOM model reliably drives the Task into ToolCallsPending
+    with a real ToolCall CR — the full create->first-ToolCall path the TTFT
+    baseline metric measures."""
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.02
+    op.toolcall_reconciler.poll_interval = 0.02
+    store = op.store
+    setup_with_status(
+        store,
+        LLM(
+            metadata=ObjectMeta(name="tpu-forced"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="tiny", max_tokens=40, temperature=1.0),
+                tpu=TPUProviderConfig(preset="tiny"),
+                provider_config={"tool_choice": "required"},
+            ),
+        ),
+        lambda o: (
+            setattr(o.status, "ready", True),
+            setattr(o.status, "status", "Ready"),
+        ),
+    )
+    # the delegate tool needs no MCP subprocess
+    make_agent(store, name="leaf", llm="tpu-forced", system="leaf")
+    make_agent(
+        store, name="rooter", llm="tpu-forced", system="delegate",
+        sub_agents=("leaf",),
+    )
+    make_task(store, name="forced-task", agent="rooter", user_message="do the thing")
+    await op.start()
+    try:
+        # poll for the ToolCall CR itself: ToolCallsPending is transient
+        # (the delegate may resolve and loop the task back to ReadyForLLM)
+        import time as _time
+
+        deadline = _time.monotonic() + 120
+        ours = []
+        while _time.monotonic() < deadline and not ours:
+            ours = store.list(
+                "ToolCall", "default",
+                label_selector={"acp.tpu/task": "forced-task"},
+            )
+            await asyncio.sleep(0.05)
+        assert len(ours) >= 1
+        assert ours[0].spec.tool_ref.name == "delegate_to_agent__leaf"
+        import json as _json
+
+        _json.loads(ours[0].spec.arguments)  # grammar guaranteed this
     finally:
         await op.stop()

@@ -94,3 +94,112 @@ def test_engine_json_only_always_parses():
         assert r.finish_reason in ("stop", "length")
     finally:
         eng.stop()
+
+
+def test_automaton_random_walks_always_parse():
+    """Any random legal walk through the byte automaton that reaches DONE
+    must json.loads — the guarantee is total (exact literals, full number
+    grammar, strict escapes, no trailing commas), not merely structural."""
+    import random
+
+    import numpy as np
+
+    from agentcontrolplane_tpu.engine.constrain import JsonByteAutomaton
+
+    auto = JsonByteAutomaton()
+    trans = np.stack(auto._trans)
+    rng = random.Random(1234)
+    completed = 0
+    for _ in range(500):
+        sid = auto.start
+        out = bytearray()
+        for _ in range(200):
+            legal = np.nonzero(trans[sid] >= 0)[0]
+            assert len(legal) > 0, f"dead end after {bytes(out)!r}"
+            b = int(rng.choice(legal))
+            out.append(b)
+            sid = int(trans[sid][b])
+            if auto.is_done(sid):
+                break
+        if auto.is_done(sid):
+            obj = json.loads(out.decode("utf-8", "replace"))
+            assert isinstance(obj, dict)
+            completed += 1
+    assert completed > 100  # the walks genuinely exercise completion
+
+
+def test_forced_prefix_tool_call_always_parses():
+    """tool_choice forcing: teacher-force the '{"name": "X", "arguments": {'
+    envelope, grammar-constrain the rest — a RANDOM model's completion must
+    ALWAYS be a parseable call to X (engine/client.py tool_choice)."""
+    from agentcontrolplane_tpu.engine.toolparse import parse_tool_calls
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(), mesh=mesh,
+        max_slots=2, max_ctx=160, prefill_buckets=(64, 128),
+    )
+    eng.start()
+    try:
+        prefix = tuple(ByteTokenizer().encode('{"name": "web__fetch", "arguments": {'))
+        for i in range(3):
+            r = eng.generate(
+                f"call the tool {i}",
+                SamplingParams(
+                    temperature=1.3, max_tokens=100, json_only=True,
+                    forced_prefix=prefix,
+                ),
+            )
+            if r.finish_reason == "length":
+                continue
+            calls = parse_tool_calls(r.text)
+            assert len(calls) == 1, r.text
+            assert calls[0].function.name == "web__fetch"
+            json.loads(calls[0].function.arguments)
+        # illegal prefix fails fast instead of generating garbage
+        bad = tuple(ByteTokenizer().encode('}{ not json'))
+        fut = eng.submit("x", SamplingParams(json_only=True, forced_prefix=bad))
+        try:
+            fut.result(timeout=30)
+            raise AssertionError("expected illegal-prefix failure")
+        except RuntimeError as e:
+            assert "forced_prefix" in str(e)
+    finally:
+        eng.stop()
+
+
+def test_budget_aware_constraint_always_completes():
+    """json_only + tight max_tokens: the budget-aware mask steers generation
+    to close the object IN BUDGET — output always json.loads, even when the
+    finish_reason is 'length'."""
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(), mesh=mesh,
+        max_slots=2, max_ctx=128, prefill_buckets=(32, 64), decode_block_size=4,
+    )
+    eng.start()
+    try:
+        for max_toks in (8, 12, 24):
+            for i in range(2):
+                r = eng.generate(
+                    f"go {i}",
+                    SamplingParams(temperature=1.3, max_tokens=max_toks, json_only=True),
+                )
+                obj = json.loads(r.text)
+                assert isinstance(obj, dict), r.text
+        # forced tool envelope under a budget must still close
+        prefix = tuple(ByteTokenizer().encode('{"name": "t", "arguments": {'))
+        for i in range(3):
+            r = eng.generate(
+                f"x{i}",
+                SamplingParams(
+                    temperature=1.3, max_tokens=16, json_only=True,
+                    forced_prefix=prefix,
+                ),
+            )
+            obj = json.loads(r.text)
+            assert obj["name"] == "t" and isinstance(obj["arguments"], dict), r.text
+    finally:
+        eng.stop()

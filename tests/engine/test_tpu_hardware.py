@@ -1,0 +1,121 @@
+"""Real-TPU validation, opt-in via ACP_TEST_TPU=1 (VERDICT r1 #2).
+
+These run against the actual chip through the axon tunnel (NOT the forced
+CPU backend the rest of the suite uses): compiled-mode Pallas paged
+attention vs the XLA reference on-device, TPU-shaped tile sizes, and a
+slot-vs-paged engine equivalence on hardware.
+
+    ACP_TEST_TPU=1 python -m pytest tests/engine/test_tpu_hardware.py -q
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    not os.environ.get("ACP_TEST_TPU"),
+    reason="set ACP_TEST_TPU=1 to run against the real TPU",
+)
+
+
+@pytest.fixture(scope="module")
+def tpu():
+    import jax
+
+    if jax.default_backend() != "tpu":
+        pytest.skip(f"no TPU backend (got {jax.default_backend()})")
+    return jax.devices()[0]
+
+
+def _setup_tpu_shapes(seed=0, S=8, H=8, Hkv=8, d=128, P=16, max_pages=8, num_pages=128):
+    """TPU-native tile sizes: d=128 lanes, P a multiple of the sublane tile."""
+    import jax.numpy as jnp
+
+    from agentcontrolplane_tpu.ops.paged import PageAllocator, TRASH_PAGE
+
+    rng = np.random.default_rng(seed)
+    seq_lens = rng.integers(1, max_pages * P, size=S).astype(np.int32)
+    q = jnp.asarray(rng.normal(size=(S, H, d)), dtype=jnp.float32)
+    k_pages = np.zeros((num_pages, P, Hkv, d), dtype=np.float32)
+    v_pages = np.zeros((num_pages, P, Hkv, d), dtype=np.float32)
+    alloc = PageAllocator(num_pages)
+    tables = np.full((S, max_pages), TRASH_PAGE, dtype=np.int32)
+    for s in range(S):
+        n = -(-int(seq_lens[s]) // P)
+        pages = alloc.alloc(n)
+        tables[s, :n] = pages
+        kv = rng.normal(size=(2, int(seq_lens[s]), Hkv, d)).astype(np.float32)
+        for j, page in enumerate(pages):
+            lo, hi = j * P, min((j + 1) * P, int(seq_lens[s]))
+            k_pages[page, : hi - lo] = kv[0][lo:hi]
+            v_pages[page, : hi - lo] = kv[1][lo:hi]
+    return (
+        q,
+        jnp.asarray(k_pages),
+        jnp.asarray(v_pages),
+        jnp.asarray(tables),
+        jnp.asarray(seq_lens),
+    )
+
+
+def test_compiled_pallas_paged_attention_matches_reference(tpu):
+    """The double-buffered DMA kernel, COMPILED on hardware (not interpret
+    mode), must agree with the XLA gather reference."""
+    import jax
+
+    from agentcontrolplane_tpu.ops.paged import paged_decode_attention_reference
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    q, k_pages, v_pages, tables, seq_lens = _setup_tpu_shapes()
+    ref = jax.jit(paged_decode_attention_reference)(q, k_pages, v_pages, tables, seq_lens)
+    out = jax.jit(paged_decode_attention)(q, k_pages, v_pages, tables, seq_lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_compiled_pallas_gqa_shapes(tpu):
+    import jax
+
+    from agentcontrolplane_tpu.ops.paged import paged_decode_attention_reference
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    q, k_pages, v_pages, tables, seq_lens = _setup_tpu_shapes(
+        seed=1, S=4, H=32, Hkv=8, d=128, P=32, max_pages=4, num_pages=64
+    )
+    ref = jax.jit(paged_decode_attention_reference)(q, k_pages, v_pages, tables, seq_lens)
+    out = jax.jit(paged_decode_attention)(q, k_pages, v_pages, tables, seq_lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_engine_slot_and_paged_agree_on_tpu(tpu):
+    """Greedy decode through BOTH kv layouts on hardware must produce the
+    same tokens (the paged path uses the compiled Pallas kernel: engine
+    _use_pallas is True on the tpu backend)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    results = {}
+    for layout in ("slot", "paged"):
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            max_slots=2,
+            max_ctx=128,
+            prefill_buckets=(64, 128),
+            decode_block_size=8,
+            kv_layout=layout,
+            seed=0,
+        )
+        assert layout == "slot" or eng._use_pallas, "paged on TPU must compile Pallas"
+        eng.start()
+        try:
+            results[layout] = eng.generate(
+                "the quick brown fox", SamplingParams(temperature=0.0, max_tokens=24)
+            ).tokens
+        finally:
+            eng.stop()
+    assert results["slot"] == results["paged"]

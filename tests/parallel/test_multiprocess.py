@@ -1,0 +1,78 @@
+"""REAL multi-process jax.distributed: two OS processes form one global mesh
+over gRPC coordination and train in lockstep (VERDICT r1 #6 — exercises
+parallel/distributed.py beyond single-process virtual meshes).
+
+The worker (mp_worker.py) joins a 2-process cluster, builds the
+dp(across-process) x tp(in-process) mesh, and runs two deterministic train
+steps. Assertions: both processes observe identical losses (SPMD — the psum
+crossed the process boundary), and those losses match a single-process run
+of the same global computation.
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+WORKER = os.path.join(os.path.dirname(__file__), "mp_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(pid: int, nproc: int, port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)  # worker forces cpu via jax.config
+    return subprocess.Popen(
+        [sys.executable, WORKER, str(pid), str(nproc), str(port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+
+
+def test_two_process_train_step_matches_single_process():
+    port = _free_port()
+    procs = [_spawn(i, 2, port) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    for o in outs:
+        assert o["info"]["process_count"] == 2
+        assert o["info"]["global_devices"] == 4
+    # SPMD: both processes computed the same global losses
+    assert outs[0]["losses"] == pytest.approx(outs[1]["losses"], rel=1e-6)
+
+    # single-process reference: same mesh shape, all 4 devices local
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    ref = subprocess.run(
+        [sys.executable, WORKER, "0", "1", "0"],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=420,
+    )
+    assert ref.returncode == 0, ref.stderr[-2000:]
+    ref_losses = json.loads(ref.stdout.strip().splitlines()[-1])["losses"]
+    assert outs[0]["losses"] == pytest.approx(ref_losses, rel=1e-4)

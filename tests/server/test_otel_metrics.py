@@ -1,0 +1,63 @@
+"""OTLP metrics export (observability/otel_metrics.py — the reference's
+meter provider, internal/otel/otel.go:58-80) against a live fake collector."""
+
+import asyncio
+import json
+
+from aiohttp import web
+
+from agentcontrolplane_tpu.observability.metrics import Registry
+from agentcontrolplane_tpu.observability.otel_metrics import MetricsExporter
+
+
+async def test_exporter_pushes_otlp_json():
+    received: list[dict] = []
+
+    async def collect(request: web.Request) -> web.Response:
+        received.append(json.loads(await request.read()))
+        return web.json_response({})
+
+    app = web.Application()
+    app.router.add_post("/v1/metrics", collect)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    try:
+        reg = Registry()
+        reg.counter_add("acp_reconcile_total", 3.0, {"controller": "task"}, help="reconciles")
+        reg.gauge_set("acp_engine_active_slots", 5.0, help="slots")
+        reg.observe("acp_engine_ttft_seconds", 0.25, help="ttft")
+        reg.observe("acp_engine_ttft_seconds", 0.35)
+
+        exporter = MetricsExporter(reg, endpoint=f"http://127.0.0.1:{port}")
+        ok = await asyncio.to_thread(exporter.export_once)
+        assert ok
+        assert len(received) == 1
+        doc = received[0]
+        scope = doc["resourceMetrics"][0]["scopeMetrics"][0]
+        by_name = {m["name"]: m for m in scope["metrics"]}
+        ctr = by_name["acp_reconcile_total"]["sum"]
+        assert ctr["isMonotonic"] and ctr["dataPoints"][0]["asDouble"] == 3.0
+        assert ctr["dataPoints"][0]["attributes"] == [
+            {"key": "controller", "value": {"stringValue": "task"}}
+        ]
+        assert by_name["acp_engine_active_slots"]["gauge"]["dataPoints"][0]["asDouble"] == 5.0
+        summ = by_name["acp_engine_ttft_seconds"]["summary"]["dataPoints"][0]
+        assert summ["count"] == "2"
+        assert abs(summ["sum"] - 0.6) < 1e-9
+        assert any(q["quantile"] == 0.5 for q in summ["quantileValues"])
+    finally:
+        await runner.cleanup()
+
+
+async def test_exporter_noop_without_endpoint_and_graceful_on_refused():
+    exporter = MetricsExporter(Registry(), endpoint="")
+    exporter.start()  # no-op
+    assert exporter._thread is None
+    exporter.stop()
+
+    dead = MetricsExporter(Registry(), endpoint="http://127.0.0.1:1")
+    assert (await asyncio.to_thread(dead.export_once)) is False  # silent, no raise
