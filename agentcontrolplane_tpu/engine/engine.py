@@ -98,7 +98,17 @@ class _Request:
     prompt: list[int]
     sampling: SamplingParams
     future: Future
+    # called from the ENGINE thread with each block's newly sampled token
+    # ids (must not block; bridge to asyncio with call_soon_threadsafe)
+    on_tokens: Optional[callable] = None
     enqueued: float = field(default_factory=time.monotonic)
+
+    def emit(self, tokens: list[int]) -> None:
+        if self.on_tokens is not None and tokens:
+            try:
+                self.on_tokens(tokens)
+            except Exception:  # a broken consumer must not kill the engine
+                self.on_tokens = None
 
 
 @dataclass
@@ -385,9 +395,14 @@ class Engine:
         self._thread = None
 
     def submit(
-        self, prompt: str | list[int], sampling: Optional[SamplingParams] = None
+        self,
+        prompt: str | list[int],
+        sampling: Optional[SamplingParams] = None,
+        on_tokens=None,
     ) -> Future:
-        """Thread-safe; returns a Future[GenerationResult]."""
+        """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
+        (optional) streams newly sampled token ids per decode block from the
+        engine thread — keep it non-blocking."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         s = sampling or SamplingParams()
         prefix_len = len(s.forced_prefix)
@@ -403,6 +418,7 @@ class Engine:
             prompt=tokens,
             sampling=sampling or SamplingParams(),
             future=Future(),
+            on_tokens=on_tokens,
         )
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
@@ -717,6 +733,10 @@ class Engine:
             )
             sl.generated.extend(s.forced_prefix)
             sl.generated.append(first_tok)
+            if first_tok not in self.tokenizer.stop_tokens:
+                req.emit(list(s.forced_prefix) + [first_tok])
+            elif s.forced_prefix:
+                req.emit(list(s.forced_prefix))
             self._slots[slot] = sl
             self._seq_lens[slot] = lengths[i]
             self._last_tokens[slot] = first_tok
@@ -830,6 +850,7 @@ class Engine:
         for slot, sl in active:
             s = sl.request.sampling
             done = None
+            block_new: list[int] = []
             for k in range(K):
                 tok = int(tok_block[k, slot])
                 self._seq_lens[slot] += 1
@@ -839,12 +860,14 @@ class Engine:
                 if tok in self.tokenizer.stop_tokens:
                     done = "stop"
                     break
+                block_new.append(tok)
                 if (
                     len(sl.generated) - sl.prefix_len >= s.max_tokens
                     or self._seq_lens[slot] + 1 >= self.max_ctx
                 ):
                     done = "length"
                     break
+            sl.request.emit(block_new)
             if done is not None:
                 self._finish(slot, done)
         REGISTRY.gauge_set(

@@ -670,8 +670,12 @@ class RestServer:
             # with unparseable tool_calls[].function.arguments is malformed
             # *client* input and must 400, not 500
             prompt = render_prompt(messages, tools)
+            stream = bool(body.get("stream"))
         except Exception as e:
             return _json_error(400, f"invalid request: {e}")
+
+        if stream:
+            return await self._stream_chat(request, engine, prompt, sampling, tools, body)
 
         fut = engine.submit(prompt, sampling)
         try:
@@ -722,6 +726,123 @@ class RestServer:
                 },
             }
         )
+
+    async def _stream_chat(self, request, engine, prompt, sampling, tools, body):
+        """SSE streaming (OpenAI chat.completion.chunk wire format): token
+        deltas flow from the engine thread per decode block. With tools, the
+        streamed content is the raw (grammar-constrained) JSON text; if the
+        final text parses into tool calls, a tool_calls delta follows before
+        the finish chunk."""
+        import asyncio as _asyncio
+        import time as _time
+        import uuid as _uuid
+
+        from ..engine.toolparse import to_message
+
+        loop = _asyncio.get_running_loop()
+        q: _asyncio.Queue = _asyncio.Queue()
+        fut = engine.submit(
+            prompt, sampling,
+            on_tokens=lambda ids: loop.call_soon_threadsafe(q.put_nowait, list(ids)),
+        )
+        resp = web.StreamResponse(
+            headers={
+                "Content-Type": "text/event-stream",
+                "Cache-Control": "no-cache",
+                "Connection": "keep-alive",
+            }
+        )
+        await resp.prepare(request)
+        cid = f"chatcmpl-{_uuid.uuid4().hex[:24]}"
+        created = int(_time.time())
+        model = body.get("model") or "tpu"
+
+        def chunk(delta: dict, finish: Optional[str] = None) -> bytes:
+            doc = {
+                "id": cid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": model,
+                "choices": [{"index": 0, "delta": delta, "finish_reason": finish}],
+            }
+            return f"data: {json.dumps(doc)}\n\n".encode()
+
+        pending: list[int] = []  # ids not yet emitted (decode is O(block))
+        sent = 0  # chars already streamed
+        timed_out = False
+        deadline = _time.monotonic() + 600
+
+        async def error_event(message: str, etype: str) -> None:
+            # OpenAI-style streamed error event; no [DONE] after an error
+            await resp.write(
+                f'data: {json.dumps({"error": {"message": message, "type": etype}})}\n\n'.encode()
+            )
+
+        try:
+            await resp.write(chunk({"role": "assistant"}))
+            while not fut.done() or not q.empty():
+                if _time.monotonic() > deadline:
+                    engine.cancel(fut)
+                    timed_out = True
+                    break
+                try:
+                    ids = await _asyncio.wait_for(q.get(), timeout=0.1)
+                except _asyncio.TimeoutError:
+                    continue
+                pending.extend(ids)
+                text = engine.tokenizer.decode(pending)
+                if text.endswith("�"):
+                    continue  # partial multi-byte char at a block edge
+                if text:
+                    await resp.write(chunk({"content": text}))
+                    sent += len(text)
+                pending.clear()
+            if timed_out:
+                await error_event("generation timed out", "timeout")
+                await resp.write_eof()
+                return resp
+            try:
+                result = fut.result(timeout=30)
+            except Exception as e:
+                await error_event(f"generation failed: {e}", "server_error")
+                await resp.write_eof()
+                return resp
+            # authoritative final flush: result.text is the full output, so
+            # this also covers tokens whose queue callback raced the loop
+            # exit and any held-back replacement chars
+            delta = result.text[sent:]
+            if delta:
+                await resp.write(chunk({"content": delta}))
+            finish = "length" if result.finish_reason == "length" else "stop"
+            allowed = {t.function.name for t in tools} if tools else None
+            msg = to_message(result.text, allowed)
+            if msg.tool_calls:
+                await resp.write(
+                    chunk(
+                        {
+                            "tool_calls": [
+                                {
+                                    "index": i,
+                                    "id": tc.id,
+                                    "type": "function",
+                                    "function": {
+                                        "name": tc.function.name,
+                                        "arguments": tc.function.arguments,
+                                    },
+                                }
+                                for i, tc in enumerate(msg.tool_calls)
+                            ]
+                        }
+                    )
+                )
+                finish = "tool_calls"
+            await resp.write(chunk({}, finish))
+            await resp.write(b"data: [DONE]\n\n")
+        except (ConnectionResetError, _asyncio.CancelledError):
+            engine.cancel(fut)  # client went away mid-stream
+            raise
+        await resp.write_eof()
+        return resp
 
     # -- observability ----------------------------------------------------
 

@@ -404,3 +404,63 @@ async def test_bearer_token_auth():
             f"{h.base}/v1/tasks", headers={"Authorization": "Bearer wrong"}
         )
         assert bad.status == 401
+
+
+async def test_chat_completions_streaming_sse():
+    """stream:true — OpenAI chat.completion.chunk SSE: role chunk, content
+    deltas whose concatenation equals the non-streamed text, finish chunk,
+    [DONE]."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256), decode_block_size=4,
+    )
+    eng.start()
+    try:
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            payload = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "stream me"}],
+                "max_tokens": 12,
+                "temperature": 0,
+            }
+            # non-streamed reference text
+            ref = await (await h.http.post(
+                f"{h.base}/v1/chat/completions", json=payload
+            )).json()
+            ref_text = ref["choices"][0]["message"]["content"] or ""
+
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions", json={**payload, "stream": True}
+            )
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            raw = (await resp.read()).decode()
+            events = [
+                json.loads(line[len("data: "):])
+                for line in raw.splitlines()
+                if line.startswith("data: ") and line != "data: [DONE]"
+            ]
+            assert raw.rstrip().endswith("data: [DONE]")
+            assert events[0]["choices"][0]["delta"].get("role") == "assistant"
+            assert all(e["object"] == "chat.completion.chunk" for e in events)
+            content = "".join(
+                e["choices"][0]["delta"].get("content") or "" for e in events
+            )
+            assert content == ref_text
+            finishes = [e["choices"][0]["finish_reason"] for e in events]
+            assert finishes[-1] in ("stop", "length")
+    finally:
+        eng.stop()
