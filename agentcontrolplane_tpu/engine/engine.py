@@ -37,7 +37,7 @@ import uuid
 from concurrent.futures import Future
 from dataclasses import dataclass, field
 from functools import partial
-from typing import Optional, Sequence
+from typing import Any, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -101,6 +101,9 @@ class _Request:
     # called from the ENGINE thread with each block's newly sampled token
     # ids (must not block; bridge to asyncio with call_soon_threadsafe)
     on_tokens: Optional[callable] = None
+    # tail-truncated prompts keep their suffix, not their prefix — they can
+    # neither hit nor usefully seed the prefix cache
+    truncated: bool = False
     enqueued: float = field(default_factory=time.monotonic)
 
     def emit(self, tokens: list[int]) -> None:
@@ -127,6 +130,20 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
     return buckets[-1]
 
 
+def _pow2_chunks(items: list, max_chunk: int) -> list[list]:
+    """Split into power-of-two-sized chunks (7 -> [4, 2, 1]) so each batch
+    size is its own (bounded) jit cache entry."""
+    out: list[list] = []
+    i = 0
+    while i < len(items):
+        b = 1
+        while b * 2 <= min(len(items) - i, max_chunk):
+            b *= 2
+        out.append(items[i : i + b])
+        i += b
+    return out
+
+
 class Engine:
     def __init__(
         self,
@@ -139,6 +156,8 @@ class Engine:
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
         prefill_batch_max: int = 8,  # burst admissions batch up to this many prompts
         width_buckets: Sequence[int] = (1, 2, 4, 8),  # low-occupancy decode widths
+        prefix_cache_entries: int = 4,  # 0 disables; slot layout only
+        prefix_cache_max_tokens: int = 4096,  # HBM bound: total cached KV tokens
         decode_block_size: int = 8,
         kv_layout: str = "slot",  # "slot" | "paged"
         page_size: int = 16,
@@ -259,6 +278,28 @@ class Engine:
         self._constrained = np.zeros(max_slots, dtype=bool)
         # table width = MODEL vocab (logits width); tokenizer vocab may be
         # smaller — those extra logits are simply forbidden under constraint
+        # prefix KV cache (slot layout): LRU of prompt-prefix -> device KV
+        # [L, cut, H_kv, d]. Agent workloads re-send growing conversations
+        # with identical system prompts; a hit copies the cached KV into the
+        # slot and prefills only the suffix — per-turn prefill becomes
+        # O(new tokens) instead of O(whole conversation).
+        import collections as _collections
+
+        self._prefix_enabled = prefix_cache_entries > 0 and self.kv_layout == "slot"
+        self._prefix_cache_entries = prefix_cache_entries
+        # HBM accounting: per cached token one K+V row per layer
+        # (L * H_kv * d * 2 * dtype bytes); the token bound keeps worst-case
+        # cache HBM explicit instead of silently scaling with bucket sizes
+        self._prefix_cache_max_tokens = prefix_cache_max_tokens
+        self._prefix_cache: "_collections.OrderedDict[tuple, dict]" = (
+            _collections.OrderedDict()
+        )
+        # engine thread mutates; stats() reads from REST threads
+        self._prefix_lock = threading.Lock()
+        self._jit_copy_prefix: dict[int, Any] = {}
+        self._jit_extract_prefix: dict[int, Any] = {}
+        self._prefix_hits = 0
+        self._prefix_misses = 0
         self._token_table = None
         self._min_close = None
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
@@ -371,6 +412,17 @@ class Engine:
                 return cache, toks, states
 
             self._jit_prefill = jax.jit(prefill_and_sample, donate_argnums=(1,))
+
+            from ..models.llama import prefill_continue
+
+            def continue_and_sample(params, cache, tokens, lengths, starts, slots, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
+                cache, logits = prefill_continue(
+                    params, cache, tokens, lengths, starts, slots, config
+                )
+                toks, states = sample_first(logits, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets)
+                return cache, toks, states
+
+            self._jit_prefill_continue = jax.jit(continue_and_sample, donate_argnums=(1,))
             self._jit_decode = make_decode_block(
                 lambda params, cache, tokens, seq_lens, active: decode_step(
                     params, cache, tokens, seq_lens, config
@@ -411,7 +463,8 @@ class Engine:
         # every response (and any forced tool call) truncates immediately
         reserve = min(s.max_tokens, max(1, self.max_ctx // 2))
         budget = max(1, self.max_ctx - prefix_len - reserve)
-        if len(tokens) > budget:
+        truncated = len(tokens) > budget
+        if truncated:
             tokens = tokens[-budget:]
         req = _Request(
             rid=uuid.uuid4().hex[:8],
@@ -419,6 +472,7 @@ class Engine:
             sampling=sampling or SamplingParams(),
             future=Future(),
             on_tokens=on_tokens,
+            truncated=truncated,
         )
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
@@ -474,6 +528,15 @@ class Engine:
                 "free": self._allocator.free_count,
                 "page_size": self.page_size,
             }
+        if self._prefix_enabled:
+            with self._prefix_lock:
+                out["prefix_cache"] = {
+                    "entries": len(self._prefix_cache),
+                    "capacity": self._prefix_cache_entries,
+                    "hits": self._prefix_hits,
+                    "misses": self._prefix_misses,
+                    "cached_tokens": sum(e["cut"] for e in self._prefix_cache.values()),
+                }
         return out
 
     # -- engine loop -----------------------------------------------------
@@ -554,15 +617,112 @@ class Engine:
             if not group:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
-            # power-of-two chunks keep the jit cache small: 7 -> [4, 2, 1]
-            i = 0
-            while i < len(group):
-                b = 1
-                while b * 2 <= min(len(group) - i, self.prefill_batch_max):
-                    b *= 2
-                self._prefill_group(group[i : i + b])
-                i += b
+            # split by prefix-cache outcome (hits run the suffix-only
+            # continuation program), then into power-of-two chunks so each
+            # batch size is a bounded jit cache entry
+            hits: list = []
+            misses: list = []
+            for item in group:
+                m = self._match_prefix(item[0]) if self._prefix_enabled else None
+                (hits if m else misses).append((item, m))
+            for chunk in _pow2_chunks(misses, self.prefill_batch_max):
+                self._prefill_group([it for it, _ in chunk])
+            for chunk in _pow2_chunks(hits, self.prefill_batch_max):
+                self._prefill_group(
+                    [it for it, _ in chunk], matches=[m for _, m in chunk]
+                )
         return admitted
+
+    # -- prefix KV cache (slot layout) -----------------------------------
+
+    @staticmethod
+    def _full_row(req: _Request) -> list[int]:
+        """The tokens a request prefills: prompt + teacher-forced prefix."""
+        return list(req.prompt) + list(req.sampling.forced_prefix)
+
+    def _match_prefix(self, req: _Request) -> Optional[tuple]:
+        """Longest cached entry whose key is a strict prefix of the row
+        (strict: at least one suffix token must remain to produce logits)."""
+        if req.truncated:
+            return None
+        full = self._full_row(req)
+        with self._prefix_lock:
+            best_key, best = None, None
+            for key, entry in self._prefix_cache.items():
+                cut = entry["cut"]
+                if cut < len(full) and (best is None or cut > best["cut"]):
+                    if tuple(full[:cut]) == key:
+                        best_key, best = key, entry
+            if best_key is None:
+                return None
+            self._prefix_cache.move_to_end(best_key)
+            return (best_key, best)
+
+    def _copy_prefix_into_slot(self, slot: int, entry: dict) -> None:
+        cut = entry["cut"]
+        fn = self._jit_copy_prefix.get(cut)
+        if fn is None:
+
+            def copy(cache, slot_, ek, ev):
+                k = jax.lax.dynamic_update_slice(
+                    cache["k"], ek[:, None], (0, slot_, 0, 0, 0)
+                )
+                v = jax.lax.dynamic_update_slice(
+                    cache["v"], ev[:, None], (0, slot_, 0, 0, 0)
+                )
+                return {"k": k, "v": v}
+
+            fn = jax.jit(copy, donate_argnums=(0,))
+            self._jit_copy_prefix[cut] = fn
+        self.cache = fn(self.cache, jnp.int32(slot), entry["k"], entry["v"])
+
+    def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:
+        """After a miss prefill: snapshot the slot's leading KV at the
+        largest bucket boundary as a reusable prefix entry (LRU-capped).
+        The cut never reaches past the PROMPT into the teacher-forced
+        generation prefix — the next turn's rendered prompt contains the
+        serialized assistant message, not the raw forced tokens, so a key
+        crossing that boundary could never match again."""
+        if not self._prefix_enabled:
+            return
+        cap = min(prompt_len, len(full) - 1)
+        cut = 0
+        for b in self.prefill_buckets:
+            if b <= cap:
+                cut = b
+        if cut < self.prefill_buckets[0]:
+            return  # too short to be worth caching
+        key = tuple(full[:cut])
+        with self._prefix_lock:
+            if key in self._prefix_cache:
+                self._prefix_cache.move_to_end(key)
+                return
+        fn = self._jit_extract_prefix.get(cut)
+        if fn is None:
+            L = self.config.n_layers
+            Hkv = self.config.n_kv_heads
+            d = self.config.head_dim
+
+            def extract(cache, slot_):
+                ek = jax.lax.dynamic_slice(
+                    cache["k"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
+                )[:, 0]
+                ev = jax.lax.dynamic_slice(
+                    cache["v"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
+                )[:, 0]
+                return ek, ev
+
+            fn = jax.jit(extract)  # read-only: cache NOT donated
+            self._jit_extract_prefix[cut] = fn
+        ek, ev = fn(self.cache, jnp.int32(slot))
+        with self._prefix_lock:
+            self._prefix_cache[key] = {"cut": cut, "k": ek, "v": ev}
+            while len(self._prefix_cache) > self._prefix_cache_entries or (
+                len(self._prefix_cache) > 1
+                and sum(e["cut"] for e in self._prefix_cache.values())
+                > self._prefix_cache_max_tokens
+            ):
+                self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
 
     def _collect_group(self) -> list[tuple[_Request, int, Optional[list[int]]]]:
         """Pop up to prefill_batch_max admissible head requests, reserving a
@@ -636,16 +796,33 @@ class Engine:
             )
         return self._token_table
 
-    def _prefill_group(self, chunk: list[tuple[_Request, int, Optional[list[int]]]]) -> None:
+    def _prefill_group(
+        self,
+        chunk: list[tuple[_Request, int, Optional[list[int]]]],
+        matches: Optional[list[tuple]] = None,
+    ) -> None:
         """One batched prefill dispatch for B already-reserved requests
         (B = power of two <= prefill_batch_max). Burst admissions no longer
         serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
-        batch-1 prefills."""
+        batch-1 prefills. With ``matches`` (prefix-cache hits), each slot
+        first receives its cached prefix KV and only the SUFFIX runs through
+        the model (prefill_continue)."""
         B = len(chunk)
-        full = lambda r: list(r.prompt) + list(r.sampling.forced_prefix)
+        starts = np.zeros(B, dtype=np.int32)
+        if matches is not None:
+            for i, ((req, slot, _), (_key, entry)) in enumerate(zip(chunk, matches)):
+                self._copy_prefix_into_slot(slot, entry)
+                starts[i] = entry["cut"]
+            self._prefix_hits += B
+            REGISTRY.counter_add("acp_engine_prefix_cache_hit_requests", float(B))
+        elif self._prefix_enabled:
+            self._prefix_misses += B
+            REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", float(B))
+        # bucket over what actually runs through the model (full row on a
+        # miss; suffix on a hit)
         bucket = max(
-            _next_bucket(len(r.prompt) + len(r.sampling.forced_prefix), self.prefill_buckets)
-            for r, _, _ in chunk
+            _next_bucket(len(self._full_row(r)) - int(starts[i]), self.prefill_buckets)
+            for i, (r, _, _) in enumerate(chunk)
         )
         tokens = np.zeros((B, bucket), dtype=np.int32)
         lengths = np.zeros(B, dtype=np.int32)
@@ -656,6 +833,7 @@ class Engine:
         con_states0 = np.zeros(B, dtype=np.int32)
         constrained0 = np.zeros(B, dtype=bool)
         budgets = np.zeros(B, dtype=np.int32)
+        full_lens = np.zeros(B, dtype=np.int32)
         any_json = any(r.sampling.json_only for r, _, _ in chunk)
         if any_json:
             table = self._get_token_table()
@@ -667,10 +845,12 @@ class Engine:
             )
         for i, (req, slot, _) in enumerate(chunk):
             s = req.sampling
-            row = full(req)
+            row = self._full_row(req)
             plen = len(row)
-            tokens[i, :plen] = row
-            lengths[i] = plen
+            full_lens[i] = plen
+            suffix = row[int(starts[i]) :]
+            tokens[i, : len(suffix)] = suffix
+            lengths[i] = len(suffix)
             slots[i] = slot
             temps[i] = s.temperature
             top_ks[i] = s.top_k
@@ -712,11 +892,24 @@ class Engine:
             cache, firsts, con_states = self._jit_prefill_paged(
                 self.params, self.cache, *common, jnp.asarray(page_ids), *tail
             )
+        elif matches is not None:
+            cache, firsts, con_states = self._jit_prefill_continue(
+                self.params, self.cache, *common,
+                jnp.asarray(starts), jnp.asarray(slots), *tail,
+            )
         else:
             cache, firsts, con_states = self._jit_prefill(
                 self.params, self.cache, *common, jnp.asarray(slots), *tail
             )
         self.cache = cache
+        if self.kv_layout == "slot":
+            # snapshot prefixes for future hits (engine thread; the rows
+            # can't change before decode extends past the cut). Hit slots
+            # save too: their rows now hold the FULL prompt KV, so the next
+            # turn can reuse this whole context, not just the old prefix.
+            for i, (req, slot, _) in enumerate(chunk):
+                if not req.truncated:
+                    self._save_prefix(self._full_row(req), len(req.prompt), slot)
         firsts = np.asarray(firsts)
         con_states = np.asarray(con_states)
         now = time.monotonic()
@@ -738,7 +931,7 @@ class Engine:
             elif s.forced_prefix:
                 req.emit(list(s.forced_prefix))
             self._slots[slot] = sl
-            self._seq_lens[slot] = lengths[i]
+            self._seq_lens[slot] = full_lens[i]  # cached prefix + suffix
             self._last_tokens[slot] = first_tok
             self._temps[slot] = s.temperature
             self._top_ks[slot] = s.top_k

@@ -28,6 +28,7 @@ import jax.numpy as jnp
 
 from ..ops.attention import (
     causal_attention,
+    continue_attention,
     decode_attention,
     write_kv_token,
 )
@@ -325,6 +326,54 @@ def prefill(
         params, cache, tokens[None], length[None], slot[None], config
     )
     return cache, logits[0]
+
+
+def prefill_continue(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true suffix lengths
+    starts: jax.Array,  # [B] int32 — absolute position of each suffix start
+    slots: jax.Array,  # [B] int32
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Prefix-cache continuation: the first ``starts[b]`` positions of each
+    slot's KV rows were already populated (copied from the prefix cache);
+    run only the suffix through the model, attending over prefix + suffix.
+    Costs O(suffix) model FLOPs instead of O(full prompt) — the win that
+    makes multi-turn agent conversations cheap (each turn's prompt extends
+    the previous one). Returns (cache, last-token logits [B, V])."""
+    c = config
+    B, T = tokens.shape
+    ar = jnp.arange(T)
+    positions = jnp.where(ar[None, :] < lengths[:, None], starts[:, None] + ar[None, :], -1)
+    x = params["embed"][tokens].astype(c.dtype)
+    C = cache["k"].shape[2]
+    # scatter indices for the suffix writes; clamped so bucket padding can
+    # never write past the row (clamped garbage lands at C-1, which is
+    # always re-written by decode before it becomes readable)
+    write_pos = jnp.minimum(starts[:, None] + ar[None, :], C - 1)  # [B, T]
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_cache_l, v_cache_l = scanned
+
+        def attn(q, k, v):
+            k_l = k_cache_l.at[slots[:, None], write_pos].set(k.astype(k_cache_l.dtype))
+            v_l = v_cache_l.at[slots[:, None], write_pos].set(v.astype(v_cache_l.dtype))
+            out = continue_attention(q, k_l[slots], v_l[slots], positions)
+            attn.updated = (k_l, v_l)
+            return out
+
+        out, _, _ = _attn_mlp(x, layer, c, positions, attn)
+        return out, attn.updated
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    last = x[jnp.arange(B), lengths - 1]
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits
 
 
 # ---------------------------------------------------------------------------

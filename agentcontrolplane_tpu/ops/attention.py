@@ -78,6 +78,32 @@ def decode_attention(
     return jnp.einsum("shc,schd->shd", probs, v)
 
 
+def continue_attention(
+    q: jax.Array,  # [B, T, H, d] — suffix queries
+    k_rows: jax.Array,  # [B, C, H_kv, d] — the slots' full cache rows
+    v_rows: jax.Array,
+    positions: jax.Array,  # [B, T] absolute query positions (-1 = padding)
+) -> jax.Array:
+    """Suffix-over-cache attention (prefix-cache continuation): each query
+    attends to every cache position <= its own absolute position — exactly
+    causal, because everything below the query is valid prefix or
+    just-written suffix."""
+    B, T, H, d = q.shape
+    C = k_rows.shape[1]
+    n_rep = H // k_rows.shape[-2]
+    k = repeat_kv(k_rows, n_rep)
+    v = repeat_kv(v_rows, n_rep)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = jnp.einsum("bthd,bchd->bhtc", q, k).astype(jnp.float32) * scale
+    mask = (
+        (jnp.arange(C)[None, None, None, :] <= positions[:, None, :, None])
+        & (positions >= 0)[:, None, :, None]
+    )
+    logits = jnp.where(mask, logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhtc,bchd->bthd", probs, v)
+
+
 def write_kv(
     k_cache: jax.Array,  # [S, C, H_kv, d]
     v_cache: jax.Array,

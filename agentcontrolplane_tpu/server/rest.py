@@ -771,6 +771,10 @@ class RestServer:
         sent = 0  # chars already streamed
         timed_out = False
         deadline = _time.monotonic() + 600
+        # with tools offered the final message is EITHER content OR
+        # tool_calls (matching the non-streamed path): buffer instead of
+        # streaming raw tool-call JSON as content deltas
+        buffer_mode = bool(tools)
 
         async def error_event(message: str, etype: str) -> None:
             # OpenAI-style streamed error event; no [DONE] after an error
@@ -790,6 +794,8 @@ class RestServer:
                 except _asyncio.TimeoutError:
                     continue
                 pending.extend(ids)
+                if buffer_mode:
+                    continue
                 text = engine.tokenizer.decode(pending)
                 if text.endswith("�"):
                     continue  # partial multi-byte char at a block edge
@@ -807,15 +813,16 @@ class RestServer:
                 await error_event(f"generation failed: {e}", "server_error")
                 await resp.write_eof()
                 return resp
-            # authoritative final flush: result.text is the full output, so
-            # this also covers tokens whose queue callback raced the loop
-            # exit and any held-back replacement chars
-            delta = result.text[sent:]
-            if delta:
-                await resp.write(chunk({"content": delta}))
             finish = "length" if result.finish_reason == "length" else "stop"
             allowed = {t.function.name for t in tools} if tools else None
             msg = to_message(result.text, allowed)
+            if not (buffer_mode and msg.tool_calls):
+                # authoritative final flush: result.text covers tokens whose
+                # queue callback raced the loop exit and held-back chars;
+                # in buffer mode this is the whole (non-tool-call) content
+                delta = result.text[sent:]
+                if delta:
+                    await resp.write(chunk({"content": delta}))
             if msg.tool_calls:
                 await resp.write(
                     chunk(

@@ -1,0 +1,114 @@
+"""Prefix KV cache: hits must be bit-identical to cold prefills.
+
+Agent workloads re-send growing conversations with identical system
+prompts; the engine snapshots prefix KV at bucket boundaries and, on a hit,
+copies it into the slot and runs only the suffix (models/llama.py
+prefill_continue)."""
+
+import dataclasses
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+CFG = dataclasses.replace(
+    PRESETS["tiny"], vocab_size=512, max_seq_len=512, n_kv_heads=2
+)
+
+
+def _engine(prefix_entries: int) -> Engine:
+    eng = Engine(
+        config=CFG,
+        tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=4,
+        max_ctx=256,
+        prefill_buckets=(64, 128, 256),
+        decode_block_size=4,
+        prefix_cache_entries=prefix_entries,
+        seed=0,
+    )
+    eng.start()
+    return eng
+
+
+SYSTEM = "you are an agent with tools. " * 4  # > smallest bucket (64 bytes)
+
+
+def test_hit_results_match_cold_engine():
+    greedy = SamplingParams(temperature=0.0, max_tokens=12)
+    cached = _engine(prefix_entries=4)
+    cold = _engine(prefix_entries=0)
+    try:
+        prompts = [SYSTEM + "turn one", SYSTEM + "turn one plus more text"]
+        # first generation seeds the cache; the second must hit it
+        out_cached = [cached.generate(p, greedy).tokens for p in prompts]
+        assert cached.stats()["prefix_cache"]["entries"] >= 1
+        hits_before = cached.stats()["prefix_cache"]["hits"]
+        out_cached.append(cached.generate(prompts[1], greedy).tokens)
+        assert cached.stats()["prefix_cache"]["hits"] > hits_before
+
+        out_cold = [cold.generate(p, greedy).tokens for p in prompts]
+        out_cold.append(cold.generate(prompts[1], greedy).tokens)
+        assert out_cached == out_cold
+    finally:
+        cached.stop()
+        cold.stop()
+
+
+def test_growing_conversation_reuses_prefix():
+    """Multi-turn shape: each prompt extends the previous one (conversation
+    re-sent in full). Later turns must hit and stay correct."""
+    greedy = SamplingParams(temperature=0.0, max_tokens=8)
+    cached = _engine(prefix_entries=4)
+    cold = _engine(prefix_entries=0)
+    try:
+        convo = SYSTEM
+        for turn in range(3):
+            convo += f" user says thing {turn}. assistant replies."
+            a = cached.generate(convo, greedy).tokens
+            b = cold.generate(convo, greedy).tokens
+            assert a == b, f"turn {turn} diverged under prefix caching"
+        assert cached.stats()["prefix_cache"]["hits"] >= 1
+    finally:
+        cached.stop()
+        cold.stop()
+
+
+def test_forced_prefix_and_json_through_cache_hit():
+    """tool_choice forcing + grammar must survive the hit path (constraint
+    state is seeded past the forced prefix regardless of where the KV came
+    from)."""
+    import json
+
+    prefix = tuple(ByteTokenizer().encode('{"name": "t", "arguments": {'))
+    sp = SamplingParams(temperature=1.1, max_tokens=24, json_only=True, forced_prefix=prefix)
+    eng = _engine(prefix_entries=4)
+    try:
+        r1 = eng.generate(SYSTEM + "call it", sp)
+        r2 = eng.generate(SYSTEM + "call it", sp)  # hit
+        assert eng.stats()["prefix_cache"]["hits"] >= 1
+        for r in (r1, r2):
+            obj = json.loads(r.text)
+            assert obj["name"] == "t"
+    finally:
+        eng.stop()
+
+
+def test_concurrent_mixed_hits_and_misses():
+    greedy = SamplingParams(temperature=0.0, max_tokens=8)
+    eng = _engine(prefix_entries=4)
+    try:
+        eng.generate(SYSTEM + "seed", greedy)  # seeds the SYSTEM prefix
+        prompts = [SYSTEM + f"variant {i}" for i in range(3)] + ["totally different"]
+        solo = [eng.generate(p, greedy).tokens for p in prompts]
+        futs = [eng.submit(p, greedy) for p in prompts]
+        burst = [f.result(timeout=300).tokens for f in futs]
+        assert burst == solo
+    finally:
+        eng.stop()
