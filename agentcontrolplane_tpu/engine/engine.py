@@ -230,6 +230,11 @@ class Engine:
                 raise ValueError(
                     f"page_size {self.page_size} must divide max_ctx {self.max_ctx}"
                 )
+            if self.prefill_buckets[-1] < self.max_ctx:
+                raise ValueError(
+                    "paged layout requires prefill_buckets to reach max_ctx "
+                    "(chunked prefill is slot-layout only)"
+                )
             bad = [b for b in self.prefill_buckets if b % self.page_size]
             if bad:
                 raise ValueError(
@@ -617,21 +622,81 @@ class Engine:
             if not group:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
-            # split by prefix-cache outcome (hits run the suffix-only
-            # continuation program), then into power-of-two chunks so each
-            # batch size is a bounded jit cache entry
-            hits: list = []
-            misses: list = []
+            # per item: resolve the prefix-cache start, then spill any
+            # overlong remainder through intermediate continuation chunks
+            # (chunked prefill — prompts longer than the largest bucket run
+            # as several bounded dispatches, not one giant compile)
+            enriched: list[list] = []  # [item, start] (start mutated by spill)
             for item in group:
-                m = self._match_prefix(item[0]) if self._prefix_enabled else None
-                (hits if m else misses).append((item, m))
-            for chunk in _pow2_chunks(misses, self.prefill_batch_max):
+                req, slot, _pages = item
+                start = 0
+                if self._prefix_enabled:
+                    m = self._match_prefix(req)
+                    if m is not None:
+                        self._copy_prefix_into_slot(slot, m[1])
+                        start = m[1]["cut"]
+                        self._prefix_hits += 1
+                        REGISTRY.counter_add("acp_engine_prefix_cache_hit_requests", 1.0)
+                    else:
+                        self._prefix_misses += 1
+                        REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", 1.0)
+                enriched.append([item, start])
+            if self.kv_layout == "slot":
+                self._spill_long_chunks(enriched)
+            plain = [e for e in enriched if e[1] == 0]  # cheaper causal program
+            conts = [e for e in enriched if e[1] > 0]  # suffix continuation
+            for chunk in _pow2_chunks(plain, self.prefill_batch_max):
                 self._prefill_group([it for it, _ in chunk])
-            for chunk in _pow2_chunks(hits, self.prefill_batch_max):
+            for chunk in _pow2_chunks(conts, self.prefill_batch_max):
                 self._prefill_group(
-                    [it for it, _ in chunk], matches=[m for _, m in chunk]
+                    [it for it, _ in chunk],
+                    starts_np=np.asarray([s for _, s in chunk], dtype=np.int32),
                 )
         return admitted
+
+    def _spill_long_chunks(self, enriched: list[list]) -> None:
+        """Chunked prefill, batched across the admission group: round-robin
+        one largest-bucket chunk per long request per dispatch (KV writes
+        only; the sampled token is discarded) until every remainder fits one
+        bucket. Mutates each item's start offset in place."""
+        CH = self.prefill_buckets[-1]
+        while True:
+            need = [
+                e for e in enriched
+                if len(self._full_row(e[0][0])) - e[1] > CH
+            ]
+            if not need:
+                return
+            for batch in _pow2_chunks(need, self.prefill_batch_max):
+                B = len(batch)
+                toks = np.zeros((B, CH), dtype=np.int32)
+                starts = np.zeros(B, dtype=np.int32)
+                slots = np.zeros(B, dtype=np.int32)
+                for i, (item, start) in enumerate(batch):
+                    req, slot, _ = item
+                    toks[i] = self._full_row(req)[start : start + CH]
+                    starts[i] = start
+                    slots[i] = slot
+                self._rng, step_rng = jax.random.split(self._rng)
+                self.cache, _tok, _state = self._jit_prefill_continue(
+                    self.params,
+                    self.cache,
+                    jnp.asarray(toks),
+                    jnp.full(B, CH, dtype=np.int32),
+                    jnp.asarray(starts),
+                    jnp.asarray(slots),
+                    step_rng,
+                    jnp.zeros(B, dtype=np.float32),  # temps (unused sample)
+                    jnp.zeros(B, dtype=np.int32),
+                    jnp.ones(B, dtype=np.float32),
+                    self._dummy_table,
+                    jnp.zeros(B, dtype=np.int32),
+                    jnp.zeros(B, dtype=bool),  # unconstrained
+                    self._dummy_min_close,
+                    jnp.ones(B, dtype=np.int32),
+                )
+                for e in batch:
+                    e[1] += CH
 
     # -- prefix KV cache (slot layout) -----------------------------------
 
@@ -690,6 +755,11 @@ class Engine:
         for b in self.prefill_buckets:
             if b <= cap:
                 cut = b
+        # chunked-prefill configs (largest bucket << max_ctx): snapshot at
+        # the largest chunk-multiple instead, or long conversations would be
+        # reusable only up to one bucket and re-spill almost everything
+        CH = self.prefill_buckets[-1]
+        cut = max(cut, (cap // CH) * CH)
         if cut < self.prefill_buckets[0]:
             return  # too short to be worth caching
         key = tuple(full[:cut])
@@ -799,25 +869,17 @@ class Engine:
     def _prefill_group(
         self,
         chunk: list[tuple[_Request, int, Optional[list[int]]]],
-        matches: Optional[list[tuple]] = None,
+        starts_np: Optional[np.ndarray] = None,
     ) -> None:
         """One batched prefill dispatch for B already-reserved requests
         (B = power of two <= prefill_batch_max). Burst admissions no longer
         serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
-        batch-1 prefills. With ``matches`` (prefix-cache hits), each slot
-        first receives its cached prefix KV and only the SUFFIX runs through
-        the model (prefill_continue)."""
+        batch-1 prefills. With ``starts_np`` (prefix-cache hits and/or
+        chunked-prefill remainders; slot KV below each start is already
+        populated), only the SUFFIX runs through the model
+        (prefill_continue)."""
         B = len(chunk)
-        starts = np.zeros(B, dtype=np.int32)
-        if matches is not None:
-            for i, ((req, slot, _), (_key, entry)) in enumerate(zip(chunk, matches)):
-                self._copy_prefix_into_slot(slot, entry)
-                starts[i] = entry["cut"]
-            self._prefix_hits += B
-            REGISTRY.counter_add("acp_engine_prefix_cache_hit_requests", float(B))
-        elif self._prefix_enabled:
-            self._prefix_misses += B
-            REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", float(B))
+        starts = starts_np if starts_np is not None else np.zeros(B, dtype=np.int32)
         # bucket over what actually runs through the model (full row on a
         # miss; suffix on a hit)
         bucket = max(
@@ -892,7 +954,7 @@ class Engine:
             cache, firsts, con_states = self._jit_prefill_paged(
                 self.params, self.cache, *common, jnp.asarray(page_ids), *tail
             )
-        elif matches is not None:
+        elif starts_np is not None:
             cache, firsts, con_states = self._jit_prefill_continue(
                 self.params, self.cache, *common,
                 jnp.asarray(starts), jnp.asarray(slots), *tail,

@@ -112,3 +112,48 @@ def test_concurrent_mixed_hits_and_misses():
         assert burst == solo
     finally:
         eng.stop()
+
+
+def test_chunked_prefill_long_prompt():
+    """Prompts longer than the largest prefill bucket run as several
+    bounded continuation dispatches; greedy results must equal an engine
+    whose buckets cover the prompt in one shot."""
+    greedy = SamplingParams(temperature=0.0, max_tokens=8)
+    small_buckets = Engine(
+        config=CFG, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=512, prefill_buckets=(64,),  # force chunking
+        decode_block_size=4, prefix_cache_entries=0, seed=0,
+    )
+    big_buckets = Engine(
+        config=CFG, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=512, prefill_buckets=(64, 256),
+        decode_block_size=4, prefix_cache_entries=0, seed=0,
+    )
+    small_buckets.start()
+    big_buckets.start()
+    try:
+        prompt = "a long conversation transcript. " * 7  # ~220 tokens
+        a = small_buckets.generate(prompt, greedy).tokens
+        b = big_buckets.generate(prompt, greedy).tokens
+        assert a == b
+        # and chunking composes with the prefix cache
+        cached = Engine(
+            config=CFG, tokenizer=ByteTokenizer(),
+            mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+            max_slots=2, max_ctx=512, prefill_buckets=(64,),
+            decode_block_size=4, prefix_cache_entries=4, seed=0,
+        )
+        cached.start()
+        try:
+            c1 = cached.generate(prompt, greedy).tokens
+            c2 = cached.generate(prompt + " more", greedy).tokens
+            assert c1 == a
+            assert cached.stats()["prefix_cache"]["hits"] >= 1
+            assert c2 == big_buckets.generate(prompt + " more", greedy).tokens
+        finally:
+            cached.stop()
+    finally:
+        small_buckets.stop()
+        big_buckets.stop()
