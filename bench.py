@@ -14,8 +14,12 @@ vs_baseline is against BASELINE.md's >1,000 tok/s/chip north-star target.
 Knobs (env): ACP_BENCH_PRESET, ACP_BENCH_REQUESTS, ACP_BENCH_MAX_TOKENS,
 ACP_BENCH_PROMPT_LEN, ACP_BENCH_MAX_CTX, ACP_BENCH_BLOCK,
 ACP_BENCH_KV_LAYOUT (slot|paged), ACP_BENCH_QUANTIZE (int8),
-ACP_BENCH_DEADLINE_S (wall-clock cap; partial results are reported
-honestly), ACP_BENCH_DEVICE_TIMEOUT_S (device-probe watchdog).
+ACP_BENCH_DEADLINE_S (per-burst wall-clock cap; partial results are
+reported honestly), ACP_BENCH_DEVICE_TIMEOUT_S (device-probe watchdog),
+ACP_BENCH_PROBE_WINDOW_S (tunnel retry window),
+ACP_BENCH_TTFT=0 / ACP_BENCH_TTFT_TASKS / ACP_BENCH_TTFT_DEADLINE_S
+(first-ToolCall latency phase), ACP_BENCH_AB=0 / ACP_BENCH_AB_BUDGET_S
+(slot-vs-paged A/B leg).
 
 If the accelerator cannot be reached within the watchdog window (e.g. a
 wedged tunnel), prints value 0.0 with the failure on stderr rather than
@@ -129,6 +133,7 @@ def main() -> None:
         _emit(0.0, f"FAILED: accelerator probe ok but jax.devices() hung within {probe_timeout:.0f}s")
         return
     n_chips = len(devices)
+    bench_t0 = time.monotonic()
 
     from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
     from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
@@ -140,51 +145,64 @@ def main() -> None:
     config = PRESETS[preset]
     if config.max_seq_len < max_ctx:  # small presets (tiny) honor the knob
         config = dataclasses.replace(config, max_seq_len=max_ctx)
-    engine = Engine(
-        config=config,
-        tokenizer=ByteTokenizer(),
-        mesh=serving_mesh(),
-        max_slots=n_requests,
-        max_ctx=max_ctx,
-        prefill_buckets=(prompt_len, max_ctx),
-        decode_block_size=block,
-        kv_layout=kv_layout,
-        quantize=quantize,
-        seed=0,
-    )
-    engine.start()
+    def build_engine(layout: str):
+        eng = Engine(
+            config=config,
+            tokenizer=ByteTokenizer(),
+            mesh=serving_mesh(),
+            max_slots=n_requests,
+            max_ctx=max_ctx,
+            prefill_buckets=(prompt_len, max_ctx),
+            decode_block_size=block,
+            kv_layout=layout,
+            quantize=quantize,
+            seed=0,
+        )
+        eng.start()
+        return eng
+
     prompt = [1 + (i % 250) for i in range(prompt_len - 1)]
     sampling = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=max_tokens)
 
-    # warmup at measurement shape: a full-width burst of short generations
-    # compiles every jit entry the measured run will hit (batched prefill
-    # chunks, the max-width decode block, and the narrow widths the tail
-    # decays through) — so the measured window is compile-free
-    warm = [
-        engine.submit(list(prompt), SamplingParams(temperature=0.0, max_tokens=block + 1))
-        for _ in range(n_requests)
-    ]
-    for f in warm:
-        f.result(timeout=600)
+    def measure(eng, deadline_s: float = deadline_s) -> tuple[float, int, float, int]:
+        """Warmup (compiles every jit entry the burst hits: batched prefill
+        chunks, max-width decode, the narrow decay widths) then the measured
+        full-width burst. Returns (tok/s/chip, tokens, elapsed, done)."""
+        warm = [
+            eng.submit(list(prompt), SamplingParams(temperature=0.0, max_tokens=block + 1))
+            for _ in range(n_requests)
+        ]
+        for f in warm:
+            f.result(timeout=600)
+        t0 = time.monotonic()
+        toks0 = eng.tokens_generated
+        futures = [eng.submit(list(prompt), sampling) for _ in range(n_requests)]
+        deadline = t0 + deadline_s
+        done = 0
+        for f in futures:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            try:
+                f.result(timeout=remaining)
+                done += 1
+            except Exception:
+                break
+        elapsed = time.monotonic() - t0
+        total = eng.tokens_generated - toks0
+        # drain leftovers so the next phase measures an idle engine
+        for f in futures:
+            eng.cancel(f)
+        drain_deadline = time.monotonic() + 120
+        while time.monotonic() < drain_deadline:
+            s = eng.stats()
+            if s["active_slots"] == 0 and s["waiting"] == 0:
+                break
+            time.sleep(0.2)
+        return (total / elapsed) / max(n_chips, 1), total, elapsed, done
 
-    t0 = time.monotonic()
-    toks0 = engine.tokens_generated
-    futures = [engine.submit(list(prompt), sampling) for _ in range(n_requests)]
-    deadline = t0 + deadline_s
-    done = 0
-    for f in futures:
-        remaining = deadline - time.monotonic()
-        if remaining <= 0:
-            break
-        try:
-            f.result(timeout=remaining)
-            done += 1
-        except Exception:
-            break
-    elapsed = time.monotonic() - t0
-    total_tokens = engine.tokens_generated - toks0
-
-    tok_s_chip = (total_tokens / elapsed) / max(n_chips, 1)
+    engine = build_engine(kv_layout)
+    tok_s_chip, total_tokens, elapsed, done = measure(engine)
     note = (
         f"{total_tokens} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
         f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "
@@ -192,25 +210,43 @@ def main() -> None:
         + ("" if done == n_requests else " (deadline hit; partial but honest)")
     )
 
-    # drain leftovers (deadline-hit partial runs) so the TTFT phase measures
-    # an idle engine, not contention from abandoned generations
-    for f in futures:
-        engine.cancel(f)
-    drain_deadline = time.monotonic() + 120
-    while time.monotonic() < drain_deadline:
-        s = engine.stats()
-        if s["active_slots"] == 0 and s["waiting"] == 0:
-            break
-        time.sleep(0.2)
-
-    extra = None
+    extra: dict = {}
     if os.environ.get("ACP_BENCH_TTFT", "1") != "0":
         try:
-            extra = {"ttft_first_toolcall_ms": _bench_ttft(engine)}
+            extra["ttft_first_toolcall_ms"] = _bench_ttft(engine)
         except Exception as e:  # TTFT failure must not lose the headline number
-            extra = {"ttft_error": str(e)}
+            extra["ttft_error"] = str(e)
     engine.stop()
-    _emit(tok_s_chip, note, extra)
+    del engine  # free weights+KV HBM before building the A/B engine
+
+    # slot-vs-paged A/B: re-run the same burst against the other KV layout
+    # and record which wins (VERDICT r1 #2). Budgeted: never runs past
+    # ACP_BENCH_AB_BUDGET_S of total bench wall time, so a slow first phase
+    # can't push the headline emit past the driver's patience.
+    ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "900"))
+    spent = time.monotonic() - bench_t0
+    if os.environ.get("ACP_BENCH_AB", "1") != "0" and spent < ab_budget:
+        other = "paged" if kv_layout == "slot" else "slot"
+        try:
+            eng2 = build_engine(other)
+            ab_tok_s, ab_total, ab_elapsed, ab_done = measure(
+                eng2, deadline_s=min(deadline_s, ab_budget - spent)
+            )
+            eng2.stop()
+            extra[f"{other}_tok_s_per_chip"] = round(ab_tok_s, 1)
+            extra["kv_layout_winner"] = (
+                kv_layout if tok_s_chip >= ab_tok_s else other
+            )
+            print(
+                f"# A/B {other}: {ab_total} tokens in {ab_elapsed:.2f}s "
+                f"({ab_done}/{n_requests} done)",
+                file=sys.stderr, flush=True,
+            )
+        except Exception as e:
+            extra["ab_error"] = str(e)
+    elif spent >= ab_budget:
+        extra["ab_skipped"] = f"over ACP_BENCH_AB_BUDGET_S after {spent:.0f}s"
+    _emit(tok_s_chip, note, extra or None)
 
 
 def _bench_ttft(engine) -> dict:
