@@ -63,6 +63,10 @@ class TPUEngineClient(LLMClient):
 
     async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
         prompt = render_prompt(messages, tools)
+        # crash recovery: a dead engine loop (exception, not user stop) is
+        # rebuilt and restarted; the reconciler's requeue retries land here.
+        # Off the event loop: the KV rebuild jit-compiles and allocates HBM
+        await asyncio.to_thread(self.engine.ensure_running)
         forced = self._forced_call(tools)
         # "required" with several tools can't force ONE envelope; it still
         # demands a tool call, so fall back to grammar-constrained JSON

@@ -214,18 +214,7 @@ class Engine:
                     layers[key] = jax.jit(_q)(layers[key])
         self.quantize = quantize
         self.params = params
-        if self.kv_layout == "slot":
-            cache_shardings = kv_cache_shardings(self.mesh)
-            self.cache = jax.jit(
-                lambda: init_kv_cache(config, max_slots, self.max_ctx),
-                out_shardings=cache_shardings,
-            )()
-        else:
-            from jax.sharding import NamedSharding, PartitionSpec as P
-
-            from ..models.llama import init_paged_cache
-            from ..ops.paged import PageAllocator
-
+        if self.kv_layout == "paged":
             if self.max_ctx % self.page_size:
                 raise ValueError(
                     f"page_size {self.page_size} must divide max_ctx {self.max_ctx}"
@@ -242,19 +231,8 @@ class Engine:
                 )
             self.max_pages_per_seq = self.max_ctx // self.page_size
             self.num_pages = kv_pages or (max_slots * self.max_pages_per_seq + 1)
-            page_shardings = {
-                "k": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
-                "v": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
-            }
-            self.cache = jax.jit(
-                lambda: init_paged_cache(config, self.num_pages, self.page_size),
-                out_shardings=page_shardings,
-            )()
-            self._allocator = PageAllocator(self.num_pages)
-            self._slot_pages: dict[int, list[int]] = {}
-            self._block_tables = np.full(
-                (max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
-            )
+        self._init_kv_state()
+        if self.kv_layout == "paged":
             # Compiled pallas path on real TPU (tp>1 goes through the
             # shard_map wrapper over head-sharded pages — GSPMD treats
             # pallas_call as opaque); CPU uses the exact XLA reference
@@ -313,6 +291,8 @@ class Engine:
         self._budgets = np.zeros(max_slots, dtype=np.int32)
         self._thread: Optional[threading.Thread] = None
         self._stopping = False
+        self._crashed = False
+        self._restart_lock = threading.Lock()
         # rids whose callers abandoned the request (client timeout/disconnect);
         # slots are released at the next engine-loop iteration so orphaned
         # generations don't pin capacity to max_tokens
@@ -436,6 +416,35 @@ class Engine:
 
     # -- public API ------------------------------------------------------
 
+    def _init_kv_state(self) -> None:
+        """(Re)build the device KV cache and host allocator state — shared
+        by __init__ and crash recovery (ensure_running) so the restart path
+        can never diverge from fresh construction."""
+        if self.kv_layout == "slot":
+            self.cache = jax.jit(
+                lambda: init_kv_cache(self.config, self.max_slots, self.max_ctx),
+                out_shardings=kv_cache_shardings(self.mesh),
+            )()
+        else:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            from ..models.llama import init_paged_cache
+            from ..ops.paged import PageAllocator
+
+            page_shardings = {
+                "k": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
+                "v": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
+            }
+            self.cache = jax.jit(
+                lambda: init_paged_cache(self.config, self.num_pages, self.page_size),
+                out_shardings=page_shardings,
+            )()
+            self._allocator = PageAllocator(self.num_pages)
+            self._slot_pages: dict[int, list[int]] = {}
+            self._block_tables = np.full(
+                (self.max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
+            )
+
     def start(self) -> None:
         if self._thread is not None:
             return
@@ -444,12 +453,51 @@ class Engine:
         self._thread.start()
 
     def stop(self) -> None:
-        if self._thread is None:
-            return
-        self._stopping = True
-        self._queue.put(None)
-        self._thread.join(timeout=30)
-        self._thread = None
+        # the restart lock serializes against an in-flight crash recovery;
+        # clearing _crashed makes a deliberate stop final (no resurrection
+        # by a late ensure_running)
+        with self._restart_lock:
+            self._crashed = False
+            if self._thread is None:
+                return
+            self._stopping = True
+            self._queue.put(None)
+            self._thread.join(timeout=30)
+            self._thread = None
+
+    def ensure_running(self) -> bool:
+        """Crash recovery (the phase-machine-and-requeue posture of the
+        control plane, applied to the data plane): if the engine loop died
+        on an exception — NOT a user stop() — rebuild the device-side
+        serving state (KV cache, page tables, slot bookkeeping; params are
+        untouched) and restart the loop. Callers' failed requests were
+        already resolved with errors; the control plane's 5s requeue then
+        retries them against the recovered engine. Returns True when the
+        engine is running."""
+        with self._restart_lock:
+            if self._thread is not None and self._thread.is_alive():
+                return True
+            if not self._crashed:
+                return False  # deliberately stopped; stay stopped
+            log.warning("engine crashed; rebuilding serving state and restarting")
+            self._init_kv_state()
+            self._slots = {}
+            self._free = list(range(self.max_slots))
+            self._waiting.clear()
+            self._cancelled.clear()
+            self._seq_lens[:] = 0
+            self._last_tokens[:] = 0
+            self._con_states[:] = 0
+            self._constrained[:] = False
+            self._budgets[:] = 0
+            with self._prefix_lock:
+                self._prefix_cache.clear()  # entries reference the old arrays only; safe either way
+            self._crashed = False
+            self._stopping = False
+            self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
+            self._thread.start()
+            REGISTRY.counter_add("acp_engine_restarts_total", 1.0)
+            return True
 
     def submit(
         self,
@@ -560,6 +608,8 @@ class Engine:
             log.exception("engine loop crashed")
             self._slots.clear()
             self._stopping = True
+            self._crashed = True  # restartable (see ensure_running)
+            REGISTRY.counter_add("acp_engine_crashes_total", 1.0)
             for fut in list(self._outstanding):
                 if not fut.done():
                     fut.set_exception(RuntimeError(f"engine crashed: {e}"))

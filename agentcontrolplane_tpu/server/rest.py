@@ -674,6 +674,9 @@ class RestServer:
         except Exception as e:
             return _json_error(400, f"invalid request: {e}")
 
+        # crash recovery before admission; off the event loop (KV rebuild
+        # jit-compiles and allocates HBM)
+        await asyncio.to_thread(engine.ensure_running)
         if stream:
             return await self._stream_chat(request, engine, prompt, sampling, tools, body)
 

@@ -156,3 +156,81 @@ async def test_restart_at_every_phase_resumes(tmp_path, kill_after_phase):
         assert task.status.output == "recovered"
     finally:
         await op2.stop()
+
+
+async def test_engine_crash_mid_task_recovers():
+    """Data-plane failure recovery through the full stack: the engine loop
+    dies mid-generation; the in-flight Task's LLM call fails (5xx-style,
+    phase kept), the reconciler requeues, the client-side ensure_running
+    rebuilds the engine, and the Task still reaches FinalAnswer."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.api import ObjectMeta
+    from agentcontrolplane_tpu.api.resources import (
+        LLM, BaseConfig, LLMSpec, TPUProviderConfig,
+    )
+    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    from ..fixtures import make_agent, make_task, setup_with_status
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=eng,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.05
+    store = op.store
+    setup_with_status(
+        store,
+        LLM(
+            metadata=ObjectMeta(name="tpu-llm"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="tiny", max_tokens=8, temperature=0.0),
+                tpu=TPUProviderConfig(preset="tiny"),
+            ),
+        ),
+        lambda o: (
+            setattr(o.status, "ready", True),
+            setattr(o.status, "status", "Ready"),
+        ),
+    )
+    make_agent(store, llm="tpu-llm", system="answer")
+
+    # poison the decode program: the FIRST decode dispatch crashes the loop
+    real = eng._jit_decode
+
+    def boom(*a, **k):
+        eng._jit_decode = real  # heal so the restarted engine works
+        raise RuntimeError("injected decode fault")
+
+    eng._jit_decode = boom
+    make_task(store, name="crashy", user_message="hello there")
+    await op.start()
+    try:
+        t = await wait_for(
+            store, "Task", "crashy", "default",
+            lambda t: t.status.phase == "FinalAnswer", timeout=120,
+        )
+        assert t.status.phase == "FinalAnswer"
+        assert [m.role for m in t.status.context_window] == ["system", "user", "assistant"]
+        # the crash actually happened (the poisoned program executed and
+        # healed itself) and the task still completed
+        assert eng._jit_decode is real
+    finally:
+        await op.stop()
+        eng.stop()

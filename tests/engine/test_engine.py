@@ -123,3 +123,51 @@ def test_cancel_frees_slot_and_waiting_request(engine):
     r = engine.generate("after", SamplingParams(temperature=0.0, max_tokens=4))
     assert len(r.tokens) >= 1
     assert engine.stats()["active_slots"] == 0
+
+
+def test_engine_crash_recovery():
+    """Failure recovery for the data plane: a crashed engine loop is
+    rebuilt (fresh KV/slot state, params kept) by ensure_running(); in-flight
+    requests fail fast with errors, later requests succeed — mirroring the
+    control plane's error-then-requeue posture."""
+    import dataclasses as _dc
+
+    cfg = _dc.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=TOK,
+        mesh=jax.sharding.Mesh(jax.devices()[:2], ("tp",)),
+        max_slots=2, max_ctx=128, prefill_buckets=(64, 128),
+    )
+    eng.start()
+    try:
+        before = eng.generate("hello", SamplingParams(temperature=0.0, max_tokens=6))
+
+        # inject a crash: poison the decode program for one dispatch
+        real = eng._jit_decode
+
+        def boom(*a, **k):
+            eng._jit_decode = real  # heal after the first failure
+            raise RuntimeError("injected decode fault")
+
+        eng._jit_decode = boom
+        fut = eng.submit("crash me", SamplingParams(temperature=0.0, max_tokens=6))
+        try:
+            fut.result(timeout=60)
+            raise AssertionError("expected the in-flight request to fail")
+        except RuntimeError as e:
+            assert "engine crashed" in str(e)
+        # the future resolves before the crashed thread finishes its drain;
+        # join it before asserting deadness
+        if eng._thread is not None:
+            eng._thread.join(timeout=30)
+        assert eng._crashed and not (eng._thread and eng._thread.is_alive())
+
+        # a deliberately stopped engine must NOT restart...
+        # (covered implicitly: ensure_running returns False only via _crashed)
+        assert eng.ensure_running() is True
+        after = eng.generate("hello", SamplingParams(temperature=0.0, max_tokens=6))
+        assert after.tokens == before.tokens  # params survived; results identical
+    finally:
+        eng.stop()
+    # ...and once stopped on purpose, ensure_running stays down
+    assert eng.ensure_running() is False
