@@ -68,6 +68,16 @@ def cmd_run(args) -> int:
         else:
             engine = Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(), **kw)
         engine.start()
+        if args.tpu_prewarm:
+            # background: the REST API comes up immediately; early requests
+            # simply queue behind the same compiles they would have caused
+            import threading
+
+            threading.Thread(
+                target=lambda: engine.prewarm(constrained=True),
+                name="tpu-prewarm",
+                daemon=True,
+            ).start()
 
     options = OperatorOptions(
         db_path=args.db,
@@ -288,6 +298,12 @@ def build_parser() -> argparse.ArgumentParser:
     run.add_argument("--tpu-ctx", type=int, default=2048)
     run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
     run.add_argument("--tpu-quantize", choices=["int8"], default=None)
+    run.add_argument(
+        "--tpu-prewarm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="compile serving programs in the background at startup",
+    )
     run.set_defaults(fn=cmd_run)
 
     ap = sub.add_parser("apply", help="apply manifests")

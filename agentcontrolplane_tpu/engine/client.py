@@ -65,8 +65,9 @@ class TPUEngineClient(LLMClient):
         prompt = render_prompt(messages, tools)
         # crash recovery: a dead engine loop (exception, not user stop) is
         # rebuilt and restarted; the reconciler's requeue retries land here.
-        # Off the event loop: the KV rebuild jit-compiles and allocates HBM
-        await asyncio.to_thread(self.engine.ensure_running)
+        # Off the event loop: the KV rebuild jit-compiles and allocates HBM.
+        if not await asyncio.to_thread(self.engine.ensure_running):
+            raise LLMRequestError(503, "TPU engine is stopped")
         forced = self._forced_call(tools)
         # "required" with several tools can't force ONE envelope; it still
         # demands a tool call, so fall back to grammar-constrained JSON

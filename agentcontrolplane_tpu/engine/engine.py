@@ -475,9 +475,14 @@ class Engine:
         retries them against the recovered engine. Returns True when the
         engine is running."""
         with self._restart_lock:
-            if self._thread is not None and self._thread.is_alive():
+            if self._crashed:
+                # the crashed thread may still be draining; join it so the
+                # restart below owns the loop exclusively
+                if self._thread is not None:
+                    self._thread.join(timeout=30)
+            elif self._thread is not None and self._thread.is_alive():
                 return True
-            if not self._crashed:
+            else:
                 return False  # deliberately stopped; stay stopped
             log.warning("engine crashed; rebuilding serving state and restarting")
             self._init_kv_state()
@@ -535,6 +540,45 @@ class Engine:
         req.future.rid = req.rid  # type: ignore[attr-defined]  # cancel() handle
         self._queue.put(req)
         return req.future
+
+    def prewarm(self, constrained: bool = False) -> None:
+        """Compile the jit entries real traffic will hit — a full-width
+        burst of short generations with largest-bucket prompts covers the
+        batched-prefill chunk sizes, the max-width decode block, and the
+        narrow widths the tail decays through. With ``constrained``, a
+        second burst compiles the grammar-masked variants (and builds the
+        token table). Without this, the FIRST Task after startup pays
+        20-40s of TPU compiles — fatal to the 500ms time-to-first-ToolCall
+        target. Blocking; run from a background thread if startup latency
+        matters more than first-request latency."""
+        with self._prefix_lock:
+            hits0, misses0 = self._prefix_hits, self._prefix_misses
+        # two prompt shapes per mode: the largest bucket (prefill compiles;
+        # when buckets[-1] == max_ctx these finish at 1 token with no decode
+        # room) and a short prompt that actually decodes K+ tokens (decode
+        # block at full width + the decay widths)
+        long_prompt = [1] * max(8, self.prefill_buckets[-1] - 1)
+        short_prompt = [1] * 8
+        shapes = [
+            (long_prompt, 1),
+            (short_prompt, self.decode_block_size + 1),
+        ]
+        modes = [False, True] if constrained else [False]
+        for json_only in modes:
+            for prompt, mt in shapes:
+                sp = SamplingParams(temperature=0.0, max_tokens=mt, json_only=json_only)
+                futs = [self.submit(list(prompt), sp) for _ in range(self.max_slots)]
+                for f in futs:
+                    f.result(timeout=1800)
+        # dummy prompts must not occupy the prefix cache or skew its stats;
+        # evict ONLY the all-dummy keys so real traffic served during a
+        # background prewarm keeps its entries
+        with self._prefix_lock:
+            for key in [k for k in self._prefix_cache if set(k) == {1}]:
+                del self._prefix_cache[key]
+            self._prefix_hits = hits0
+            self._prefix_misses = misses0
+        log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:
         """Abort the request behind a Future returned by :meth:`submit`.

@@ -675,8 +675,9 @@ class RestServer:
             return _json_error(400, f"invalid request: {e}")
 
         # crash recovery before admission; off the event loop (KV rebuild
-        # jit-compiles and allocates HBM)
-        await asyncio.to_thread(engine.ensure_running)
+        # jit-compiles and allocates HBM). False = deliberately stopped.
+        if not await asyncio.to_thread(engine.ensure_running):
+            return _json_error(503, "TPU engine is stopped")
         if stream:
             return await self._stream_chat(request, engine, prompt, sampling, tools, body)
 

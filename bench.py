@@ -229,17 +229,17 @@ def main() -> None:
     ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "900"))
     spent = time.monotonic() - bench_t0
     remaining = ab_budget - spent
-    # the leg needs real room: engine build + warmup compiles + burst +
-    # <=120s drain are all bounded by `remaining` below (warmup result
-    # timeouts included), so the budget is honest, not advisory
+    # approximately bounded: warmup and the measured burst each get a
+    # quarter of the remaining budget, the drain adds <=120s; engine-build
+    # compile time is the one unbounded piece (first build of this layout)
     if os.environ.get("ACP_BENCH_AB", "1") != "0" and remaining > 240:
         other = "paged" if kv_layout == "slot" else "slot"
         try:
             eng2 = build_engine(other)
             ab_tok_s, ab_total, ab_elapsed, ab_done = measure(
                 eng2,
-                deadline_s=min(deadline_s, remaining / 3),
-                warm_timeout=max(60.0, remaining / 2),
+                deadline_s=min(deadline_s, remaining / 4),
+                warm_timeout=max(60.0, remaining / 4),
             )
             eng2.stop()
             extra[f"{other}_tok_s_per_chip"] = round(ab_tok_s, 1)

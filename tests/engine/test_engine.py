@@ -171,3 +171,14 @@ def test_engine_crash_recovery():
         eng.stop()
     # ...and once stopped on purpose, ensure_running stays down
     assert eng.ensure_running() is False
+
+
+def test_prewarm_compiles_and_leaves_clean_state(engine):
+    engine.prewarm(constrained=True)
+    st = engine.stats()
+    assert st["active_slots"] == 0 and st["waiting"] == 0
+    pc = st.get("prefix_cache")
+    if pc is not None:
+        assert pc["entries"] == 0 and pc["hits"] == 0  # dummies left no trace
+    r = engine.generate("after prewarm", SamplingParams(temperature=0.0, max_tokens=4))
+    assert len(r.tokens) >= 1

@@ -5,6 +5,7 @@ discovery, tool invocation with text flattening, Secret-resolved env vars
 (envvar_test.go equivalent), error propagation, reconnect after death.
 """
 
+import json
 import os
 import sys
 
@@ -175,3 +176,78 @@ async def test_stdio_memory_limit_applied(store):
         assert "hi" in out
     finally:
         await mgr.close()
+
+
+async def test_http_transport_against_live_server(store):
+    """Streamable-HTTP MCP transport (mcpmanager.go:148 parity) against a
+    live aiohttp server: JSON responses, SSE responses, session ids,
+    JSON-RPC errors."""
+    from aiohttp import web
+
+    calls: list[dict] = []
+
+    async def mcp(request: web.Request) -> web.Response:
+        msg = json.loads(await request.read())
+        calls.append(msg)
+        method = msg.get("method")
+        rid = msg.get("id")
+        if method == "initialize":
+            result = {
+                "protocolVersion": "2024-11-05",
+                "capabilities": {"tools": {}},
+                "serverInfo": {"name": "http-test-server", "version": "1.0"},
+            }
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": rid, "result": result},
+                headers={"Mcp-Session-Id": "sess-42"},
+            )
+        if rid is None:  # notification
+            return web.Response(status=202)
+        assert request.headers.get("Mcp-Session-Id") == "sess-42"
+        if method == "tools/list":
+            # SSE-framed response exercises the event-stream parse path
+            result = {"tools": [{"name": "greet", "description": "", "inputSchema": {}}]}
+            body = f'data: {json.dumps({"jsonrpc": "2.0", "id": rid, "result": result})}\n\n'
+            return web.Response(text=body, content_type="text/event-stream")
+        if method == "tools/call":
+            name = msg["params"]["name"]
+            if name == "boom":
+                return web.json_response(
+                    {"jsonrpc": "2.0", "id": rid,
+                     "error": {"code": -32000, "message": "scripted"}}
+                )
+            text = f"hello {msg['params'].get('arguments', {}).get('who', '')}"
+            return web.json_response(
+                {"jsonrpc": "2.0", "id": rid,
+                 "result": {"content": [{"type": "text", "text": text}]}}
+            )
+        return web.json_response({"jsonrpc": "2.0", "id": rid, "result": {}})
+
+    app = web.Application()
+    app.router.add_post("/mcp", mcp)
+    runner = web.AppRunner(app)
+    await runner.setup()
+    site = web.TCPSite(runner, "127.0.0.1", 0)
+    await site.start()
+    port = site._server.sockets[0].getsockname()[1]
+
+    mgr = MCPManager(store)
+    try:
+        server = MCPServer(
+            metadata=ObjectMeta(name="httpd"),
+            spec=MCPServerSpec(transport="http", url=f"http://127.0.0.1:{port}/mcp"),
+        )
+        conn = await mgr.connect_server(server)
+        assert conn.client.server_info["name"] == "http-test-server"
+        assert [t.name for t in conn.tools] == ["greet"]
+        out = await mgr.call_tool("httpd", "greet", {"who": "world"})
+        assert out == "hello world"
+        try:
+            await mgr.call_tool("httpd", "boom", {})
+            raise AssertionError("expected MCPError")
+        except MCPError as e:
+            assert "scripted" in str(e)
+        assert any(c.get("method") == "notifications/initialized" for c in calls)
+    finally:
+        await mgr.close()
+        await runner.cleanup()
