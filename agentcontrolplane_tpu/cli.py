@@ -27,14 +27,14 @@ import time
 DEFAULT_SERVER = os.environ.get("ACP_TPU_SERVER", "http://127.0.0.1:8082")
 
 
-def _client(args):
+def _client(args, timeout: float | None = 30.0):
     import httpx
 
     headers = {}
     token = getattr(args, "token", None) or os.environ.get("ACP_API_TOKEN")
     if token:
         headers["Authorization"] = f"Bearer {token}"
-    return httpx.Client(base_url=args.server, timeout=30.0, headers=headers)
+    return httpx.Client(base_url=args.server, timeout=timeout, headers=headers)
 
 
 def cmd_run(args) -> int:
@@ -262,6 +262,71 @@ def cmd_task_show(args) -> int:
     return 0
 
 
+def cmd_chat(args) -> int:
+    """Interactive REPL against the OpenAI-compatible front door (SSE
+    streaming) — the quickest way to poke the TPU engine by hand."""
+    import httpx
+
+    messages: list[dict] = []
+    if args.system:
+        messages.append({"role": "system", "content": args.system})
+    print("chatting with the engine; empty line or Ctrl-D to exit", flush=True)
+    with _client(args, timeout=None) as http:
+        while True:
+            try:
+                line = input("> ").strip()
+            except (EOFError, KeyboardInterrupt):
+                print(flush=True)
+                return 0
+            if not line:
+                return 0
+            messages.append({"role": "user", "content": line})
+            payload = {
+                "messages": messages,
+                "max_tokens": args.max_tokens,
+                "temperature": args.temperature,
+                "stream": True,
+            }
+            reply = []
+            errored = False
+            try:
+                with http.stream("POST", "/v1/chat/completions", json=payload) as resp:
+                    if resp.status_code != 200:
+                        resp.read()
+                        print(f"error: {resp.text}", file=sys.stderr)
+                        messages.pop()
+                        continue
+                    for raw in resp.iter_lines():
+                        if not raw.startswith("data: ") or raw == "data: [DONE]":
+                            continue
+                        event = json.loads(raw[len("data: "):])
+                        if "error" in event:
+                            print(f"\nerror: {event['error']['message']}", file=sys.stderr)
+                            errored = True
+                            break
+                        delta = event["choices"][0]["delta"]
+                        chunk = delta.get("content") or ""
+                        if chunk:
+                            reply.append(chunk)
+                            print(chunk, end="", flush=True)
+                        for tc in delta.get("tool_calls") or []:
+                            print(
+                                f"\n[tool call] {tc['function']['name']}"
+                                f"({tc['function']['arguments']})",
+                                flush=True,
+                            )
+            except (httpx.HTTPError, KeyboardInterrupt) as e:
+                print(f"\nerror: {e}", file=sys.stderr)
+                errored = True
+            if errored:
+                # drop the failed exchange entirely so the next turn's
+                # conversation isn't corrupted by a partial assistant turn
+                messages.pop()
+                continue
+            print(flush=True)
+            messages.append({"role": "assistant", "content": "".join(reply)})
+
+
 def cmd_engine(args) -> int:
     with _client(args) as http:
         resp = http.get("/v1/engine")
@@ -349,6 +414,12 @@ def build_parser() -> argparse.ArgumentParser:
 
     eng = sub.add_parser("engine", help="TPU engine status")
     eng.set_defaults(fn=cmd_engine)
+
+    chat = sub.add_parser("chat", help="interactive chat with the TPU engine (SSE)")
+    chat.add_argument("--system", default="")
+    chat.add_argument("--max-tokens", type=int, default=256)
+    chat.add_argument("--temperature", type=float, default=0.7)
+    chat.set_defaults(fn=cmd_chat)
 
     return p
 

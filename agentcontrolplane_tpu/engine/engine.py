@@ -476,10 +476,14 @@ class Engine:
         engine is running."""
         with self._restart_lock:
             if self._crashed:
-                # the crashed thread may still be draining; join it so the
-                # restart below owns the loop exclusively
+                # the crashed thread may still be draining; the restart must
+                # own the loop exclusively, so a wedged drain defers recovery
+                # to the caller's next retry rather than racing it
                 if self._thread is not None:
                     self._thread.join(timeout=30)
+                    if self._thread.is_alive():
+                        log.error("crashed engine thread still draining; deferring restart")
+                        return False
             elif self._thread is not None and self._thread.is_alive():
                 return True
             else:
@@ -509,10 +513,12 @@ class Engine:
         prompt: str | list[int],
         sampling: Optional[SamplingParams] = None,
         on_tokens=None,
+        _prewarm: bool = False,
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
         (optional) streams newly sampled token ids per decode block from the
-        engine thread — keep it non-blocking."""
+        engine thread — keep it non-blocking. ``_prewarm`` requests bypass
+        the prefix cache entirely (no entries, no counters)."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         s = sampling or SamplingParams()
         prefix_len = len(s.forced_prefix)
@@ -521,8 +527,8 @@ class Engine:
         # every response (and any forced tool call) truncates immediately
         reserve = min(s.max_tokens, max(1, self.max_ctx // 2))
         budget = max(1, self.max_ctx - prefix_len - reserve)
-        truncated = len(tokens) > budget
-        if truncated:
+        truncated = len(tokens) > budget or _prewarm
+        if len(tokens) > budget:
             tokens = tokens[-budget:]
         req = _Request(
             rid=uuid.uuid4().hex[:8],
@@ -551,33 +557,33 @@ class Engine:
         20-40s of TPU compiles — fatal to the 500ms time-to-first-ToolCall
         target. Blocking; run from a background thread if startup latency
         matters more than first-request latency."""
-        with self._prefix_lock:
-            hits0, misses0 = self._prefix_hits, self._prefix_misses
-        # two prompt shapes per mode: the largest bucket (prefill compiles;
-        # when buckets[-1] == max_ctx these finish at 1 token with no decode
-        # room) and a short prompt that actually decodes K+ tokens (decode
-        # block at full width + the decay widths)
-        long_prompt = [1] * max(8, self.prefill_buckets[-1] - 1)
-        short_prompt = [1] * 8
-        shapes = [
-            (long_prompt, 1),
-            (short_prompt, self.decode_block_size + 1),
-        ]
+        # coverage (documented, not aspirational): per mode —
+        #   (a) a full-width staggered burst of short prompts: batched
+        #       prefill at the max chunk size, then decode at max width and
+        #       at EVERY narrower width bucket as the staggered max_tokens
+        #       drain the low slots last;
+        #   (b) one B=1 prefill per bucket (the shape a lone Task hits).
+        # Mid-size prefill batches (B=2/4) stay cold — rare and cheap
+        # relative to covering the bucket x batch matrix.
+        K = self.decode_block_size
+        widths = self.width_buckets
+        short = [1] * 8
         modes = [False, True] if constrained else [False]
         for json_only in modes:
-            for prompt, mt in shapes:
-                sp = SamplingParams(temperature=0.0, max_tokens=mt, json_only=json_only)
-                futs = [self.submit(list(prompt), sp) for _ in range(self.max_slots)]
-                for f in futs:
-                    f.result(timeout=1800)
-        # dummy prompts must not occupy the prefix cache or skew its stats;
-        # evict ONLY the all-dummy keys so real traffic served during a
-        # background prewarm keeps its entries
-        with self._prefix_lock:
-            for key in [k for k in self._prefix_cache if set(k) == {1}]:
-                del self._prefix_cache[key]
-            self._prefix_hits = hits0
-            self._prefix_misses = misses0
+            futs = []
+            for i in range(self.max_slots):
+                # slot i outlives slot j>i: the active set decays through
+                # every width bucket (block b leaves {i: i < widths[-1-b]}-ish)
+                blocks = 1 + sum(1 for w in widths if i < w)
+                sp = SamplingParams(
+                    temperature=0.0, max_tokens=blocks * K + 1, json_only=json_only
+                )
+                futs.append(self.submit(list(short), sp, _prewarm=True))
+            for b in self.prefill_buckets:
+                sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                futs.append(self.submit([1] * max(1, b - 1), sp, _prewarm=True))
+            for f in futs:
+                f.result(timeout=1800)
         log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:
@@ -724,7 +730,9 @@ class Engine:
             for item in group:
                 req, slot, _pages = item
                 start = 0
-                if self._prefix_enabled:
+                # truncated requests (and prewarm dummies) can neither hit
+                # nor seed the cache — they don't count in the stats either
+                if self._prefix_enabled and not req.truncated:
                     m = self._match_prefix(req)
                     if m is not None:
                         self._copy_prefix_into_slot(slot, m[1])

@@ -174,11 +174,13 @@ def test_engine_crash_recovery():
 
 
 def test_prewarm_compiles_and_leaves_clean_state(engine):
+    before = engine.stats().get("prefix_cache")
     engine.prewarm(constrained=True)
     st = engine.stats()
     assert st["active_slots"] == 0 and st["waiting"] == 0
     pc = st.get("prefix_cache")
     if pc is not None:
-        assert pc["entries"] == 0 and pc["hits"] == 0  # dummies left no trace
+        # dummies left no trace: entries and counters exactly as before
+        assert pc == before
     r = engine.generate("after prewarm", SamplingParams(temperature=0.0, max_tokens=4))
     assert len(r.tokens) >= 1
