@@ -558,32 +558,55 @@ class Engine:
         target. Blocking; run from a background thread if startup latency
         matters more than first-request latency."""
         # coverage (documented, not aspirational): per mode —
-        #   (a) a full-width staggered burst of short prompts: batched
-        #       prefill at the max chunk size, then decode at max width and
-        #       at EVERY narrower width bucket as the staggered max_tokens
-        #       drain the low slots last;
-        #   (b) one B=1 prefill per bucket (the shape a lone Task hits).
+        #   (a) a full-width staggered burst at the largest bucket that
+        #       leaves decode room: batched prefill at the max chunk size,
+        #       then decode at max width and at EVERY narrower width bucket
+        #       as the staggered max_tokens drain the low slots last;
+        #   (b) a full-width burst at the LARGEST bucket, 1 token each
+        #       (the long-prompt burst prefill shape);
+        #   (c) one B=1 prefill per bucket, SEQUENTIAL — each awaited
+        #       before the next so admission can't batch them together
+        #       (the shape a lone Task hits).
         # Mid-size prefill batches (B=2/4) stay cold — rare and cheap
-        # relative to covering the bucket x batch matrix.
+        # relative to covering the full bucket x batch matrix.
         K = self.decode_block_size
         widths = self.width_buckets
-        short = [1] * 8
+        max_blocks = 1 + len(widths)
+        decay_bucket = self.prefill_buckets[0]
+        for b in self.prefill_buckets:
+            if b + max_blocks * K < self.max_ctx:
+                decay_bucket = b
         modes = [False, True] if constrained else [False]
         for json_only in modes:
+            # phase a: staggered decay burst (barrier: the next phase must
+            # find every slot free, or its batch can't form at full width)
             futs = []
             for i in range(self.max_slots):
                 # slot i outlives slot j>i: the active set decays through
-                # every width bucket (block b leaves {i: i < widths[-1-b]}-ish)
+                # every width bucket
                 blocks = 1 + sum(1 for w in widths if i < w)
                 sp = SamplingParams(
                     temperature=0.0, max_tokens=blocks * K + 1, json_only=json_only
                 )
-                futs.append(self.submit(list(short), sp, _prewarm=True))
-            for b in self.prefill_buckets:
-                sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
-                futs.append(self.submit([1] * max(1, b - 1), sp, _prewarm=True))
+                futs.append(
+                    self.submit([1] * max(1, decay_bucket - 1), sp, _prewarm=True)
+                )
             for f in futs:
                 f.result(timeout=1800)
+            # phase b: full-width burst at the largest bucket
+            if self.prefill_buckets[-1] != decay_bucket:
+                one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                futs = [
+                    self.submit([1] * (self.prefill_buckets[-1] - 1), one, _prewarm=True)
+                    for _ in range(self.max_slots)
+                ]
+                for f in futs:
+                    f.result(timeout=1800)
+            # phase c: lone-request shapes, sequential so admission can't
+            # batch them together
+            for b in self.prefill_buckets:
+                sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                self.submit([1] * max(1, b - 1), sp, _prewarm=True).result(timeout=1800)
         log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:

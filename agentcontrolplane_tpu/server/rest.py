@@ -168,6 +168,7 @@ class RestServer:
         r.add_post("/v1/contacts/{call_id}/respond", self.respond)
         r.add_get("/v1/events", self.list_events)
         r.add_post("/v1/chat/completions", self.chat_completions)
+        r.add_get("/v1/models", self.list_models)
         r.add_get("/v1/engine", self.engine_status)
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
@@ -847,7 +848,20 @@ class RestServer:
                     )
                 )
                 finish = "tool_calls"
-            await resp.write(chunk({}, finish))
+            final = {
+                "id": cid,
+                "object": "chat.completion.chunk",
+                "created": created,
+                "model": model,
+                "choices": [{"index": 0, "delta": {}, "finish_reason": finish}],
+                # usage on the final chunk (OpenAI stream_options parity)
+                "usage": {
+                    "prompt_tokens": result.prompt_tokens,
+                    "completion_tokens": len(result.tokens),
+                    "total_tokens": result.prompt_tokens + len(result.tokens),
+                },
+            }
+            await resp.write(f"data: {json.dumps(final)}\n\n".encode())
             await resp.write(b"data: [DONE]\n\n")
         except (ConnectionResetError, _asyncio.CancelledError):
             engine.cancel(fut)  # client went away mid-stream
@@ -873,6 +887,36 @@ class RestServer:
                 for e in events
             ]
         )
+
+    async def list_models(self, request: web.Request) -> web.Response:
+        """OpenAI-compatible model listing: the engine's model (when
+        configured) plus every LLM resource with its readiness flag."""
+        import time as _time
+
+        models = []
+        engine = self.operator.engine
+        if engine is not None:
+            dims = engine.stats()["model"]
+            models.append(
+                {
+                    "id": "tpu",
+                    "object": "model",
+                    "created": int(_time.time()),
+                    "owned_by": "acp-tpu",
+                    "metadata": dims,
+                }
+            )
+        for llm in self.store.list("LLM", request.query.get("namespace", "default")):
+            models.append(
+                {
+                    "id": llm.metadata.name,
+                    "object": "model",
+                    "created": int(_time.time()),
+                    "owned_by": llm.spec.provider,
+                    "ready": llm.status.ready,
+                }
+            )
+        return web.json_response({"object": "list", "data": models})
 
     async def engine_status(self, request: web.Request) -> web.Response:
         engine = self.operator.engine

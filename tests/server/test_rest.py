@@ -464,3 +464,13 @@ async def test_chat_completions_streaming_sse():
             assert finishes[-1] in ("stop", "length")
     finally:
         eng.stop()
+
+
+async def test_list_models_endpoint():
+    async with RestHarness() as h:
+        make_llm(h.store)
+        resp = await h.http.get(f"{h.base}/v1/models")
+        body = await resp.json()
+        assert resp.status == 200 and body["object"] == "list"
+        ids = [m["id"] for m in body["data"]]
+        assert "test-llm" in ids  # no engine configured in this harness
