@@ -156,7 +156,7 @@ class Engine:
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
         prefill_batch_max: int = 8,  # burst admissions batch up to this many prompts
         width_buckets: Sequence[int] = (1, 2, 4, 8),  # low-occupancy decode widths
-        prefix_cache_entries: int = 4,  # 0 disables; slot layout only
+        prefix_cache_entries: int = 4,  # 0 disables (slot: KV copies; paged: shared pages)
         prefix_cache_max_tokens: int = 4096,  # HBM bound: total cached KV tokens
         decode_block_size: int = 8,
         kv_layout: str = "slot",  # "slot" | "paged"
@@ -268,7 +268,7 @@ class Engine:
         # O(new tokens) instead of O(whole conversation).
         import collections as _collections
 
-        self._prefix_enabled = prefix_cache_entries > 0 and self.kv_layout == "slot"
+        self._prefix_enabled = prefix_cache_entries > 0
         self._prefix_cache_entries = prefix_cache_entries
         # HBM accounting: per cached token one K+V row per layer
         # (L * H_kv * d * 2 * dtype bytes); the token bound keeps worst-case
@@ -372,7 +372,11 @@ class Engine:
             return jax.jit(decode_block, donate_argnums=(1,))
 
         if self.kv_layout == "paged":
-            from ..models.llama import decode_step_paged, prefill_paged_batch
+            from ..models.llama import (
+                decode_step_paged,
+                prefill_paged_batch,
+                prefill_paged_continue,
+            )
 
             use_pallas = self._use_pallas
 
@@ -382,6 +386,17 @@ class Engine:
                 return pages, toks, states
 
             self._jit_prefill_paged = jax.jit(prefill_and_sample, donate_argnums=(1,))
+
+            def paged_continue_and_sample(params, pages, tokens, lengths, starts, page_ids, block_tables, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
+                pages, logits = prefill_paged_continue(
+                    params, pages, tokens, lengths, starts, page_ids, block_tables, config
+                )
+                toks, states = sample_first(logits, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets)
+                return pages, toks, states
+
+            self._jit_prefill_paged_continue = jax.jit(
+                paged_continue_and_sample, donate_argnums=(1,)
+            )
             mesh = self.mesh
             self._jit_decode_paged = make_decode_block(
                 lambda params, pages, tokens, seq_lens, active, block_tables: decode_step_paged(
@@ -607,6 +622,24 @@ class Engine:
             for b in self.prefill_buckets:
                 sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
                 self.submit([1] * max(1, b - 1), sp, _prewarm=True).result(timeout=1800)
+            # phase d: the prefix-cache CONTINUATION program (B=1): a seed
+            # request then an extending one that hits it. These must go
+            # through the real cache path, so they are NOT _prewarm
+            # requests; their all-dummy entries and their exactly
+            # one-miss-one-hit stats are removed right after. (Batched
+            # continuation shapes B>1 stay cold — rare and bounded.)
+            if self._prefix_enabled:
+                seed_len = self.prefill_buckets[0] + 1
+                one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                self.submit([1] * seed_len, one).result(timeout=1800)
+                self.submit([1] * (seed_len + 8), one).result(timeout=1800)
+                with self._prefix_lock:
+                    for key in [k for k in self._prefix_cache if set(k) == {1}]:
+                        old = self._prefix_cache.pop(key)
+                        if "pages" in old:
+                            self._allocator.free(old["pages"])
+                    self._prefix_hits = max(0, self._prefix_hits - 1)
+                    self._prefix_misses = max(0, self._prefix_misses - 1)
         log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:
@@ -661,7 +694,7 @@ class Engine:
                     "capacity": self._prefix_cache_entries,
                     "hits": self._prefix_hits,
                     "misses": self._prefix_misses,
-                    "cached_tokens": sum(e["cut"] for e in self._prefix_cache.values()),
+                    "cached_tokens": self._cached_tokens_locked(),
                 }
         return out
 
@@ -745,26 +778,25 @@ class Engine:
             if not group:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
-            # per item: resolve the prefix-cache start, then spill any
-            # overlong remainder through intermediate continuation chunks
-            # (chunked prefill — prompts longer than the largest bucket run
-            # as several bounded dispatches, not one giant compile)
+            # per item: resolve the prefix-cache start (match + page
+            # assembly already happened in _collect_group), then — slot
+            # layout — spill any overlong remainder through intermediate
+            # continuation chunks (chunked prefill)
             enriched: list[list] = []  # [item, start] (start mutated by spill)
             for item in group:
-                req, slot, _pages = item
+                req, slot, _pages, match = item
                 start = 0
-                # truncated requests (and prewarm dummies) can neither hit
-                # nor seed the cache — they don't count in the stats either
-                if self._prefix_enabled and not req.truncated:
-                    m = self._match_prefix(req)
-                    if m is not None:
-                        self._copy_prefix_into_slot(slot, m[1])
-                        start = m[1]["cut"]
-                        self._prefix_hits += 1
-                        REGISTRY.counter_add("acp_engine_prefix_cache_hit_requests", 1.0)
-                    else:
-                        self._prefix_misses += 1
-                        REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", 1.0)
+                if match is not None:
+                    if self.kv_layout == "slot":
+                        self._copy_prefix_into_slot(slot, match[1])
+                    # paged: the shared prefix pages are already in the
+                    # block table; nothing to copy
+                    start = match[1]["cut"]
+                    self._prefix_hits += 1
+                    REGISTRY.counter_add("acp_engine_prefix_cache_hit_requests", 1.0)
+                elif self._prefix_enabled and not req.truncated:
+                    self._prefix_misses += 1
+                    REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", 1.0)
                 enriched.append([item, start])
             if self.kv_layout == "slot":
                 self._spill_long_chunks(enriched)
@@ -798,7 +830,7 @@ class Engine:
                 starts = np.zeros(B, dtype=np.int32)
                 slots = np.zeros(B, dtype=np.int32)
                 for i, (item, start) in enumerate(batch):
-                    req, slot, _ = item
+                    req, slot, _, _m = item
                     toks[i] = self._full_row(req)[start : start + CH]
                     starts[i] = start
                     slots[i] = slot
@@ -867,63 +899,102 @@ class Engine:
         self.cache = fn(self.cache, jnp.int32(slot), entry["k"], entry["v"])
 
     def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:
-        """After a miss prefill: snapshot the slot's leading KV at the
-        largest bucket boundary as a reusable prefix entry (LRU-capped).
-        The cut never reaches past the PROMPT into the teacher-forced
-        generation prefix — the next turn's rendered prompt contains the
-        serialized assistant message, not the raw forced tokens, so a key
-        crossing that boundary could never match again."""
+        """After a prefill: snapshot the slot's leading KV as a reusable
+        prefix entry (LRU-capped). Slot layout: a device COPY at the largest
+        bucket/chunk boundary. Paged layout: zero-copy — take a reference on
+        the slot's leading (full, immutable) pages. The cut never reaches
+        past the PROMPT into the teacher-forced generation prefix — the
+        next turn's rendered prompt contains the serialized assistant
+        message, not the raw forced tokens, so a key crossing that boundary
+        could never match again."""
         if not self._prefix_enabled:
             return
         cap = min(prompt_len, len(full) - 1)
-        cut = 0
-        for b in self.prefill_buckets:
-            if b <= cap:
-                cut = b
-        # chunked-prefill configs (largest bucket << max_ctx): snapshot at
-        # the largest chunk-multiple instead, or long conversations would be
-        # reusable only up to one bucket and re-spill almost everything
-        CH = self.prefill_buckets[-1]
-        cut = max(cut, (cap // CH) * CH)
-        if cut < self.prefill_buckets[0]:
+        if self.kv_layout == "paged":
+            cut = (cap // self.page_size) * self.page_size  # full pages only
+        else:
+            cut = 0
+            for b in self.prefill_buckets:
+                if b <= cap:
+                    cut = b
+            # chunked-prefill configs (largest bucket << max_ctx): snapshot
+            # at the largest chunk-multiple instead, or long conversations
+            # would be reusable only up to one bucket
+            CH = self.prefill_buckets[-1]
+            cut = max(cut, (cap // CH) * CH)
+        if cut < min(self.prefill_buckets[0], 4 * self.page_size):
             return  # too short to be worth caching
         key = tuple(full[:cut])
         with self._prefix_lock:
             if key in self._prefix_cache:
                 self._prefix_cache.move_to_end(key)
                 return
-        fn = self._jit_extract_prefix.get(cut)
-        if fn is None:
-            L = self.config.n_layers
-            Hkv = self.config.n_kv_heads
-            d = self.config.head_dim
+        if self.kv_layout == "paged":
+            pages = self._slot_pages[slot][: cut // self.page_size]
+            self._allocator.share(pages)
+            entry = {"cut": cut, "pages": list(pages)}
+        else:
+            fn = self._jit_extract_prefix.get(cut)
+            if fn is None:
+                L = self.config.n_layers
+                Hkv = self.config.n_kv_heads
+                d = self.config.head_dim
 
-            def extract(cache, slot_):
-                ek = jax.lax.dynamic_slice(
-                    cache["k"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
-                )[:, 0]
-                ev = jax.lax.dynamic_slice(
-                    cache["v"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
-                )[:, 0]
-                return ek, ev
+                def extract(cache, slot_):
+                    ek = jax.lax.dynamic_slice(
+                        cache["k"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
+                    )[:, 0]
+                    ev = jax.lax.dynamic_slice(
+                        cache["v"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
+                    )[:, 0]
+                    return ek, ev
 
-            fn = jax.jit(extract)  # read-only: cache NOT donated
-            self._jit_extract_prefix[cut] = fn
-        ek, ev = fn(self.cache, jnp.int32(slot))
+                fn = jax.jit(extract)  # read-only: cache NOT donated
+                self._jit_extract_prefix[cut] = fn
+            ek, ev = fn(self.cache, jnp.int32(slot))
+            entry = {"cut": cut, "k": ek, "v": ev}
         with self._prefix_lock:
-            self._prefix_cache[key] = {"cut": cut, "k": ek, "v": ev}
+            self._prefix_cache[key] = entry
             while len(self._prefix_cache) > self._prefix_cache_entries or (
                 len(self._prefix_cache) > 1
-                and sum(e["cut"] for e in self._prefix_cache.values())
-                > self._prefix_cache_max_tokens
+                and self._cached_tokens_locked() > self._prefix_cache_max_tokens
             ):
-                self._prefix_cache.popitem(last=False)  # evict LRU; frees HBM
+                _, old = self._prefix_cache.popitem(last=False)  # evict LRU
+                if "pages" in old:
+                    self._allocator.free(old["pages"])  # drop the cache ref
 
-    def _collect_group(self) -> list[tuple[_Request, int, Optional[list[int]]]]:
+    def _cached_tokens_locked(self) -> int:
+        """Distinct tokens pinned by the cache (hold _prefix_lock). Paged
+        entries from one growing conversation SHARE pages — counting each
+        entry's cut would double-count them and evict prematurely."""
+        toks = 0
+        pages: set[int] = set()
+        for e in self._prefix_cache.values():
+            if "pages" in e:
+                pages.update(e["pages"])
+            else:
+                toks += e["cut"]
+        return toks + len(pages) * self.page_size
+
+    def _evict_one_prefix_entry(self) -> bool:
+        """Evict the LRU prefix entry (allocation pressure). True if one
+        was evicted."""
+        with self._prefix_lock:
+            if not self._prefix_cache:
+                return False
+            _, old = self._prefix_cache.popitem(last=False)
+        if "pages" in old:
+            self._allocator.free(old["pages"])
+        return True
+
+    def _collect_group(self) -> list[tuple[_Request, int, Optional[list[int]], Optional[tuple]]]:
         """Pop up to prefill_batch_max admissible head requests, reserving a
-        slot (and KV pages, in paged mode) for each. Strict FIFO: stop at
-        the first request that can't get pages."""
-        group: list[tuple[_Request, int, Optional[list[int]]]] = []
+        slot (and KV pages, in paged mode) for each, and resolving each
+        request's prefix-cache match. Paged hits assemble their block list
+        as SHARED prefix pages (refcounted, never re-written) + freshly
+        allocated suffix pages. Strict FIFO: stop at the first request that
+        can't get pages."""
+        group: list[tuple[_Request, int, Optional[list[int]], Optional[tuple]]] = []
         while self._waiting and self._free and len(group) < self.prefill_batch_max:
             req = self._waiting[0]
             s = req.sampling
@@ -936,27 +1007,46 @@ class Engine:
                         RuntimeError("forced_prefix is not a legal JSON prefix")
                     )
                     continue
+            match: Optional[tuple] = None
+            if self._prefix_enabled and not req.truncated:
+                match = self._match_prefix(req)
             pages: Optional[list[int]] = None
             if self.kv_layout == "paged":
-                n_pages = -(-(len(req.prompt) + len(s.forced_prefix)) // self.page_size)
-                if n_pages > self._allocator.num_pages - 1:
+                total_pages = -(-(len(req.prompt) + len(s.forced_prefix)) // self.page_size)
+                if total_pages > self._allocator.num_pages - 1:
                     # bigger than the entire pool: waiting would spin forever
                     self._waiting.popleft()
                     req.future.set_exception(
                         RuntimeError(
-                            f"prompt needs {n_pages} KV pages but the pool has "
+                            f"prompt needs {total_pages} KV pages but the pool has "
                             f"{self._allocator.num_pages - 1}"
                         )
                     )
                     continue
-                try:
-                    pages = self._allocator.alloc(n_pages)
-                except MemoryError:
-                    break  # head waits for finishing slots to free pages
+                shared: list[int] = []
+                if match is not None:
+                    shared = list(match[1]["pages"])
+                # take the share FIRST: if allocation pressure evicts the
+                # matched entry below, our reference keeps its pages alive
+                self._allocator.share(shared)
+                fresh: Optional[list[int]] = None
+                while fresh is None:
+                    try:
+                        fresh = self._allocator.alloc(total_pages - len(shared))
+                    except MemoryError:
+                        # cache entries PIN pages; under pressure they must
+                        # yield or an idle engine could livelock with the
+                        # head request waiting on pages nothing will free
+                        if not self._evict_one_prefix_entry():
+                            break
+                if fresh is None:
+                    self._allocator.free(shared)  # undo; head waits (FIFO)
+                    break
+                pages = shared + fresh
             self._waiting.popleft()
             # lowest-index slot first: keeps active slots compacted at low
             # indices so decode width bucketing stays narrow
-            group.append((req, heapq.heappop(self._free), pages))
+            group.append((req, heapq.heappop(self._free), pages, match))
         return group
 
     def _seed_con_state(self, prefix: Sequence[int]) -> int:
@@ -1009,7 +1099,7 @@ class Engine:
         # miss; suffix on a hit)
         bucket = max(
             _next_bucket(len(self._full_row(r)) - int(starts[i]), self.prefill_buckets)
-            for i, (r, _, _) in enumerate(chunk)
+            for i, (r, _, _, _) in enumerate(chunk)
         )
         tokens = np.zeros((B, bucket), dtype=np.int32)
         lengths = np.zeros(B, dtype=np.int32)
@@ -1021,7 +1111,7 @@ class Engine:
         constrained0 = np.zeros(B, dtype=bool)
         budgets = np.zeros(B, dtype=np.int32)
         full_lens = np.zeros(B, dtype=np.int32)
-        any_json = any(r.sampling.json_only for r, _, _ in chunk)
+        any_json = any(r.sampling.json_only for r, _, _, _ in chunk)
         if any_json:
             table = self._get_token_table()
             min_close = self._min_close
@@ -1030,7 +1120,7 @@ class Engine:
             min_close = (
                 self._min_close if self._min_close is not None else self._dummy_min_close
             )
-        for i, (req, slot, _) in enumerate(chunk):
+        for i, (req, slot, _, _m) in enumerate(chunk):
             s = req.sampling
             row = self._full_row(req)
             plen = len(row)
@@ -1069,16 +1159,29 @@ class Engine:
             jnp.asarray(budgets),
         )
         if self.kv_layout == "paged":
-            page_ids = np.full((B, bucket // self.page_size), TRASH_PAGE, dtype=np.int32)
-            for i, (req, slot, pages) in enumerate(chunk):
+            P = self.page_size
+            # suffix pages only (the model writes just the suffix; shared
+            # prefix pages are referenced via the block table, never written)
+            page_ids = np.full((B, bucket // P), TRASH_PAGE, dtype=np.int32)
+            for i, (req, slot, pages, _m) in enumerate(chunk):
                 assert pages is not None
                 self._slot_pages[slot] = pages
                 self._block_tables[slot, :] = TRASH_PAGE
                 self._block_tables[slot, : len(pages)] = pages
-                page_ids[i, : len(pages)] = pages
-            cache, firsts, con_states = self._jit_prefill_paged(
-                self.params, self.cache, *common, jnp.asarray(page_ids), *tail
-            )
+                fresh = pages[int(starts[i]) // P :]
+                page_ids[i, : len(fresh)] = fresh
+            if starts_np is not None:
+                block_tables = jnp.asarray(
+                    self._block_tables[[slot for _, slot, _, _ in chunk]]
+                )
+                cache, firsts, con_states = self._jit_prefill_paged_continue(
+                    self.params, self.cache, *common,
+                    jnp.asarray(starts), jnp.asarray(page_ids), block_tables, *tail,
+                )
+            else:
+                cache, firsts, con_states = self._jit_prefill_paged(
+                    self.params, self.cache, *common, jnp.asarray(page_ids), *tail
+                )
         elif starts_np is not None:
             cache, firsts, con_states = self._jit_prefill_continue(
                 self.params, self.cache, *common,
@@ -1089,18 +1192,18 @@ class Engine:
                 self.params, self.cache, *common, jnp.asarray(slots), *tail
             )
         self.cache = cache
-        if self.kv_layout == "slot":
-            # snapshot prefixes for future hits (engine thread; the rows
-            # can't change before decode extends past the cut). Hit slots
-            # save too: their rows now hold the FULL prompt KV, so the next
-            # turn can reuse this whole context, not just the old prefix.
-            for i, (req, slot, _) in enumerate(chunk):
+        # snapshot prefixes for future hits (engine thread; the state can't
+        # change before decode extends past the cut). Hit slots save too:
+        # their rows/tables now hold the FULL prompt KV, so the next turn can
+        # reuse this whole context, not just the old prefix.
+        if self._prefix_enabled:
+            for i, (req, slot, _, _m) in enumerate(chunk):
                 if not req.truncated:
                     self._save_prefix(self._full_row(req), len(req.prompt), slot)
         firsts = np.asarray(firsts)
         con_states = np.asarray(con_states)
         now = time.monotonic()
-        for i, (req, slot, _) in enumerate(chunk):
+        for i, (req, slot, _, _m) in enumerate(chunk):
             s = req.sampling
             first_tok = int(firsts[i])
             self._con_states[slot] = int(con_states[i])

@@ -444,6 +444,55 @@ def prefill_paged(
     return pages, logits[0]
 
 
+def prefill_paged_continue(
+    params: dict,
+    pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
+    tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true suffix lengths
+    starts: jax.Array,  # [B] int32 — absolute suffix start (page-aligned)
+    page_ids: jax.Array,  # [B, T // P] int32 — the SUFFIX pages
+    block_tables: jax.Array,  # [B, max_pages] int32 — prefix + suffix pages
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Paged prefix-cache continuation: the prefix pages referenced by each
+    row's block table are already populated (SHARED with the cache entry —
+    never written here; starts are page-aligned so suffix writes only touch
+    fresh pages). Runs the suffix through the model, attending over the
+    gathered prefix+suffix pages. Returns (pages, last-token logits [B, V])."""
+    c = config
+    B, T = tokens.shape
+    ar = jnp.arange(T)
+    positions = jnp.where(ar[None, :] < lengths[:, None], starts[:, None] + ar[None, :], -1)
+    x = params["embed"][tokens].astype(c.dtype)
+    max_pages = block_tables.shape[1]
+
+    def body(carry, scanned):
+        x = carry
+        layer, k_pages_l, v_pages_l = scanned
+
+        def attn(q, k, v):
+            P = k_pages_l.shape[1]
+            blocks = lambda t: t.reshape(B * (T // P), P, *t.shape[2:])
+            flat_ids = page_ids.reshape(-1)
+            k_l = k_pages_l.at[flat_ids].set(blocks(k).astype(k_pages_l.dtype))
+            v_l = v_pages_l.at[flat_ids].set(blocks(v).astype(v_pages_l.dtype))
+            k_rows = k_l[block_tables].reshape(B, max_pages * P, *k_l.shape[2:])
+            v_rows = v_l[block_tables].reshape(B, max_pages * P, *v_l.shape[2:])
+            out = continue_attention(q, k_rows, v_rows, positions)
+            attn.updated = (k_l, v_l)
+            return out
+
+        out, _, _ = _attn_mlp(x, layer, c, positions, attn)
+        return out, attn.updated
+
+    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    x = rms_norm(x, params["norm"], c.norm_eps)
+    last = x[jnp.arange(B), lengths - 1]
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    return {"k": new_k, "v": new_v}, logits
+
+
 def decode_step_paged(
     params: dict,
     pages: dict,

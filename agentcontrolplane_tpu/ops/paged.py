@@ -90,12 +90,19 @@ def paged_decode_attention_reference(
 
 
 class PageAllocator:
-    """Host-side page free list (the engine thread owns it; no locking).
-    Page 0 is the reserved trash page and is never handed out."""
+    """Host-side page free list with reference counts (the engine thread
+    owns it; no locking). Page 0 is the reserved trash page and is never
+    handed out.
+
+    Refcounts enable zero-copy prefix sharing: a cached prompt prefix keeps
+    a reference on its (full, immutable) pages, and every sequence whose
+    block table borrows them takes another — a page returns to the pool
+    only when its last reference drops."""
 
     def __init__(self, num_pages: int):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
+        self._refs: dict[int, int] = {}
 
     @property
     def free_count(self) -> int:
@@ -104,9 +111,27 @@ class PageAllocator:
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
             raise MemoryError(f"out of KV pages: need {n}, have {len(self._free)}")
-        return [self._free.pop() for _ in range(n)]
+        pages = [self._free.pop() for _ in range(n)]
+        for p in pages:
+            self._refs[p] = 1
+        return pages
 
-    def free(self, pages: list[int]) -> None:
+    def share(self, pages: list[int]) -> None:
+        """Take an additional reference on already-allocated pages."""
         for p in pages:
             if p != TRASH_PAGE:
+                self._refs[p] += 1
+
+    def free(self, pages: list[int]) -> None:
+        """Drop one reference per page; pool it when the last ref drops.
+        Freeing a page with no live reference raises (KeyError) — a silent
+        double-free would hand one page to two sequences later."""
+        for p in pages:
+            if p == TRASH_PAGE:
+                continue
+            left = self._refs[p] - 1
+            if left <= 0:
+                del self._refs[p]
                 self._free.append(p)
+            else:
+                self._refs[p] = left

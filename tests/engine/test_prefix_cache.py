@@ -157,3 +157,94 @@ def test_chunked_prefill_long_prompt():
     finally:
         small_buckets.stop()
         big_buckets.stop()
+
+
+def _paged_engine(prefix_entries: int) -> Engine:
+    eng = Engine(
+        config=CFG,
+        tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=4,
+        max_ctx=256,
+        prefill_buckets=(64, 128, 256),
+        decode_block_size=4,
+        kv_layout="paged",
+        page_size=16,
+        prefix_cache_entries=prefix_entries,
+        seed=0,
+    )
+    eng.start()
+    return eng
+
+
+def test_paged_hit_results_match_cold_engine():
+    """Paged layout shares prefix PAGES zero-copy (refcounted block-table
+    references); hits must still be bit-identical to cold prefills."""
+    greedy = SamplingParams(temperature=0.0, max_tokens=10)
+    cached = _paged_engine(prefix_entries=4)
+    cold = _paged_engine(prefix_entries=0)
+    try:
+        prompts = [SYSTEM + "turn one", SYSTEM + "turn one and then some"]
+        out_cached = [cached.generate(p, greedy).tokens for p in prompts]
+        assert cached.stats()["prefix_cache"]["entries"] >= 1
+        assert cached.stats()["prefix_cache"]["hits"] >= 1  # prompt 2 reused prompt 1's pages
+        out_cold = [cold.generate(p, greedy).tokens for p in prompts]
+        assert out_cached == out_cold
+    finally:
+        cached.stop()
+        cold.stop()
+
+
+def test_paged_prefix_page_refcounts_conserved():
+    """Page accounting: after all requests drain, the only pages still out
+    are exactly the cached entries' shared pages; disabling the cache (0
+    entries) returns the pool to full."""
+    greedy = SamplingParams(temperature=0.0, max_tokens=6)
+    eng = _paged_engine(prefix_entries=2)
+    initial_free = eng._allocator.free_count
+    try:
+        for i in range(5):  # several prompts; entries capped at 2 (LRU evicts)
+            eng.generate(SYSTEM + f"variant {i}", greedy)
+        import time as _time
+
+        deadline = _time.monotonic() + 30
+        while _time.monotonic() < deadline and eng.stats()["active_slots"]:
+            _time.sleep(0.05)
+        held = sum(
+            len(e["pages"]) for e in eng._prefix_cache.values() if "pages" in e
+        )
+        assert held > 0
+        assert eng._allocator.free_count == initial_free - held
+        # evict everything (simulate) and the pool must be whole again
+        with eng._prefix_lock:
+            while eng._prefix_cache:
+                _, old = eng._prefix_cache.popitem(last=False)
+                eng._allocator.free(old["pages"])
+        assert eng._allocator.free_count == initial_free
+    finally:
+        eng.stop()
+
+
+def test_paged_entry_eviction_while_borrower_active_is_safe():
+    """An entry evicted while a sequence still references its pages must not
+    free them out from under the borrower (refcounts): the borrower's
+    output is unaffected and pages return only when it finishes."""
+    eng = _paged_engine(prefix_entries=1)  # capacity 1: next save evicts
+    cold = _paged_engine(prefix_entries=0)
+    try:
+        seed_prompt = SYSTEM + "base"
+        eng.generate(seed_prompt, SamplingParams(temperature=0.0, max_tokens=4))
+        # borrower: long generation that HITS the entry and keeps running
+        borrower = eng.submit(
+            seed_prompt + " extended turn", SamplingParams(temperature=0.0, max_tokens=48)
+        )
+        # a different prompt's save evicts the (capacity-1) entry mid-flight
+        eng.generate("completely different " * 10, SamplingParams(temperature=0.0, max_tokens=4))
+        got = borrower.result(timeout=120).tokens
+        want = cold.generate(
+            seed_prompt + " extended turn", SamplingParams(temperature=0.0, max_tokens=48)
+        ).tokens
+        assert got == want
+    finally:
+        eng.stop()
+        cold.stop()
