@@ -43,6 +43,9 @@ def cmd_run(args) -> int:
 
     setup_logging(os.environ.get("ACP_TPU_LOG_LEVEL", "INFO"))
 
+    if args.tpu_lora and not args.tpu_checkpoint:
+        print("error: --tpu-lora requires --tpu-checkpoint", file=sys.stderr)
+        return 2
     engine = None
     if args.tpu_preset or args.tpu_checkpoint:
         from .engine.engine import Engine
@@ -58,10 +61,25 @@ def cmd_run(args) -> int:
             from .engine.weights import load_safetensors_dir
 
             # quantization happens host-side at load: the bf16 copy of a big
-            # model never reaches the device
+            # model never reaches the device. With a LoRA adapter the merge
+            # must see bf16, so loading defers quantization to the Engine
+            # (which quantizes matrix-by-matrix on device — peak HBM is the
+            # bf16 params plus one tensor).
             params, config = load_safetensors_dir(
-                args.tpu_checkpoint, quantize=args.tpu_quantize
+                args.tpu_checkpoint,
+                quantize=None if args.tpu_lora else args.tpu_quantize,
             )
+            if args.tpu_lora:
+                from .train.lora import load_lora, merge_lora
+
+                lora_params, lora_cfg = load_lora(args.tpu_lora, config)
+                params = merge_lora(params, lora_params, lora_cfg)
+                print(
+                    f"merged LoRA adapter r={lora_cfg.rank} "
+                    f"targets={list(lora_cfg.targets)}"
+                    + (" (quantizing merged weights)" if args.tpu_quantize else ""),
+                    flush=True,
+                )
             tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
             tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
             engine = Engine(config=config, params=params, tokenizer=tokenizer, **kw)
@@ -359,6 +377,11 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
     run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
+    run.add_argument(
+        "--tpu-lora",
+        default=None,
+        help="LoRA adapter dir (train.lora.save_lora) merged into the checkpoint at load",
+    )
     run.add_argument("--tpu-slots", type=int, default=64)
     run.add_argument("--tpu-ctx", type=int, default=2048)
     run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")

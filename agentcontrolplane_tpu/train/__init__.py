@@ -1,3 +1,8 @@
+from .lora import LoraConfig, LoraTrainer, init_lora, load_lora, merge_lora, save_lora
 from .trainer import Trainer, cross_entropy_loss
 
-__all__ = ["Trainer", "cross_entropy_loss"]
+__all__ = [
+    "Trainer", "cross_entropy_loss",
+    "LoraConfig", "LoraTrainer", "init_lora", "merge_lora",
+    "save_lora", "load_lora",
+]

@@ -1,0 +1,192 @@
+"""LoRA fine-tuning: low-rank adapters over the frozen base model.
+
+No reference analogue (the reference trains nothing — SURVEY.md §0); this is
+how agents' base models get specialized ON the serving pod: a full 8B
+fine-tune doesn't fit one 16GB chip, but rank-r adapters (~0.1% of the
+params) train comfortably next to the frozen bf16/int8 base.
+
+Design (TPU-first):
+
+- Adapters are a tiny separate pytree ``{"layers": {target: {"a", "b"}}}``
+  with ``a: [L, in, r]`` (scaled normal) and ``b: [L, r, out]`` (zeros —
+  merged delta starts at exactly 0). They stay REPLICATED on the mesh:
+  at rank<=64 they are KBs-to-MBs, so replication is cheaper than any
+  collective a sharded layout would force into the matmul path.
+- The forward pass runs on ``merge_lora(params, lora)`` — functionally
+  merged weights (base + (a@b) * alpha/r). Autodiff through the merge
+  yields gradients for a/b only; the base pytree is a closed-over constant
+  so XLA never materializes base gradients. The merge itself fuses into
+  the layer matmuls' operand production.
+- Serving: merge once at load (``merge_lora``) and hand the merged tree to
+  the Engine — zero inference-time overhead. Merge BEFORE int8
+  quantization (a LoRA delta over already-quantized weights would need
+  dequant; the CLI enforces the order).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.llama import LlamaConfig, init_params
+from ..parallel.mesh import param_shardings
+from .trainer import lm_loss
+
+# weight shapes are stacked [L, in, out]; all attention + MLP mats accepted
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "w1", "w2", "w3")
+
+
+@dataclass(frozen=True)
+class LoraConfig:
+    rank: int = 8
+    alpha: float = 16.0
+    targets: Sequence[str] = ("wq", "wk", "wv", "wo")
+
+    @property
+    def scale(self) -> float:
+        return self.alpha / self.rank
+
+
+def init_lora(config: LlamaConfig, lora: LoraConfig, key: jax.Array) -> dict:
+    """a ~ N(0, 1/r) (fan-in style), b = 0 — the initial delta is exactly 0,
+    so training starts from the base model's behavior."""
+    bad = [t for t in lora.targets if t not in LORA_TARGETS]
+    if bad:
+        raise ValueError(f"unknown LoRA targets {bad}; valid: {LORA_TARGETS}")
+    abstract = jax.eval_shape(lambda k: init_params(config, k), jax.random.key(0))
+    layers = {}
+    for i, t in enumerate(lora.targets):
+        Lk, d_in, d_out = abstract["layers"][t].shape
+        k = jax.random.fold_in(key, i)
+        layers[t] = {
+            "a": (
+                jax.random.normal(k, (Lk, d_in, lora.rank)) * (lora.rank**-0.5)
+            ).astype(jnp.float32),
+            "b": jnp.zeros((Lk, lora.rank, d_out), dtype=jnp.float32),
+        }
+    return {"layers": layers}
+
+
+def merge_lora(
+    params: dict, lora_params: dict, lora: LoraConfig, compute_dtype=None
+) -> dict:
+    """base + (a @ b) * alpha/r, leaving non-target leaves untouched.
+    Works for training (differentiable in lora_params; pass
+    ``compute_dtype=jnp.float32``) and for one-shot serving merges, where
+    the default computes the delta directly in the base dtype — the eager
+    serving merge would otherwise materialize a full float32 copy of every
+    target matrix (2x bf16) next to a chip-filling base."""
+    merged_layers = dict(params["layers"])
+    for t, ab in lora_params["layers"].items():
+        base = params["layers"][t]
+        dt = compute_dtype or base.dtype
+        delta = jnp.einsum(
+            "lir,lro->lio", ab["a"].astype(dt), ab["b"].astype(dt)
+        ) * jnp.asarray(lora.scale, dtype=dt)
+        merged_layers[t] = (base.astype(dt) + delta).astype(base.dtype)
+    out = dict(params)
+    out["layers"] = merged_layers
+    return out
+
+
+@dataclass
+class LoraTrainer:
+    """Adapter-only train step: the base pytree is frozen (no gradients, no
+    optimizer state); only the replicated a/b tensors update."""
+
+    config: LlamaConfig
+    lora: LoraConfig
+    mesh: Mesh
+    optimizer: optax.GradientTransformation
+
+    def __post_init__(self):
+        c, mesh = self.config, self.mesh
+        abstract = jax.eval_shape(lambda k: init_params(c, k), jax.random.key(0))
+        self.base_sharding = param_shardings(mesh, c, abstract)
+        rep = NamedSharding(mesh, P())
+        self.lora_sharding = jax.tree_util.tree_map(
+            lambda _: rep,
+            jax.eval_shape(lambda k: init_lora(c, self.lora, k), jax.random.key(0)),
+        )
+        has_dp = "dp" in mesh.axis_names and mesh.shape["dp"] > 1
+        self.batch_sharding = NamedSharding(mesh, P("dp" if has_dp else None))
+        lora_cfg = self.lora
+
+        def loss_fn(lora_params, base_params, tokens, loss_mask):
+            merged = merge_lora(
+                base_params, lora_params, lora_cfg, compute_dtype=jnp.float32
+            )
+            return lm_loss(merged, tokens, loss_mask, c)
+
+        def train_step(lora_params, opt_state, base_params, tokens, loss_mask):
+            loss, grads = jax.value_and_grad(loss_fn)(
+                lora_params, base_params, tokens, loss_mask
+            )
+            updates, opt_state = self.optimizer.update(grads, opt_state, lora_params)
+            lora_params = optax.apply_updates(lora_params, updates)
+            return lora_params, opt_state, loss
+
+        self.train_step = jax.jit(
+            train_step,
+            in_shardings=(
+                self.lora_sharding,
+                None,
+                self.base_sharding,
+                self.batch_sharding,
+                self.batch_sharding,
+            ),
+            out_shardings=(self.lora_sharding, None, None),
+            donate_argnums=(0, 1),
+        )
+
+    def init(self, key: jax.Array) -> tuple[dict, optax.OptState]:
+        lora_params = jax.jit(
+            lambda k: init_lora(self.config, self.lora, k),
+            out_shardings=self.lora_sharding,
+        )(key)
+        opt_state = self.optimizer.init(lora_params)
+        return lora_params, opt_state
+
+
+def save_lora(path: str, lora_params: dict, lora: LoraConfig, step: int = 0) -> None:
+    """Adapter checkpoint: orbax tree + a lora.json carrying the config
+    (rank/targets are recoverable from shapes; alpha is not)."""
+    import json
+    import os
+
+    from .checkpoint import save_checkpoint
+
+    save_checkpoint(path, lora_params, step=step)
+    with open(os.path.join(path, "lora.json"), "w") as f:
+        json.dump(
+            {"rank": lora.rank, "alpha": lora.alpha, "targets": list(lora.targets)}, f
+        )
+
+
+def load_lora(path: str, config: LlamaConfig) -> tuple[dict, LoraConfig]:
+    import json
+    import os
+
+    from .checkpoint import abstract_like, restore_checkpoint
+
+    with open(os.path.join(path, "lora.json")) as f:
+        meta = json.load(f)
+    cfg = LoraConfig(
+        rank=meta["rank"], alpha=meta["alpha"], targets=tuple(meta["targets"])
+    )
+    abstract = {
+        "params": jax.eval_shape(lambda k: init_lora(config, cfg, k), jax.random.key(0))
+    }
+    restored = restore_checkpoint(path, abstract_like(abstract))
+    return restored["params"], cfg
+
+
+__all__ = [
+    "LoraConfig", "LoraTrainer", "init_lora", "merge_lora", "save_lora",
+    "load_lora", "LORA_TARGETS",
+]

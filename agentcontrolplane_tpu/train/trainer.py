@@ -35,6 +35,23 @@ def cross_entropy_loss(
     return jnp.sum(nll * mask) / jnp.maximum(jnp.sum(mask), 1.0)
 
 
+def lm_loss(
+    params: dict,
+    tokens: jax.Array,  # [B, T] int32
+    loss_mask: jax.Array,  # [B, T]
+    config: LlamaConfig,
+    attn_impl=None,
+) -> jax.Array:
+    """Next-token LM objective shared by full fine-tuning and LoRA: arange
+    positions, shift-by-one targets, last position masked out."""
+    B, T = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    logits = forward(params, tokens, config, positions, attn_impl=attn_impl)
+    targets = jnp.roll(tokens, -1, axis=1)
+    mask = loss_mask.astype(jnp.float32).at[:, -1].set(0.0)
+    return cross_entropy_loss(logits, targets, mask)
+
+
 @dataclass
 class Trainer:
     """Owns the jitted train step; params/opt_state live sharded on device."""
@@ -83,12 +100,7 @@ class Trainer:
         )
 
         def loss_fn(params, tokens, loss_mask):
-            B, T = tokens.shape
-            positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-            logits = forward(params, tokens, c, positions, attn_impl=attn_impl)
-            targets = jnp.roll(tokens, -1, axis=1)
-            mask = loss_mask.astype(jnp.float32).at[:, -1].set(0.0)
-            return cross_entropy_loss(logits, targets, mask)
+            return lm_loss(params, tokens, loss_mask, c, attn_impl=attn_impl)
 
         def train_step(params, opt_state, tokens, loss_mask):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, loss_mask)
@@ -128,4 +140,4 @@ class Trainer:
         )
 
 
-__all__ = ["Trainer", "cross_entropy_loss"]
+__all__ = ["Trainer", "cross_entropy_loss", "lm_loss"]
