@@ -60,26 +60,16 @@ def cmd_run(args) -> int:
         if args.tpu_checkpoint:
             from .engine.weights import load_safetensors_dir
 
-            # quantization happens host-side at load: the bf16 copy of a big
-            # model never reaches the device. With a LoRA adapter the merge
-            # must see bf16, so loading defers quantization to the Engine
-            # (which quantizes matrix-by-matrix on device — peak HBM is the
-            # bf16 params plus one tensor).
+            # LoRA merge AND quantization both happen host-side at load, in
+            # that order — the bf16 (and unmerged) copy of a big model never
+            # reaches the device
             params, config = load_safetensors_dir(
                 args.tpu_checkpoint,
-                quantize=None if args.tpu_lora else args.tpu_quantize,
+                quantize=args.tpu_quantize,
+                lora_path=args.tpu_lora,
             )
             if args.tpu_lora:
-                from .train.lora import load_lora, merge_lora
-
-                lora_params, lora_cfg = load_lora(args.tpu_lora, config)
-                params = merge_lora(params, lora_params, lora_cfg)
-                print(
-                    f"merged LoRA adapter r={lora_cfg.rank} "
-                    f"targets={list(lora_cfg.targets)}"
-                    + (" (quantizing merged weights)" if args.tpu_quantize else ""),
-                    flush=True,
-                )
+                print(f"merged LoRA adapter from {args.tpu_lora}", flush=True)
             tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
             tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
             engine = Engine(config=config, params=params, tokenizer=tokenizer, **kw)

@@ -180,6 +180,13 @@ class Engine:
             self.max_ctx
         ]
         self.mesh = mesh if mesh is not None else serving_mesh()
+        tp = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
+        if tp > 1 and self.config.n_kv_heads % tp:
+            raise ValueError(
+                f"n_kv_heads={self.config.n_kv_heads} cannot shard over tp={tp} "
+                "(MQA/GQA KV heads must divide tp — serve gemma-2b-style MQA "
+                "models with tp=1)"
+            )
         self.prefill_batch_max = max(1, prefill_batch_max)
         # decode dispatch widths: smallest bucket covering the active slots
         # (each width is its own jit cache entry; keep the set small so cold

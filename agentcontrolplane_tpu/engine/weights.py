@@ -47,6 +47,8 @@ _BIAS_MAP = {
 def config_from_hf(config_path: str) -> LlamaConfig:
     with open(config_path) as f:
         hf = json.load(f)
+    is_gemma = hf.get("model_type") == "gemma"
+    act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     return LlamaConfig(
         vocab_size=hf["vocab_size"],
         dim=hf["hidden_size"],
@@ -57,9 +59,14 @@ def config_from_hf(config_path: str) -> LlamaConfig:
         norm_eps=hf.get("rms_norm_eps", 1e-5),
         rope_theta=hf.get("rope_theta", 500000.0),
         max_seq_len=hf.get("max_position_embeddings", 8192),
-        tie_embeddings=hf.get("tie_word_embeddings", False),
+        # gemma ties embeddings unconditionally
+        tie_embeddings=bool(hf.get("tie_word_embeddings", is_gemma)),
         # Qwen2 checkpoints set attention_bias (or are the qwen2 model_type)
         qkv_bias=bool(hf.get("attention_bias", hf.get("model_type") == "qwen2")),
+        hidden_act="gelu_tanh" if ("gelu" in act or is_gemma) else "silu",
+        norm_plus_one=is_gemma,
+        embed_scale=is_gemma,
+        head_dim_override=hf.get("head_dim") if is_gemma else None,
     )
 
 
@@ -68,6 +75,7 @@ def params_from_state_dict(
     config: LlamaConfig,
     put: Optional[Callable[[str, np.ndarray], jax.Array]] = None,
     quantize: Optional[str] = None,
+    lora: Optional[tuple] = None,  # (lora_params_as_numpy, LoraConfig)
 ) -> dict:
     """Build the params pytree from HF-named tensors.
 
@@ -112,6 +120,16 @@ def params_from_state_dict(
                 m = m.T  # HF stores [out, in]; we compute x @ W as [in, out]
             mats.append(m)
         stacked = np.stack(mats)
+        if lora is not None and key in lora[0]["layers"]:
+            # merge the adapter HOST-SIDE, before quantization and before
+            # anything reaches the device — an on-device merge of an 8B
+            # model would put bf16 params + merged copies on a 16GB chip
+            ab = lora[0]["layers"][key]
+            stacked = stacked + np.einsum(
+                "lir,lro->lio",
+                np.asarray(ab["a"], dtype=np.float32),
+                np.asarray(ab["b"], dtype=np.float32),
+            ) * lora[1].scale
         if quantize == "int8" and key in QUANTIZABLE:
             absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
             scale = np.maximum(absmax, 1e-8) / 127.0
@@ -132,12 +150,22 @@ def load_safetensors_dir(
     config: Optional[LlamaConfig] = None,
     put: Optional[Callable[[str, np.ndarray], jax.Array]] = None,
     quantize: Optional[str] = None,
+    lora_path: Optional[str] = None,
 ) -> tuple[dict, LlamaConfig]:
-    """Load an HF checkpoint directory (config.json + *.safetensors)."""
+    """Load an HF checkpoint directory (config.json + *.safetensors).
+    ``lora_path`` merges a trained adapter (train.lora.save_lora) host-side
+    BEFORE quantization/placement, so adapter+int8 serving never
+    materializes an unquantized model on device."""
     from safetensors import safe_open  # lazy: not all installs ship it
 
     if config is None:
         config = config_from_hf(os.path.join(path, "config.json"))
+    lora = None
+    if lora_path is not None:
+        from ..train.lora import load_lora
+
+        lora_params, lora_cfg = load_lora(lora_path, config)
+        lora = (jax.tree_util.tree_map(np.asarray, lora_params), lora_cfg)
     tensors: dict[str, np.ndarray] = {}
     for fname in sorted(os.listdir(path)):
         if not fname.endswith(".safetensors"):
@@ -145,7 +173,7 @@ def load_safetensors_dir(
         with safe_open(os.path.join(path, fname), framework="np") as f:
             for name in f.keys():
                 tensors[name] = f.get_tensor(name)
-    params = params_from_state_dict(tensors, config, put, quantize=quantize)
+    params = params_from_state_dict(tensors, config, put, quantize=quantize, lora=lora)
     return params, config
 
 

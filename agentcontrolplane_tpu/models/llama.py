@@ -40,7 +40,8 @@ from ..ops.rope import apply_rope
 class LlamaConfig:
     """Covers the Llama-architecture family: Llama-3/3.x, Mistral (same
     block; sliding window unused at our context lengths), Qwen2/2.5
-    (``qkv_bias=True``)."""
+    (``qkv_bias=True``), and Gemma-1 (``hidden_act="gelu_tanh"``,
+    ``norm_plus_one``, ``embed_scale``, explicit ``head_dim`` — MQA)."""
 
     vocab_size: int = 128256
     dim: int = 4096
@@ -53,10 +54,16 @@ class LlamaConfig:
     max_seq_len: int = 8192
     tie_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention input bias
+    hidden_act: str = "silu"  # "silu" (llama/mistral/qwen) | "gelu_tanh" (gemma)
+    norm_plus_one: bool = False  # gemma RMSNorm multiplies by (1 + weight)
+    embed_scale: bool = False  # gemma scales embeddings by sqrt(dim)
+    head_dim_override: Optional[int] = None  # gemma: head_dim != dim/n_heads
     dtype: Any = jnp.bfloat16
 
     @property
     def head_dim(self) -> int:
+        if self.head_dim_override is not None:
+            return self.head_dim_override
         return self.dim // self.n_heads
 
 
@@ -123,6 +130,37 @@ PRESETS: dict[str, LlamaConfig] = {
         qkv_bias=True,
         tie_embeddings=True,
     ),
+    # google/gemma-2b: MQA (1 kv head), GeGLU, (1+w) norms, scaled embeddings
+    "gemma-2b": LlamaConfig(
+        vocab_size=256000,
+        dim=2048,
+        n_layers=18,
+        n_heads=8,
+        n_kv_heads=1,
+        ffn_dim=16384,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        hidden_act="gelu_tanh",
+        norm_plus_one=True,
+        embed_scale=True,
+        head_dim_override=256,
+    ),
+    "gemma-7b": LlamaConfig(
+        vocab_size=256000,
+        dim=3072,
+        n_layers=28,
+        n_heads=16,
+        n_kv_heads=16,
+        ffn_dim=24576,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        hidden_act="gelu_tanh",
+        norm_plus_one=True,
+        embed_scale=True,
+        head_dim_override=256,
+    ),
     # tiny config for CPU tests (matches an HF config in tests)
     "tiny": LlamaConfig(
         vocab_size=256,
@@ -187,6 +225,18 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
     return params
 
 
+
+def _embed(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
+    x = params["embed"][tokens].astype(c.dtype)
+    if c.embed_scale:  # gemma normalizes embeddings by sqrt(dim)
+        x = x * jnp.asarray(c.dim**0.5, dtype=c.dtype)
+    return x
+
+
+def _final_norm_w(params: dict, c: LlamaConfig) -> jax.Array:
+    return params["norm"] + 1.0 if c.norm_plus_one else params["norm"]
+
+
 def _attn_mlp(
     x: jax.Array,  # [B, T, D]
     layer: dict,  # one layer's params (unstacked)
@@ -200,7 +250,14 @@ def _attn_mlp(
 
     c = config
     B, T, D = x.shape
-    h = rms_norm(x, layer["ln1"], c.norm_eps)
+    norm_w = (lambda w: w + 1.0) if c.norm_plus_one else (lambda w: w)
+    if c.hidden_act == "silu":
+        act = jax.nn.silu
+    elif c.hidden_act == "gelu_tanh":
+        act = partial(jax.nn.gelu, approximate=True)
+    else:  # fail at trace time, not silently compute the wrong function
+        raise ValueError(f"unsupported hidden_act {c.hidden_act!r} (silu|gelu_tanh)")
+    h = rms_norm(x, norm_w(layer["ln1"]), c.norm_eps)
     q = mm(h, layer["wq"])
     k = mm(h, layer["wk"])
     v = mm(h, layer["wv"])
@@ -215,8 +272,8 @@ def _attn_mlp(
     k = apply_rope(k, positions, c.rope_theta)
     attn = attn_fn(q, k, v)
     x = x + mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
-    h = rms_norm(x, layer["ln2"], c.norm_eps)
-    x = x + mm(jax.nn.silu(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
+    h = rms_norm(x, norm_w(layer["ln2"]), c.norm_eps)
+    x = x + mm(act(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
     return x, k, v
 
 
@@ -247,10 +304,10 @@ def forward(
         )
         return out, None
 
-    x = params["embed"][tokens].astype(c.dtype)
+    x = _embed(params, tokens, c)
 
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = rms_norm(x, params["norm"], c.norm_eps)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     return (x @ head.astype(c.dtype)).astype(jnp.float32)
 
@@ -287,7 +344,7 @@ def prefill_batch(
     B, T = tokens.shape
     ar = jnp.arange(T)
     positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)  # [B,T]
-    x = params["embed"][tokens].astype(c.dtype)  # [B, T, D]
+    x = _embed(params, tokens, c)  # [B, T, D]
 
     def body(carry, scanned):
         x = carry
@@ -306,7 +363,7 @@ def prefill_batch(
         return out, (k_cache_l, v_cache_l)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["norm"], c.norm_eps)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]  # [B, D]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
@@ -347,7 +404,7 @@ def prefill_continue(
     B, T = tokens.shape
     ar = jnp.arange(T)
     positions = jnp.where(ar[None, :] < lengths[:, None], starts[:, None] + ar[None, :], -1)
-    x = params["embed"][tokens].astype(c.dtype)
+    x = _embed(params, tokens, c)
     C = cache["k"].shape[2]
     # scatter indices for the suffix writes; clamped so bucket padding can
     # never write past the row (clamped garbage lands at C-1, which is
@@ -369,7 +426,7 @@ def prefill_continue(
         return out, attn.updated
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x, params["norm"], c.norm_eps)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
@@ -404,7 +461,7 @@ def prefill_paged_batch(
     B, T = tokens.shape
     ar = jnp.arange(T)
     positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)
-    x = params["embed"][tokens].astype(c.dtype)
+    x = _embed(params, tokens, c)
 
     def body(carry, scanned):
         x = carry
@@ -422,7 +479,7 @@ def prefill_paged_batch(
         return out, (k_pages_l, v_pages_l)
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
-    x = rms_norm(x, params["norm"], c.norm_eps)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
@@ -463,7 +520,7 @@ def prefill_paged_continue(
     B, T = tokens.shape
     ar = jnp.arange(T)
     positions = jnp.where(ar[None, :] < lengths[:, None], starts[:, None] + ar[None, :], -1)
-    x = params["embed"][tokens].astype(c.dtype)
+    x = _embed(params, tokens, c)
     max_pages = block_tables.shape[1]
 
     def body(carry, scanned):
@@ -486,7 +543,7 @@ def prefill_paged_continue(
         return out, attn.updated
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
-    x = rms_norm(x, params["norm"], c.norm_eps)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
@@ -509,7 +566,7 @@ def decode_step_paged(
 
     c = config
     positions = seq_lens[:, None]
-    x = params["embed"][tokens][:, None].astype(c.dtype)
+    x = _embed(params, tokens[:, None], c)
     tp_size = 1
     if mesh is not None and "tp" in mesh.axis_names:
         tp_size = dict(zip(mesh.axis_names, mesh.devices.shape))["tp"]
@@ -543,7 +600,7 @@ def decode_step_paged(
         return out, attn.updated
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
-    x = rms_norm(x[:, 0], params["norm"], c.norm_eps)
+    x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
     return {"k": new_k, "v": new_v}, logits
@@ -565,7 +622,7 @@ def decode_step(
     c = config
     W = tokens.shape[0]
     positions = seq_lens[:, None]  # the new token's position, [W, 1]
-    x = params["embed"][tokens][:, None].astype(c.dtype)  # [W, 1, D]
+    x = _embed(params, tokens[:, None], c)  # [W, 1, D]
 
     def body(carry, scanned):
         x = carry
@@ -582,7 +639,7 @@ def decode_step(
         return out, attn.updated
 
     x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
-    x = rms_norm(x[:, 0], params["norm"], c.norm_eps)  # [S, D]
+    x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)  # [S, D]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
     return {"k": new_k, "v": new_v}, logits

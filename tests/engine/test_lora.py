@@ -110,3 +110,54 @@ def test_lora_save_load_roundtrip(tmp_path):
         jax.tree_util.tree_leaves(lora), jax.tree_util.tree_leaves(restored)
     ):
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_loader_merges_adapter_host_side(tmp_path):
+    """load_safetensors_dir(lora_path=...) merges pre-placement (and
+    composes with int8): the served weights must equal an explicit
+    merge_lora of the separately loaded base."""
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    from agentcontrolplane_tpu.engine.weights import load_safetensors_dir
+    from agentcontrolplane_tpu.train import save_lora
+
+    hf_config = HFConfig(
+        vocab_size=128, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=64,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    ckpt = tmp_path / "ckpt"
+    LlamaForCausalLM(hf_config).save_pretrained(str(ckpt), safe_serialization=True)
+
+    base, config = load_safetensors_dir(str(ckpt))
+    lora_cfg = LoraConfig(rank=4, alpha=8.0, targets=("wq", "w2"))
+    lora = init_lora(config, lora_cfg, jax.random.key(1))
+    lora["layers"]["wq"]["b"] = (
+        jax.random.normal(jax.random.key(2), lora["layers"]["wq"]["b"].shape) * 0.05
+    )
+    save_lora(str(tmp_path / "adapter"), lora, lora_cfg)
+
+    merged_by_loader, _ = load_safetensors_dir(str(ckpt), lora_path=str(tmp_path / "adapter"))
+    expected = merge_lora(base, lora, lora_cfg, compute_dtype=jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(merged_by_loader["layers"]["wq"], dtype=np.float32),
+        np.asarray(expected["layers"]["wq"], dtype=np.float32),
+        rtol=2e-2, atol=2e-2,  # loader merges in f32 then casts to model dtype
+    )
+    # int8 composes: merged-then-quantized weights serve
+    q_params, q_config = load_safetensors_dir(
+        str(ckpt), lora_path=str(tmp_path / "adapter"), quantize="int8"
+    )
+    from agentcontrolplane_tpu.ops.quant import QuantizedTensor
+
+    assert isinstance(q_params["layers"]["wq"], QuantizedTensor)
+    toks = jnp.asarray(np.random.default_rng(0).integers(1, 128, (1, 8)), dtype=jnp.int32)
+    from agentcontrolplane_tpu.models.llama import forward as fwd
+
+    a = np.asarray(fwd(expected, toks, q_config))
+    b = np.asarray(fwd(q_params, toks, q_config))
+    assert np.mean(np.argmax(a, -1) == np.argmax(b, -1)) > 0.8
