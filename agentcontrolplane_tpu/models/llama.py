@@ -27,6 +27,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops.attention import (
+    blocked_causal_attention,
     causal_attention,
     continue_attention,
     decode_attention,
@@ -354,7 +355,7 @@ def prefill_batch(
             layer,
             c,
             positions,
-            lambda q, k, v: causal_attention(q, k, v, positions),
+            lambda q, k, v: blocked_causal_attention(q, k, v, positions),
         )
         # scatter each row's [T] K/V into its slot (padded tail is garbage
         # but never read: decode masks by seq_len)
@@ -468,7 +469,7 @@ def prefill_paged_batch(
         layer, k_pages_l, v_pages_l = scanned
         out, k, v = _attn_mlp(
             x, layer, c, positions,
-            lambda q, k, v: causal_attention(q, k, v, positions),
+            lambda q, k, v: blocked_causal_attention(q, k, v, positions),
         )
         P = k_pages_l.shape[1]
         # [B, T, H, d] -> [B * T//P, P, H, d] blocks matched to flat page ids

@@ -78,6 +78,93 @@ def decode_attention(
     return jnp.einsum("shc,schd->shd", probs, v)
 
 
+def online_softmax_step(qf, kf, vf, mask, m, l, acc, scale):
+    """One flash-style accumulation step over a K/V block: given f32 query
+    [B,Tq,H,d], block keys/values [B,Tk,H,d] (kv heads already repeated),
+    and a [B,1|H,Tq,Tk] mask, fold the block into the running (m, l, acc).
+    The isfinite guards keep fully-masked-so-far rows at exactly zero; a
+    previously-contaminated row (finite NEG_INF) is erased by the
+    correction factor underflowing to 0 once a real key appears."""
+    logits = jnp.einsum("bthd,bshd->bhts", qf, kf) * scale
+    logits = jnp.where(mask, logits, NEG_INF)
+    m_blk = jnp.max(logits, axis=-1)
+    m_new = jnp.maximum(m, m_blk)
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(logits - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(m_new)[..., None], p, 0.0)
+    correction = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
+    l = l * correction + jnp.sum(p, axis=-1)
+    acc = acc * correction[..., None] + jnp.einsum("bhts,bshd->bhtd", p, vf)
+    return m_new, l, acc
+
+
+def online_softmax_finalize(l, acc, dtype):
+    """(l, acc) -> [B, T, H, d] output in ``dtype``."""
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return jnp.transpose(out, (0, 2, 1, 3)).astype(dtype)
+
+
+def blocked_causal_attention(
+    q: jax.Array,  # [B, T, H, d]
+    k: jax.Array,  # [B, T, H_kv, d]
+    v: jax.Array,
+    positions: jax.Array | None = None,  # [B, T] (-1 = padding)
+    block_size: int = 512,
+) -> jax.Array:
+    """Flash-style blocked causal attention (single device): query blocks
+    attend only their causal KEY PREFIX (q-block i scans key blocks 0..i
+    with an online-softmax accumulator), so peak logits memory is
+    [B, H, block, block]-ish instead of [B, H, T, T] AND roughly half the
+    fully-masked block-pair FLOPs of a dense T x T computation are never
+    issued. Exact vs :func:`causal_attention` up to f32 accumulation order.
+    Requires right-padded rows (valid positions equal their indices — true
+    for prefill); falls back to the dense path when T doesn't split into
+    blocks (buckets are powers of two, so T > block implies divisibility)."""
+    B, T, H, d = q.shape
+    if T <= block_size or T % block_size:
+        return causal_attention(q, k, v, positions)
+    if positions is None:
+        positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
+    nb = T // block_size
+    n_rep = H // k.shape[-2]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+
+    def kv_prefix(arrs, qi):
+        return [a[:, : (qi + 1) * block_size] for a in arrs]
+
+    outs = []
+    for qi in range(nb):  # unrolled: nb is small (T/512), shapes static per qi
+        sl = slice(qi * block_size, (qi + 1) * block_size)
+        qf = q[:, sl].astype(jnp.float32)
+        q_pos = positions[:, sl]
+        kp, vp, kvp = kv_prefix((k, v, positions), qi)
+        nkb = qi + 1
+        k_blocks = jnp.moveaxis(kp.reshape(B, nkb, block_size, *k.shape[2:]), 1, 0)
+        v_blocks = jnp.moveaxis(vp.reshape(B, nkb, block_size, *v.shape[2:]), 1, 0)
+        pos_blocks = jnp.moveaxis(kvp.reshape(B, nkb, block_size), 1, 0)
+
+        m = jnp.full((B, H, block_size), -jnp.inf, dtype=jnp.float32)
+        l = jnp.zeros((B, H, block_size), dtype=jnp.float32)
+        acc = jnp.zeros((B, H, block_size, d), dtype=jnp.float32)
+
+        def step(carry, blk, qf=qf, q_pos=q_pos):
+            m, l, acc = carry
+            kb, vb, kv_pos = blk
+            kf = repeat_kv(kb, n_rep).astype(jnp.float32)
+            vf = repeat_kv(vb, n_rep).astype(jnp.float32)
+            mask = (
+                (kv_pos[:, None, None, :] <= q_pos[:, None, :, None])
+                & (q_pos[:, None, :, None] >= 0)
+                & (kv_pos[:, None, None, :] >= 0)
+            )
+            m, l, acc = online_softmax_step(qf, kf, vf, mask, m, l, acc, scale)
+            return (m, l, acc), None
+
+        (m, l, acc), _ = jax.lax.scan(step, (m, l, acc), (k_blocks, v_blocks, pos_blocks))
+        outs.append(online_softmax_finalize(l, acc, q.dtype))
+    return jnp.concatenate(outs, axis=1)
+
+
 def continue_attention(
     q: jax.Array,  # [B, T, H, d] — suffix queries
     k_rows: jax.Array,  # [B, C, H_kv, d] — the slots' full cache rows

@@ -43,37 +43,28 @@ def _ring_attention_local(
     acc = jnp.zeros((B, H, Tq, d), dtype=jnp.float32)
     perm = [(i, (i + 1) % sp) for i in range(sp)]
 
+    from ..ops.attention import online_softmax_finalize, online_softmax_step
+
     def step(carry, _):
         k, v, kv_pos, m, l, acc = carry
         kf = repeat_kv(k, n_rep).astype(jnp.float32)
         vf = repeat_kv(v, n_rep).astype(jnp.float32)
-        logits = jnp.einsum("bthd,bshd->bhts", qf, kf) * scale  # [B,H,Tq,Tk]
         mask = (
             (kv_pos[:, None, None, :] <= q_pos[:, None, :, None])
             & (q_pos[:, None, :, None] >= 0)
             & (kv_pos[:, None, None, :] >= 0)
         )
-        logits = jnp.where(mask, logits, NEG_INF)
-        m_blk = jnp.max(logits, axis=-1)  # [B,H,Tq]
-        m_new = jnp.maximum(m, m_blk)
-        # guard fully-masked rows (exp(-inf - -inf))
-        m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
-        p = jnp.exp(logits - m_safe[..., None])
-        p = jnp.where(jnp.isfinite(m_new)[..., None], p, 0.0)
-        correction = jnp.where(jnp.isfinite(m), jnp.exp(m - m_safe), 0.0)
-        l = l * correction + jnp.sum(p, axis=-1)
-        acc = acc * correction[..., None] + jnp.einsum("bhts,bshd->bhtd", p, vf)
+        m, l, acc = online_softmax_step(qf, kf, vf, mask, m, l, acc, scale)
         # rotate k/v/kv_pos one hop around the ring
         k = jax.lax.ppermute(k, axis_name, perm)
         v = jax.lax.ppermute(v, axis_name, perm)
         kv_pos = jax.lax.ppermute(kv_pos, axis_name, perm)
-        return (k, v, kv_pos, m_new, l, acc), None
+        return (k, v, kv_pos, m, l, acc), None
 
     (k, v, kv_pos, m, l, acc), _ = jax.lax.scan(
         step, (k, v, kv_pos, m, l, acc), None, length=sp
     )
-    out = acc / jnp.maximum(l, 1e-30)[..., None]  # [B,H,Tq,d]
-    return jnp.transpose(out, (0, 2, 1, 3)).astype(q.dtype)  # [B,Tq,H,d]
+    return online_softmax_finalize(l, acc, q.dtype)  # [B,Tq,H,d]
 
 
 def ring_causal_attention(

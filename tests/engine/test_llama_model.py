@@ -184,3 +184,39 @@ def test_sampling_modes():
             top_p=jnp.asarray([1.0, 1.0]),
         )
         assert out[0].item() in (1, 2)
+
+
+def test_blocked_causal_attention_matches_dense():
+    """The flash-style blocked prefill attention is exact vs the dense path
+    (incl. padded rows and GQA)."""
+    import numpy as np
+
+    from agentcontrolplane_tpu.ops.attention import (
+        blocked_causal_attention,
+        causal_attention,
+    )
+
+    rng = np.random.default_rng(0)
+    B, T, H, Hkv, d = 2, 256, 4, 2, 16
+    q = jnp.asarray(rng.normal(size=(B, T, H, d)), dtype=jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, T, Hkv, d)), dtype=jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, T, Hkv, d)), dtype=jnp.float32)
+    lengths = np.asarray([256, 100])
+    ar = np.arange(T)
+    positions = jnp.asarray(
+        np.where(ar[None] < lengths[:, None], ar[None], -1), dtype=jnp.int32
+    )
+    dense = causal_attention(q, k, v, positions)
+    blocked = blocked_causal_attention(q, k, v, positions, block_size=64)
+    valid = np.asarray(positions) >= 0
+    np.testing.assert_allclose(
+        np.asarray(blocked)[valid], np.asarray(dense)[valid], rtol=2e-5, atol=2e-5
+    )
+    # non-divisible T falls back to dense (still exact)
+    odd = blocked_causal_attention(q[:, :200], k[:, :200], v[:, :200],
+                                   positions[:, :200], block_size=64)
+    np.testing.assert_allclose(
+        np.asarray(odd)[valid[:, :200]],
+        np.asarray(causal_attention(q[:, :200], k[:, :200], v[:, :200], positions[:, :200]))[valid[:, :200]],
+        rtol=2e-5, atol=2e-5,
+    )
