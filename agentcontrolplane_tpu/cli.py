@@ -270,6 +270,107 @@ def cmd_task_show(args) -> int:
     return 0
 
 
+def cmd_train(args) -> int:
+    """LoRA fine-tuning in one command: JSONL dataset -> adapter directory
+    servable via ``acp-tpu run --tpu-lora``. Lines are either
+    {"text": "..."} or {"messages": [{role, content}, ...]} (rendered with
+    the same chat template the engine serves)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from .api.resources import Message
+    from .engine.tokenizer import ByteTokenizer, HFTokenizer, render_prompt
+    from .engine.weights import load_safetensors_dir
+    from .parallel.mesh import make_mesh
+    from .train import LoraConfig, LoraTrainer, save_lora
+    from .utils import setup_logging
+
+    setup_logging(os.environ.get("ACP_TPU_LOG_LEVEL", "INFO"))
+
+    params, config = load_safetensors_dir(args.checkpoint)
+    tok_path = os.path.join(args.checkpoint, "tokenizer.json")
+    tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
+
+    from .engine.tokenizer import EH, SH
+
+    generation_tail = f"{SH}assistant{EH}\n\n"
+    rows: list[list[int]] = []
+    with open(args.data) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+                if "messages" in doc:
+                    text = render_prompt(
+                        [Message(**m) for m in doc["messages"]], tools=[]
+                    )
+                    # the renderer ends with an OPEN assistant header to
+                    # prompt generation; training on it would teach the
+                    # model to start a new turn after every stop token
+                    text = text.removesuffix(generation_tail)
+                else:
+                    text = doc["text"]
+            except (KeyError, ValueError, TypeError) as e:
+                print(f"error: {args.data}:{lineno}: {e}", file=sys.stderr)
+                return 2
+            ids = tokenizer.encode(text)[: args.seq_len]
+            if len(ids) >= 8:
+                rows.append(ids)
+    if not rows:
+        print("error: dataset is empty", file=sys.stderr)
+        return 2
+    print(f"dataset: {len(rows)} examples; model dim={config.dim} L={config.n_layers}")
+
+    devices = jax.devices()
+    tp = args.tp
+    if len(devices) % tp:
+        print(f"error: --tp {tp} does not divide {len(devices)} devices", file=sys.stderr)
+        return 2
+    # largest dp that divides the batch (a silent 1-chip fallback would
+    # waste the host; an indivisible batch is likelier operator error)
+    max_dp = len(devices) // tp
+    dp = max(d for d in range(1, max_dp + 1) if args.batch % d == 0)
+    if dp < max_dp:
+        print(f"note: batch {args.batch} limits dp to {dp} of {max_dp} possible")
+    mesh = make_mesh({"dp": dp, "tp": tp}, devices=devices[: dp * tp])
+    lora_cfg = LoraConfig(
+        rank=args.rank, alpha=args.alpha, targets=tuple(args.targets.split(","))
+    )
+    trainer = LoraTrainer(
+        config=config, lora=lora_cfg, mesh=mesh, optimizer=optax.adamw(args.lr)
+    )
+    base = jax.device_put(params, trainer.base_sharding)
+    lora_params, opt_state = trainer.init(jax.random.key(args.seed))
+
+    rng = np.random.default_rng(args.seed)
+    pad = 0
+    for step in range(args.steps):
+        idx = rng.integers(0, len(rows), size=args.batch)
+        batch = np.full((args.batch, args.seq_len), pad, dtype=np.int32)
+        mask = np.zeros_like(batch)
+        for j, i in enumerate(idx):
+            ids = rows[int(i)]
+            batch[j, : len(ids)] = ids
+            # the last real token's shifted target would be padding — mask
+            # it out or every short example teaches "emit pad after text"
+            mask[j, : len(ids) - 1] = 1
+        tokens = jax.device_put(jnp.asarray(batch), trainer.batch_sharding)
+        loss_mask = jax.device_put(jnp.asarray(mask), trainer.batch_sharding)
+        lora_params, opt_state, loss = trainer.train_step(
+            lora_params, opt_state, base, tokens, loss_mask
+        )
+        if step % max(1, args.steps // 20) == 0 or step == args.steps - 1:
+            print(f"step {step:>5}  loss {float(loss):.4f}", flush=True)
+    save_lora(args.out, lora_params, lora_cfg, step=args.steps)
+    print(f"adapter saved to {args.out}; serve with: acp-tpu run "
+          f"--tpu-checkpoint {args.checkpoint} --tpu-lora {args.out}")
+    return 0
+
+
 def cmd_chat(args) -> int:
     """Interactive REPL against the OpenAI-compatible front door (SSE
     streaming) — the quickest way to poke the TPU engine by hand."""
@@ -427,6 +528,21 @@ def build_parser() -> argparse.ArgumentParser:
 
     eng = sub.add_parser("engine", help="TPU engine status")
     eng.set_defaults(fn=cmd_engine)
+
+    tr = sub.add_parser("train", help="LoRA fine-tune a checkpoint on a JSONL dataset")
+    tr.add_argument("--checkpoint", required=True, help="HF checkpoint dir")
+    tr.add_argument("--data", required=True, help="JSONL: {text} or {messages} lines")
+    tr.add_argument("--out", required=True, help="adapter output dir")
+    tr.add_argument("--steps", type=int, default=100)
+    tr.add_argument("--batch", type=int, default=4)
+    tr.add_argument("--seq-len", type=int, default=512)
+    tr.add_argument("--rank", type=int, default=8)
+    tr.add_argument("--alpha", type=float, default=16.0)
+    tr.add_argument("--targets", default="wq,wk,wv,wo")
+    tr.add_argument("--lr", type=float, default=1e-4)
+    tr.add_argument("--tp", type=int, default=1, help="shard the frozen base over tp chips")
+    tr.add_argument("--seed", type=int, default=0)
+    tr.set_defaults(fn=cmd_train)
 
     chat = sub.add_parser("chat", help="interactive chat with the TPU engine (SSE)")
     chat.add_argument("--system", default="")

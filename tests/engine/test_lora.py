@@ -161,3 +161,75 @@ def test_loader_merges_adapter_host_side(tmp_path):
     a = np.asarray(fwd(expected, toks, q_config))
     b = np.asarray(fwd(q_params, toks, q_config))
     assert np.mean(np.argmax(a, -1) == np.argmax(b, -1)) > 0.8
+
+
+def _tiny_hf_checkpoint(path, vocab=320):
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    hf_config = HFConfig(
+        vocab_size=vocab, hidden_size=64, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=2, intermediate_size=128,
+        rms_norm_eps=1e-5, rope_theta=10000.0, max_position_embeddings=128,
+        tie_word_embeddings=False, attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    LlamaForCausalLM(hf_config).save_pretrained(str(path), safe_serialization=True)
+
+
+def test_cli_train_produces_servable_adapter(tmp_path, capsys):
+    """acp-tpu train: JSONL (text + messages rows) -> adapter dir; printed
+    loss decreases and the adapter merges through the serving loader.
+    vocab 320 covers the ByteTokenizer's special ids so the rendered
+    messages rows train on real tokens."""
+    import json as _json
+    import re
+
+    from agentcontrolplane_tpu.cli import main
+    from agentcontrolplane_tpu.engine.weights import load_safetensors_dir
+
+    ckpt = tmp_path / "ckpt"
+    _tiny_hf_checkpoint(ckpt, vocab=320)
+    data = tmp_path / "data.jsonl"
+    lines = [{"text": "agents call tools and join results. " * 2}] * 8 + [
+        {"messages": [{"role": "user", "content": "hello"},
+                      {"role": "assistant", "content": "hi there"}]}
+    ] * 4
+    data.write_text("\n".join(_json.dumps(d) for d in lines))
+
+    out = tmp_path / "adapter"
+    rc = main([
+        "train", "--checkpoint", str(ckpt), "--data", str(data),
+        "--out", str(out), "--steps", "16", "--batch", "2", "--seq-len", "64",
+        "--rank", "4", "--lr", "5e-2",
+    ])
+    assert rc == 0
+    assert (out / "lora.json").exists()
+    losses = [
+        float(m.group(1))
+        for m in re.finditer(r"loss (\d+\.\d+)", capsys.readouterr().out)
+    ]
+    assert len(losses) >= 2 and losses[-1] < losses[0], losses
+
+    base, _ = load_safetensors_dir(str(ckpt))
+    merged, _ = load_safetensors_dir(str(ckpt), lora_path=str(out))
+    assert not np.allclose(
+        np.asarray(merged["layers"]["wq"], dtype=np.float32),
+        np.asarray(base["layers"]["wq"], dtype=np.float32),
+    )
+
+
+def test_cli_train_rejects_bad_dataset_line(tmp_path, capsys):
+    from agentcontrolplane_tpu.cli import main
+
+    ckpt = tmp_path / "ckpt"
+    _tiny_hf_checkpoint(ckpt)
+    data = tmp_path / "bad.jsonl"
+    data.write_text('{"text": "fine"}\n{"prompt": "wrong key"}\n')
+    rc = main([
+        "train", "--checkpoint", str(ckpt), "--data", str(data),
+        "--out", str(tmp_path / "a"), "--steps", "1",
+    ])
+    assert rc == 2
+    assert ":2:" in capsys.readouterr().err  # points at the offending line
