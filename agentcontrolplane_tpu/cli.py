@@ -281,7 +281,7 @@ def cmd_train(args) -> int:
     import optax
 
     from .api.resources import Message
-    from .engine.tokenizer import ByteTokenizer, HFTokenizer, render_prompt
+    from .engine.tokenizer import ByteTokenizer, HFTokenizer, render_turns
     from .engine.weights import load_safetensors_dir
     from .parallel.mesh import make_mesh
     from .train import LoraConfig, LoraTrainer, save_lora
@@ -292,11 +292,28 @@ def cmd_train(args) -> int:
     params, config = load_safetensors_dir(args.checkpoint)
     tok_path = os.path.join(args.checkpoint, "tokenizer.json")
     tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
+    if tokenizer.vocab_size > config.vocab_size:
+        # out-of-range ids would be silently clamped under jit — the
+        # adapter would train on corrupted embeddings with no error
+        print(
+            f"error: tokenizer vocab {tokenizer.vocab_size} exceeds model "
+            f"vocab {config.vocab_size}",
+            file=sys.stderr,
+        )
+        return 2
 
-    from .engine.tokenizer import EH, SH
+    from .train.lora import LORA_TARGETS
 
-    generation_tail = f"{SH}assistant{EH}\n\n"
-    rows: list[list[int]] = []
+    targets = tuple(t.strip() for t in args.targets.split(",") if t.strip())
+    bad = [t for t in targets if t not in LORA_TARGETS]
+    if not targets or bad:
+        print(f"error: bad --targets {bad or '(empty)'}; valid: {LORA_TARGETS}", file=sys.stderr)
+        return 2
+
+    # rows = (token ids, per-token supervision flags): a position's loss is
+    # counted when its TARGET (next token) is supervised
+    rows: list[tuple[list[int], list[int]]] = []
+    skipped = 0
     with open(args.data) as f:
         for lineno, line in enumerate(f, 1):
             line = line.strip()
@@ -305,24 +322,38 @@ def cmd_train(args) -> int:
             try:
                 doc = json.loads(line)
                 if "messages" in doc:
-                    text = render_prompt(
+                    # per-turn segments (no open generation header); with
+                    # --mask-prompt only assistant turns are supervised —
+                    # the model learns replies, not to parrot prompts
+                    ids: list[int] = []
+                    sup: list[int] = []
+                    for role, seg in render_turns(
                         [Message(**m) for m in doc["messages"]], tools=[]
-                    )
-                    # the renderer ends with an OPEN assistant header to
-                    # prompt generation; training on it would teach the
-                    # model to start a new turn after every stop token
-                    text = text.removesuffix(generation_tail)
+                    ):
+                        seg_ids = tokenizer.encode(seg)
+                        on = 1 if (role == "assistant" or not args.mask_prompt) else 0
+                        ids.extend(seg_ids)
+                        sup.extend([on] * len(seg_ids))
                 else:
-                    text = doc["text"]
+                    ids = tokenizer.encode(doc["text"])
+                    sup = [1] * len(ids)
             except (KeyError, ValueError, TypeError) as e:
                 print(f"error: {args.data}:{lineno}: {e}", file=sys.stderr)
                 return 2
-            ids = tokenizer.encode(text)[: args.seq_len]
-            if len(ids) >= 8:
-                rows.append(ids)
+            ids, sup = ids[: args.seq_len], sup[: args.seq_len]
+            if len(ids) >= 8 and any(sup):
+                rows.append((ids, sup))
+            else:
+                skipped += 1
     if not rows:
-        print("error: dataset is empty", file=sys.stderr)
+        print(
+            f"error: no usable examples ({skipped} skipped: shorter than 8 "
+            "tokens or no supervised tokens within --seq-len)",
+            file=sys.stderr,
+        )
         return 2
+    if skipped:
+        print(f"note: skipped {skipped} examples (too short / nothing supervised)")
     print(f"dataset: {len(rows)} examples; model dim={config.dim} L={config.n_layers}")
 
     devices = jax.devices()
@@ -337,9 +368,7 @@ def cmd_train(args) -> int:
     if dp < max_dp:
         print(f"note: batch {args.batch} limits dp to {dp} of {max_dp} possible")
     mesh = make_mesh({"dp": dp, "tp": tp}, devices=devices[: dp * tp])
-    lora_cfg = LoraConfig(
-        rank=args.rank, alpha=args.alpha, targets=tuple(args.targets.split(","))
-    )
+    lora_cfg = LoraConfig(rank=args.rank, alpha=args.alpha, targets=targets)
     trainer = LoraTrainer(
         config=config, lora=lora_cfg, mesh=mesh, optimizer=optax.adamw(args.lr)
     )
@@ -353,11 +382,12 @@ def cmd_train(args) -> int:
         batch = np.full((args.batch, args.seq_len), pad, dtype=np.int32)
         mask = np.zeros_like(batch)
         for j, i in enumerate(idx):
-            ids = rows[int(i)]
+            ids, sup = rows[int(i)]
             batch[j, : len(ids)] = ids
-            # the last real token's shifted target would be padding — mask
-            # it out or every short example teaches "emit pad after text"
-            mask[j, : len(ids) - 1] = 1
+            # position t predicts token t+1: supervise t iff target t+1 is
+            # supervised (this also drops the last real token, whose
+            # shifted target would be padding)
+            mask[j, : len(ids) - 1] = sup[1:]
         tokens = jax.device_put(jnp.asarray(batch), trainer.batch_sharding)
         loss_mask = jax.device_put(jnp.asarray(mask), trainer.batch_sharding)
         lora_params, opt_state, loss = trainer.train_step(
@@ -541,6 +571,12 @@ def build_parser() -> argparse.ArgumentParser:
     tr.add_argument("--targets", default="wq,wk,wv,wo")
     tr.add_argument("--lr", type=float, default=1e-4)
     tr.add_argument("--tp", type=int, default=1, help="shard the frozen base over tp chips")
+    tr.add_argument(
+        "--mask-prompt",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="supervise only assistant turns of {messages} rows (SFT masking)",
+    )
     tr.add_argument("--seed", type=int, default=0)
     tr.set_defaults(fn=cmd_train)
 

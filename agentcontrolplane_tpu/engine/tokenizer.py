@@ -195,13 +195,19 @@ def _turn(role: str, content: str) -> str:
     return f"{SH}{ROLE_HEADER[role]}{EH}\n\n{content}{EOT}"
 
 
-def render_prompt(messages: Sequence[Message], tools: Sequence[Tool]) -> str:
-    """Context window -> Llama-3 chat prompt ending at an open assistant turn."""
-    parts = [BOT]
+def render_turns(
+    messages: Sequence[Message], tools: Sequence[Tool]
+) -> list[tuple[str, str]]:
+    """Context window -> [(role, rendered_segment), ...] — the building
+    blocks :func:`render_prompt` concatenates. Exposed separately so
+    training can mask loss to assistant segments (every segment starts at
+    a special-token boundary, so per-segment tokenization concatenates to
+    the whole-prompt tokenization)."""
+    parts: list[tuple[str, str]] = [("bot", BOT)]
     rendered_system = False
     for m in messages:
         if m.role == "system" and not rendered_system:
-            parts.append(_turn("system", render_system(m.content, tools)))
+            parts.append(("system", _turn("system", render_system(m.content, tools))))
             rendered_system = True
             continue
         if m.role == "assistant" and m.tool_calls:
@@ -213,10 +219,16 @@ def render_prompt(messages: Sequence[Message], tools: Sequence[Tool]) -> str:
                 for tc in m.tool_calls
             ]
             body = "\n".join(json.dumps(c) for c in calls)
-            parts.append(_turn("assistant", body))
+            parts.append(("assistant", _turn("assistant", body)))
             continue
-        parts.append(_turn(m.role, m.content))
+        parts.append((m.role, _turn(m.role, m.content)))
     if not rendered_system and tools:
-        parts.insert(1, _turn("system", render_system("", tools)))
+        parts.insert(1, ("system", _turn("system", render_system("", tools))))
+    return parts
+
+
+def render_prompt(messages: Sequence[Message], tools: Sequence[Tool]) -> str:
+    """Context window -> Llama-3 chat prompt ending at an open assistant turn."""
+    parts = [t for _, t in render_turns(messages, tools)]
     parts.append(f"{SH}assistant{EH}\n\n")
     return "".join(parts)

@@ -233,3 +233,53 @@ def test_cli_train_rejects_bad_dataset_line(tmp_path, capsys):
     ])
     assert rc == 2
     assert ":2:" in capsys.readouterr().err  # points at the offending line
+
+
+def test_cli_train_mask_prompt_supervises_assistant_only(tmp_path, capsys):
+    """--mask-prompt (default): with a dataset whose user turns are random
+    noise but assistant turns are constant, training still converges on the
+    assistant span (the supervision mask covers only assistant targets).
+    Also: render_turns segments concatenate to the full render."""
+    import json as _json
+
+    from agentcontrolplane_tpu.api.resources import Message
+    from agentcontrolplane_tpu.cli import main
+    from agentcontrolplane_tpu.engine.tokenizer import (
+        ByteTokenizer, render_prompt, render_turns,
+    )
+
+    msgs = [
+        Message(role="system", content="sys"),
+        Message(role="user", content="u1"),
+        Message(role="assistant", content="a1"),
+    ]
+    tok = ByteTokenizer()
+    joined = "".join(seg for _, seg in render_turns(msgs, []))
+    assert render_prompt(msgs, []).startswith(joined)
+    flat = []
+    for _, seg in render_turns(msgs, []):
+        flat.extend(tok.encode(seg))
+    assert flat == tok.encode(joined)  # per-segment == whole-string tokens
+
+    ckpt = tmp_path / "ckpt"
+    _tiny_hf_checkpoint(ckpt, vocab=320)
+    data = tmp_path / "d.jsonl"
+    rows = [
+        {"messages": [{"role": "user", "content": f"noise {i} {i*7}"},
+                      {"role": "assistant", "content": "the answer is tools"}]}
+        for i in range(8)
+    ]
+    data.write_text("\n".join(_json.dumps(r) for r in rows))
+    rc = main([
+        "train", "--checkpoint", str(ckpt), "--data", str(data),
+        "--out", str(tmp_path / "a"), "--steps", "16", "--batch", "2",
+        "--seq-len", "64", "--rank", "4", "--lr", "5e-2",
+    ])
+    assert rc == 0
+    import re
+
+    losses = [
+        float(m.group(1))
+        for m in re.finditer(r"loss (\d+\.\d+)", capsys.readouterr().out)
+    ]
+    assert losses[-1] < losses[0], losses

@@ -156,4 +156,3 @@ async def test_engine_status_endpoint_unconfigured():
     async with RestHarness() as h:
         resp = await h.http.get(f"{h.base}/v1/engine")
         assert (await resp.json()) == {"configured": False}
-
