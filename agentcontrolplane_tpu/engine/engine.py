@@ -290,6 +290,9 @@ class Engine:
         self._jit_extract_prefix: dict[int, Any] = {}
         self._prefix_hits = 0
         self._prefix_misses = 0
+        # continuation batch sizes actually dispatched (prewarm coverage
+        # is verified against this, not assumed from submit timing)
+        self._cont_batch_sizes: set[int] = set()
         self._token_table = None
         self._min_close = None
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
@@ -629,23 +632,44 @@ class Engine:
             for b in self.prefill_buckets:
                 sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
                 self.submit([1] * max(1, b - 1), sp, _prewarm=True).result(timeout=1800)
-            # phase d: the prefix-cache CONTINUATION program (B=1): a seed
-            # request then an extending one that hits it. These must go
-            # through the real cache path, so they are NOT _prewarm
-            # requests; their all-dummy entries and their exactly
-            # one-miss-one-hit stats are removed right after. (Batched
-            # continuation shapes B>1 stay cold — rare and bounded.)
+            # phase d: the prefix-cache CONTINUATION program: a seed request,
+            # then hitting bursts at every power-of-two batch size up to
+            # min(prefill_batch_max, max_slots) (distinct tails so a burst
+            # forms one conts chunk). These must go through the real cache
+            # path, so they are NOT _prewarm requests; their dummy entries
+            # (token-1/2 keys) and their exact hit/miss deltas are removed
+            # right after.
             if self._prefix_enabled:
                 seed_len = self.prefill_buckets[0] + 1
                 one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
                 self.submit([1] * seed_len, one).result(timeout=1800)
-                self.submit([1] * (seed_len + 8), one).result(timeout=1800)
+                d_hits = 0
+                b = 1
+                while b <= min(self.prefill_batch_max, self.max_slots):
+                    # burst formation depends on queue-drain timing: verify
+                    # the batch size actually DISPATCHED and retry, rather
+                    # than assuming the b submits landed in one group
+                    for attempt in range(5):
+                        futs = [
+                            self.submit([1] * seed_len + [2] * (8 + i), one)
+                            for i in range(b)
+                        ]
+                        for f in futs:
+                            f.result(timeout=1800)
+                        d_hits += b
+                        if b in self._cont_batch_sizes:
+                            break
+                    else:
+                        log.warning("prewarm: continuation batch B=%d never formed", b)
+                    b *= 2
                 with self._prefix_lock:
-                    for key in [k for k in self._prefix_cache if set(k) == {1}]:
+                    for key in [
+                        k for k in self._prefix_cache if set(k) <= {1, 2}
+                    ]:
                         old = self._prefix_cache.pop(key)
                         if "pages" in old:
                             self._allocator.free(old["pages"])
-                    self._prefix_hits = max(0, self._prefix_hits - 1)
+                    self._prefix_hits = max(0, self._prefix_hits - d_hits)
                     self._prefix_misses = max(0, self._prefix_misses - 1)
         log.info("engine prewarm complete (constrained=%s)", constrained)
 
@@ -1178,6 +1202,7 @@ class Engine:
                 fresh = pages[int(starts[i]) // P :]
                 page_ids[i, : len(fresh)] = fresh
             if starts_np is not None:
+                self._cont_batch_sizes.add(B)
                 block_tables = jnp.asarray(
                     self._block_tables[[slot for _, slot, _, _ in chunk]]
                 )
@@ -1190,6 +1215,7 @@ class Engine:
                     self.params, self.cache, *common, jnp.asarray(page_ids), *tail
                 )
         elif starts_np is not None:
+            self._cont_batch_sizes.add(B)
             cache, firsts, con_states = self._jit_prefill_continue(
                 self.params, self.cache, *common,
                 jnp.asarray(starts), jnp.asarray(slots), *tail,
