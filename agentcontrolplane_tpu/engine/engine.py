@@ -226,11 +226,6 @@ class Engine:
                 raise ValueError(
                     f"page_size {self.page_size} must divide max_ctx {self.max_ctx}"
                 )
-            if self.prefill_buckets[-1] < self.max_ctx:
-                raise ValueError(
-                    "paged layout requires prefill_buckets to reach max_ctx "
-                    "(chunked prefill is slot-layout only)"
-                )
             bad = [b for b in self.prefill_buckets if b % self.page_size]
             if bad:
                 raise ValueError(
@@ -293,6 +288,7 @@ class Engine:
         # continuation batch sizes actually dispatched (prewarm coverage
         # is verified against this, not assumed from submit timing)
         self._cont_batch_sizes: set[int] = set()
+        self._spill_batch_sizes: set[int] = set()
         self._token_table = None
         self._min_close = None
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
@@ -671,6 +667,27 @@ class Engine:
                             self._allocator.free(old["pages"])
                     self._prefix_hits = max(0, self._prefix_hits - d_hits)
                     self._prefix_misses = max(0, self._prefix_misses - 1)
+            # phase e: chunked-prefill SPILL shapes (configs whose largest
+            # bucket is below max_ctx): long prompts at every power-of-two
+            # batch size, with the same verified-dispatch retry as phase d
+            CH = self.prefill_buckets[-1]
+            if CH < self.max_ctx:
+                long_len = min(self.max_ctx - K - 2, CH * 2)
+                one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                b = 1
+                while b <= min(self.prefill_batch_max, self.max_slots):
+                    for _attempt in range(5):
+                        futs = [
+                            self.submit([1] * (long_len + i), one, _prewarm=True)
+                            for i in range(b)
+                        ]
+                        for f in futs:
+                            f.result(timeout=1800)
+                        if b in self._spill_batch_sizes:
+                            break
+                    else:
+                        log.warning("prewarm: spill batch B=%d never formed", b)
+                    b *= 2
         log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:
@@ -810,9 +827,9 @@ class Engine:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
             # per item: resolve the prefix-cache start (match + page
-            # assembly already happened in _collect_group), then — slot
-            # layout — spill any overlong remainder through intermediate
-            # continuation chunks (chunked prefill)
+            # assembly already happened in _collect_group), then spill any
+            # overlong remainder through intermediate continuation chunks
+            # (chunked prefill — both layouts)
             enriched: list[list] = []  # [item, start] (start mutated by spill)
             for item in group:
                 req, slot, _pages, match = item
@@ -829,8 +846,15 @@ class Engine:
                     self._prefix_misses += 1
                     REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", 1.0)
                 enriched.append([item, start])
-            if self.kv_layout == "slot":
-                self._spill_long_chunks(enriched)
+            if self.kv_layout == "paged":
+                # block tables must exist before spill chunks reference them
+                for item in group:
+                    _req, slot, pages, _m = item
+                    assert pages is not None
+                    self._slot_pages[slot] = pages
+                    self._block_tables[slot, :] = TRASH_PAGE
+                    self._block_tables[slot, : len(pages)] = pages
+            self._spill_long_chunks(enriched)
             plain = [e for e in enriched if e[1] == 0]  # cheaper causal program
             conts = [e for e in enriched if e[1] > 0]  # suffix continuation
             for chunk in _pow2_chunks(plain, self.prefill_batch_max):
@@ -857,6 +881,7 @@ class Engine:
                 return
             for batch in _pow2_chunks(need, self.prefill_batch_max):
                 B = len(batch)
+                self._spill_batch_sizes.add(B)
                 toks = np.zeros((B, CH), dtype=np.int32)
                 starts = np.zeros(B, dtype=np.int32)
                 slots = np.zeros(B, dtype=np.int32)
@@ -866,13 +891,7 @@ class Engine:
                     starts[i] = start
                     slots[i] = slot
                 self._rng, step_rng = jax.random.split(self._rng)
-                self.cache, _tok, _state = self._jit_prefill_continue(
-                    self.params,
-                    self.cache,
-                    jnp.asarray(toks),
-                    jnp.full(B, CH, dtype=np.int32),
-                    jnp.asarray(starts),
-                    jnp.asarray(slots),
+                tail = (
                     step_rng,
                     jnp.zeros(B, dtype=np.float32),  # temps (unused sample)
                     jnp.zeros(B, dtype=np.int32),
@@ -883,6 +902,35 @@ class Engine:
                     self._dummy_min_close,
                     jnp.ones(B, dtype=np.int32),
                 )
+                if self.kv_layout == "paged":
+                    P = self.page_size
+                    page_ids = np.zeros((B, CH // P), dtype=np.int32)
+                    for i, (item, start) in enumerate(batch):
+                        _req, slot, _, _m = item
+                        page_ids[i] = self._slot_pages[slot][start // P : (start + CH) // P]
+                    block_tables = jnp.asarray(
+                        self._block_tables[[it[0][1] for it in batch]]
+                    )
+                    self.cache, _tok, _state = self._jit_prefill_paged_continue(
+                        self.params,
+                        self.cache,
+                        jnp.asarray(toks),
+                        jnp.full(B, CH, dtype=np.int32),
+                        jnp.asarray(starts),
+                        jnp.asarray(page_ids),
+                        block_tables,
+                        *tail,
+                    )
+                else:
+                    self.cache, _tok, _state = self._jit_prefill_continue(
+                        self.params,
+                        self.cache,
+                        jnp.asarray(toks),
+                        jnp.full(B, CH, dtype=np.int32),
+                        jnp.asarray(starts),
+                        jnp.asarray(slots),
+                        *tail,
+                    )
                 for e in batch:
                     e[1] += CH
 
@@ -1193,12 +1241,11 @@ class Engine:
             P = self.page_size
             # suffix pages only (the model writes just the suffix; shared
             # prefix pages are referenced via the block table, never written)
+            # slot pages / block tables were installed at admission (they
+            # must exist before spill chunks reference them)
             page_ids = np.full((B, bucket // P), TRASH_PAGE, dtype=np.int32)
             for i, (req, slot, pages, _m) in enumerate(chunk):
                 assert pages is not None
-                self._slot_pages[slot] = pages
-                self._block_tables[slot, :] = TRASH_PAGE
-                self._block_tables[slot, : len(pages)] = pages
                 fresh = pages[int(starts[i]) // P :]
                 page_ids[i, : len(fresh)] = fresh
             if starts_np is not None:

@@ -248,3 +248,42 @@ def test_paged_entry_eviction_while_borrower_active_is_safe():
     finally:
         eng.stop()
         cold.stop()
+
+
+def test_paged_chunked_prefill_long_prompt():
+    """Paged layout no longer requires buckets to reach max_ctx: long
+    prompts spill through the paged continuation program; greedy equality
+    vs a single-shot paged engine, and composes with the paged prefix
+    cache."""
+    greedy = SamplingParams(temperature=0.0, max_tokens=8)
+
+    def paged(buckets, entries):
+        e = Engine(
+            config=CFG, tokenizer=ByteTokenizer(),
+            mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+            max_slots=2, max_ctx=512, prefill_buckets=buckets,
+            decode_block_size=4, kv_layout="paged", page_size=16,
+            prefix_cache_entries=entries, seed=0,
+        )
+        e.start()
+        return e
+
+    small = paged((64,), 0)  # forces chunking
+    big = paged((64, 512), 0)
+    try:
+        prompt = "a long paged conversation transcript. " * 7  # ~260 tokens
+        a = small.generate(prompt, greedy).tokens
+        b = big.generate(prompt, greedy).tokens
+        assert a == b
+        cached = paged((64,), 4)
+        try:
+            c1 = cached.generate(prompt, greedy).tokens
+            c2 = cached.generate(prompt + " more", greedy).tokens
+            assert c1 == a
+            assert cached.stats()["prefix_cache"]["hits"] >= 1
+            assert c2 == big.generate(prompt + " more", greedy).tokens
+        finally:
+            cached.stop()
+    finally:
+        small.stop()
+        big.stop()
