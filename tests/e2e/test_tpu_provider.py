@@ -218,3 +218,74 @@ async def test_tool_choice_required_forces_tool_call(engine):
         _json.loads(ours[0].spec.arguments)  # grammar guaranteed this
     finally:
         await op.stop()
+
+
+async def test_human_contact_flow_driven_by_tpu_engine(engine):
+    """BASELINE config 4 with provider: tpu — the engine's forced tool call
+    targets the human-contact tool, the ToolCall goes AwaitingHumanInput
+    against the in-tree human backend, a human responds, and the answer
+    joins the Task's context window."""
+    from ..fixtures import make_contactchannel, make_secret
+
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False, verify_channel_credentials=False,
+            engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.02
+    op.toolcall_reconciler.poll_interval = 0.02
+    store = op.store
+    make_secret(store)  # the channel's api key; revalidation checks it
+    make_contactchannel(store, name="oncall")
+    setup_with_status(
+        store,
+        LLM(
+            metadata=ObjectMeta(name="tpu-hc"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="tiny", max_tokens=40, temperature=1.0),
+                tpu=TPUProviderConfig(preset="tiny"),
+                # force the channel tool explicitly
+                provider_config={"tool_choice": "oncall__human_contact_email"},
+            ),
+        ),
+        lambda o: (
+            setattr(o.status, "ready", True),
+            setattr(o.status, "status", "Ready"),
+        ),
+    )
+    make_agent(store, name="asker", llm="tpu-hc", system="ask the human",
+               channels=("oncall",))
+    make_task(store, name="hc-task", agent="asker", user_message="need sign-off")
+    await op.start()
+    try:
+        # engine-driven forced call -> ToolCall CR -> AwaitingHumanInput
+        deadline_tc = None
+        for _ in range(1200):
+            tcs = store.list(
+                "ToolCall", "default", label_selector={"acp.tpu/task": "hc-task"}
+            )
+            if tcs and tcs[0].status.phase == "AwaitingHumanInput":
+                deadline_tc = tcs[0]
+                break
+            await asyncio.sleep(0.1)
+        assert deadline_tc is not None, "ToolCall never reached AwaitingHumanInput"
+        assert deadline_tc.spec.tool_type == "HumanContact"
+
+        # the human answers through the in-tree backend
+        pending = op.human_backend.pending_contacts()
+        assert pending
+        op.human_backend.respond(pending[0].call_id, "approved, proceed")
+
+        def tool_result_joined(t) -> bool:
+            return any(
+                m.role == "tool" and "approved, proceed" in (m.content or "")
+                for m in t.status.context_window
+            )
+
+        await wait_for(
+            store, "Task", "hc-task", "default", tool_result_joined, timeout=120,
+        )
+    finally:
+        await op.stop()
