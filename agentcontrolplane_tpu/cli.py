@@ -97,11 +97,14 @@ def cmd_run(args) -> int:
     )
 
     async def main():
+        from .operator import serve_until_signalled
+
         op = Operator(options)
         await op.start()
         print(f"operator running; REST API on :{args.port}", flush=True)
         try:
-            await asyncio.Event().wait()
+            await serve_until_signalled()
+            print("shutting down", flush=True)
         except asyncio.CancelledError:
             pass
         finally:

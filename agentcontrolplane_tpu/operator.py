@@ -154,11 +154,38 @@ class Operator:
         self.store.close()
 
 
+async def serve_until_signalled() -> None:
+    """Block until SIGTERM/SIGINT (docker-stop/systemd/Ctrl-C). Handlers are
+    REMOVED once the signal arrives, so a second signal during a hung
+    cleanup still kills the process instead of being swallowed."""
+    import signal
+
+    done = asyncio.Event()
+    loop = asyncio.get_running_loop()
+    installed: list = []
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        try:
+            loop.add_signal_handler(sig, done.set)
+            installed.append(sig)
+        except (NotImplementedError, RuntimeError):
+            pass  # non-main thread / platform without signal support
+    try:
+        await done.wait()
+    finally:
+        for sig in installed:
+            loop.remove_signal_handler(sig)
+
+
 async def run_operator(options: OperatorOptions) -> None:
-    """Blocking entrypoint (the ``mgr.Start`` equivalent)."""
+    """Blocking entrypoint (the ``mgr.Start`` equivalent): serves until
+    signalled, then shuts everything down cleanly (controllers, MCP
+    subprocesses, sqlite, REST, and the TPU engine if configured)."""
     op = Operator(options)
     await op.start()
     try:
-        await asyncio.Event().wait()
+        await serve_until_signalled()
     finally:
         await op.stop()
+        engine = options.engine
+        if engine is not None:
+            engine.stop()  # type: ignore[attr-defined]
