@@ -165,6 +165,9 @@ class Engine:
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
     ):
+        from ..xla_cache import enable_persistent_compilation_cache
+
+        enable_persistent_compilation_cache()
         self.decode_block_size = max(1, decode_block_size)
         if kv_layout not in ("slot", "paged"):
             raise ValueError(f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
@@ -238,8 +241,23 @@ class Engine:
             # Compiled pallas path on real TPU (tp>1 goes through the
             # shard_map wrapper over head-sharded pages — GSPMD treats
             # pallas_call as opaque); CPU uses the exact XLA reference
-            # (interpret-mode kernel equivalence is in tests).
-            self._use_pallas = jax.default_backend() == "tpu"
+            # (interpret-mode kernel equivalence is in tests). The kernel
+            # targets hardware-native geometry: head_dim must be a multiple
+            # of the 128-lane width (128 for llama/qwen/mistral, 256 for
+            # gemma — both validated compiled-on-TPU) — Mosaic cannot
+            # shape-cast the page buffer's [P, H_kv*d] -> [P, H_kv, d] split
+            # for other widths (e.g. the tiny CPU-test configs), so those
+            # fall back to the exact XLA gather reference.
+            self._use_pallas = (
+                jax.default_backend() == "tpu" and config.head_dim % 128 == 0
+            )
+            if jax.default_backend() == "tpu" and not self._use_pallas:
+                log.warning(
+                    "paged kv_layout on TPU without the Pallas kernel: "
+                    "head_dim %d is not a multiple of 128; decode uses the "
+                    "XLA gather reference (materializes the gathered context "
+                    "every step)", config.head_dim,
+                )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
         self._rng = jax.random.key(seed)
@@ -289,8 +307,12 @@ class Engine:
         # is verified against this, not assumed from submit timing)
         self._cont_batch_sizes: set[int] = set()
         self._spill_batch_sizes: set[int] = set()
+        # plain prefill (bucket, B) pairs dispatched — each is its own
+        # compiled program; prewarm's mid-batch phase verifies against this
+        self._full_batch_shapes: set[tuple[int, int]] = set()
         self._token_table = None
         self._min_close = None
+        self._table_lock = threading.Lock()
         self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
         self._dummy_min_close = jnp.zeros((1,), dtype=jnp.int32)
         # remaining sampled-token budget per slot (budget-aware constraint)
@@ -597,8 +619,17 @@ class Engine:
         for b in self.prefill_buckets:
             if b + max_blocks * K < self.max_ctx:
                 decay_bucket = b
-        modes = [False, True] if constrained else [False]
-        for json_only in modes:
+        if constrained:
+            # build the token table BEFORE any compiles: once it exists every
+            # program (constrained or not) is traced against the real table
+            # shape, so the unconstrained phases below warm the same entries
+            # mixed traffic will hit — not a dummy-table variant that real
+            # serving immediately abandons after the first constrained request
+            self._get_token_table()
+        # ONE pass: with the table pre-built, constrained and unconstrained
+        # requests hit the same compiled programs (json_only is runtime data,
+        # not a trace shape), so a second mode pass would warm nothing new
+        for json_only in [constrained]:
             # phase a: staggered decay burst (barrier: the next phase must
             # find every slot free, or its batch can't form at full width)
             futs = []
@@ -628,6 +659,34 @@ class Engine:
             for b in self.prefill_buckets:
                 sp = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
                 self.submit([1] * max(1, b - 1), sp, _prewarm=True).result(timeout=1800)
+            # phase c2: remaining (bucket, batch) plain-prefill programs —
+            # staggered arrivals (the operator's reconcile cadence) land
+            # mid-size chunks (B=2/4) that the full-width bursts above never
+            # form; each (bucket, B) is its own compiled program. Verified
+            # against the dispatch record like phases d/e.
+            one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+            Bsz = 2
+            while Bsz <= min(self.prefill_batch_max, self.max_slots):
+                for idx, b in enumerate(self.prefill_buckets):
+                    prev = self.prefill_buckets[idx - 1] if idx else 0
+                    if (b, Bsz) in self._full_batch_shapes:
+                        continue  # covered by an earlier phase/run
+                    if b - Bsz <= prev:
+                        continue  # bucket too narrow for Bsz distinct lengths
+                    for _attempt in range(5):
+                        futs = [
+                            self.submit([1] * (b - 1 - i), one, _prewarm=True)
+                            for i in range(Bsz)
+                        ]
+                        for f in futs:
+                            f.result(timeout=1800)
+                        if (b, Bsz) in self._full_batch_shapes:
+                            break
+                    else:
+                        log.warning(
+                            "prewarm: plain batch (bucket=%d, B=%d) never formed", b, Bsz
+                        )
+                Bsz *= 2
             # phase d: the prefix-cache CONTINUATION program: a seed request,
             # then hitting bursts at every power-of-two batch size up to
             # min(prefill_batch_max, max_slots) (distinct tails so a burst
@@ -1139,25 +1198,33 @@ class Engine:
         return state
 
     def _get_token_table(self):
-        """Lazy-build + cache the grammar token table on device."""
+        """Lazy-build + cache the grammar token table on device. Called from
+        the engine thread AND from caller threads (prewarm, bench setup), so
+        the build is lock-serialized and ``_token_table`` is assigned LAST:
+        readers that key on ``_token_table is not None`` (e.g. _decode_once's
+        use_real) must never observe a half-built state where ``_min_close``
+        is still None."""
         if self._token_table is None:
-            from .constrain import build_token_table
+            with self._table_lock:
+                if self._token_table is not None:
+                    return self._token_table
+                from .constrain import build_token_table
 
-            t0 = time.monotonic()
-            table = build_token_table(self.tokenizer)
-            padded = np.full(
-                (table.token_trans.shape[0], self.config.vocab_size), -1, dtype=np.int32
-            )
-            width = min(self.config.vocab_size, table.token_trans.shape[1])
-            padded[:, :width] = table.token_trans[:, :width]
-            self._token_table = jnp.asarray(padded)
-            self._token_table_np = padded  # host-side walks (prefix seeding)
-            self._min_close = jnp.asarray(table.min_close.astype(np.int32))
-            self._table_start = table.start_state
-            log.info(
-                "built JSON constraint table: %d states x %d tokens in %.1fs",
-                *table.token_trans.shape, time.monotonic() - t0,
-            )
+                t0 = time.monotonic()
+                table = build_token_table(self.tokenizer)
+                padded = np.full(
+                    (table.token_trans.shape[0], self.config.vocab_size), -1, dtype=np.int32
+                )
+                width = min(self.config.vocab_size, table.token_trans.shape[1])
+                padded[:, :width] = table.token_trans[:, :width]
+                self._token_table_np = padded  # host-side walks (prefix seeding)
+                self._min_close = jnp.asarray(table.min_close.astype(np.int32))
+                self._table_start = table.start_state
+                self._token_table = jnp.asarray(padded)  # LAST: publishes the rest
+                log.info(
+                    "built JSON constraint table: %d states x %d tokens in %.1fs",
+                    *table.token_trans.shape, time.monotonic() - t0,
+                )
         return self._token_table
 
     def _prefill_group(
@@ -1180,6 +1247,8 @@ class Engine:
             _next_bucket(len(self._full_row(r)) - int(starts[i]), self.prefill_buckets)
             for i, (r, _, _, _) in enumerate(chunk)
         )
+        if starts_np is None:
+            self._full_batch_shapes.add((bucket, B))
         tokens = np.zeros((B, bucket), dtype=np.int32)
         lengths = np.zeros(B, dtype=np.int32)
         slots = np.zeros(B, dtype=np.int32)
@@ -1280,8 +1349,9 @@ class Engine:
             for i, (req, slot, _, _m) in enumerate(chunk):
                 if not req.truncated:
                     self._save_prefix(self._full_row(req), len(req.prompt), slot)
-        firsts = np.asarray(firsts)
-        con_states = np.asarray(con_states)
+        # one combined round trip (see _decode_once; the tunnel RTT floor
+        # applies per fetch, not per byte)
+        firsts, con_states = jax.device_get((firsts, con_states))
         now = time.monotonic()
         for i, (req, slot, _, _m) in enumerate(chunk):
             s = req.sampling
@@ -1369,9 +1439,11 @@ class Engine:
         for slot in self._slots:
             active_mask[slot] = True
         self._rng, step_rng = jax.random.split(self._rng)
-        # the real table (a large gather operand) is only passed when some
-        # slot is actually constrained; each shape is its own jit cache entry
-        use_real = self._token_table is not None and bool(self._constrained[:W].any())
+        # once the token table exists it is passed unconditionally (matching
+        # the prefill path): keying jit entries on "any slot constrained"
+        # would DOUBLE the decode-width program matrix, and the table is a
+        # device-resident array with no per-dispatch transfer cost
+        use_real = self._token_table is not None
         table = self._token_table if use_real else self._dummy_table
         min_close = self._min_close if use_real else self._dummy_min_close
         for slot, sl in self._slots.items():
@@ -1404,9 +1476,13 @@ class Engine:
             cache, tok_block, con_states = self._jit_decode(
                 self.params, self.cache, *common
             )
-        self._con_states[:W] = np.asarray(con_states)
+        # ONE host round trip for both results — through a high-RTT link
+        # (axon tunnel ~80ms/fetch) sequential np.asarray fetches double the
+        # per-block latency floor
+        con_states, tok_block = jax.device_get((con_states, tok_block))
+        self._con_states[:W] = con_states
         self.cache = cache
-        tok_block = np.asarray(tok_block)  # [K, W]
+        # tok_block: [K, W]
         K = tok_block.shape[0]
         self.decode_steps += K
         active = list(self._slots.items())

@@ -30,8 +30,7 @@ from ..ops.attention import (
     blocked_causal_attention,
     causal_attention,
     continue_attention,
-    decode_attention,
-    write_kv_token,
+    decode_attention_cache_plus_new,
 )
 from ..ops.norms import rms_norm
 from ..ops.rope import apply_rope
@@ -347,9 +346,8 @@ def prefill_batch(
     positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)  # [B,T]
     x = _embed(params, tokens, c)  # [B, T, D]
 
-    def body(carry, scanned):
+    def body(carry, layer):
         x = carry
-        layer, k_cache_l, v_cache_l = scanned
         out, k, v = _attn_mlp(
             x,
             layer,
@@ -357,18 +355,21 @@ def prefill_batch(
             positions,
             lambda q, k, v: blocked_causal_attention(q, k, v, positions),
         )
-        # scatter each row's [T] K/V into its slot (padded tail is garbage
-        # but never read: decode masks by seq_len)
-        k_cache_l = k_cache_l.at[slots, :T].set(k.astype(k_cache_l.dtype))
-        v_cache_l = v_cache_l.at[slots, :T].set(v.astype(v_cache_l.dtype))
-        return out, (k_cache_l, v_cache_l)
+        return out, (k, v)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    # prompt attention never reads the cache, so the cache stays OUT of the
+    # scan entirely: stack the per-layer K/V (ys) and commit with one
+    # scatter — writing inside the scan would copy the whole cache per layer
+    # (see decode_step)
+    x, (new_k, new_v) = jax.lax.scan(body, x, params["layers"])
+    k_all = cache["k"].at[:, slots, :T].set(new_k.astype(cache["k"].dtype))
+    v_all = cache["v"].at[:, slots, :T].set(new_v.astype(cache["v"].dtype))
+    # (padded tail is garbage but never read: decode masks by seq_len)
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]  # [B, D]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits
 
 
 def prefill(
@@ -412,26 +413,46 @@ def prefill_continue(
     # always re-written by decode before it becomes readable)
     write_pos = jnp.minimum(starts[:, None] + ar[None, :], C - 1)  # [B, T]
 
+    # keys = [prefix rows (read-only, positions < start) ++ own suffix];
+    # the cache's stale suffix region is masked via key position -1
+    cache_pos = jnp.where(
+        jnp.arange(C)[None, :] < starts[:, None], jnp.arange(C)[None, :], -1
+    )  # [B, C]
+    key_pos = jnp.concatenate([cache_pos, positions], axis=1)  # [B, C+T]
+
     def body(carry, scanned):
         x = carry
-        layer, k_cache_l, v_cache_l = scanned
+        layer, k_cache_l, v_cache_l = scanned  # read-only
 
         def attn(q, k, v):
-            k_l = k_cache_l.at[slots[:, None], write_pos].set(k.astype(k_cache_l.dtype))
-            v_l = v_cache_l.at[slots[:, None], write_pos].set(v.astype(v_cache_l.dtype))
-            out = continue_attention(q, k_l[slots], v_l[slots], positions)
-            attn.updated = (k_l, v_l)
+            k_full = jnp.concatenate(
+                [k_cache_l[slots], k.astype(k_cache_l.dtype)], axis=1
+            )
+            v_full = jnp.concatenate(
+                [v_cache_l[slots], v.astype(v_cache_l.dtype)], axis=1
+            )
+            out = continue_attention(q, k_full, v_full, positions, key_pos)
+            attn.new_kv = (k, v)
             return out
 
         out, _, _ = _attn_mlp(x, layer, c, positions, attn)
-        return out, attn.updated
+        return out, attn.new_kv
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    # one scatter commits the suffix K/V for every layer
+    k_all = cache["k"].at[:, slots[:, None], write_pos].set(
+        new_k.astype(cache["k"].dtype)
+    )
+    v_all = cache["v"].at[:, slots[:, None], write_pos].set(
+        new_v.astype(cache["v"].dtype)
+    )
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits
 
 
 # ---------------------------------------------------------------------------
@@ -464,27 +485,29 @@ def prefill_paged_batch(
     positions = jnp.where(ar[None, :] < lengths[:, None], ar[None, :], -1)
     x = _embed(params, tokens, c)
 
-    def body(carry, scanned):
+    def body(carry, layer):
         x = carry
-        layer, k_pages_l, v_pages_l = scanned
         out, k, v = _attn_mlp(
             x, layer, c, positions,
             lambda q, k, v: blocked_causal_attention(q, k, v, positions),
         )
-        P = k_pages_l.shape[1]
-        # [B, T, H, d] -> [B * T//P, P, H, d] blocks matched to flat page ids
-        blocks = lambda t: t.reshape(B * (T // P), P, *t.shape[2:])
-        flat_ids = page_ids.reshape(-1)
-        k_pages_l = k_pages_l.at[flat_ids].set(blocks(k).astype(k_pages_l.dtype))
-        v_pages_l = v_pages_l.at[flat_ids].set(blocks(v).astype(v_pages_l.dtype))
-        return out, (k_pages_l, v_pages_l)
+        return out, (k, v)
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    # pages stay out of the scan (prompt attention never reads them); one
+    # scatter commits all layers' blocks — see prefill_batch/decode_step
+    x, (new_k, new_v) = jax.lax.scan(body, x, params["layers"])
+    L = new_k.shape[0]
+    P = pages["k"].shape[2]
+    # [L, B, T, H, d] -> [L, B * T//P, P, H, d] blocks matched to flat ids
+    blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
+    flat_ids = page_ids.reshape(-1)
+    k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
+    v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits
 
 
 def prefill_paged(
@@ -524,31 +547,48 @@ def prefill_paged_continue(
     x = _embed(params, tokens, c)
     max_pages = block_tables.shape[1]
 
+    P = pages["k"].shape[2]
+    # keys = [gathered prefix pages (positions < start) ++ own suffix]; the
+    # suffix pages referenced by the block table are not yet written, so
+    # their gathered rows are stale — masked via key position -1
+    row_pos = jnp.arange(max_pages * P)[None, :]
+    cache_pos = jnp.where(row_pos < starts[:, None], row_pos, -1)  # [B, MP*P]
+    key_pos = jnp.concatenate([cache_pos, positions], axis=1)
+
     def body(carry, scanned):
         x = carry
-        layer, k_pages_l, v_pages_l = scanned
+        layer, k_pages_l, v_pages_l = scanned  # read-only
 
         def attn(q, k, v):
-            P = k_pages_l.shape[1]
-            blocks = lambda t: t.reshape(B * (T // P), P, *t.shape[2:])
-            flat_ids = page_ids.reshape(-1)
-            k_l = k_pages_l.at[flat_ids].set(blocks(k).astype(k_pages_l.dtype))
-            v_l = v_pages_l.at[flat_ids].set(blocks(v).astype(v_pages_l.dtype))
-            k_rows = k_l[block_tables].reshape(B, max_pages * P, *k_l.shape[2:])
-            v_rows = v_l[block_tables].reshape(B, max_pages * P, *v_l.shape[2:])
-            out = continue_attention(q, k_rows, v_rows, positions)
-            attn.updated = (k_l, v_l)
+            k_rows = k_pages_l[block_tables].reshape(
+                B, max_pages * P, *k_pages_l.shape[2:]
+            )
+            v_rows = v_pages_l[block_tables].reshape(
+                B, max_pages * P, *v_pages_l.shape[2:]
+            )
+            k_full = jnp.concatenate([k_rows, k.astype(k_rows.dtype)], axis=1)
+            v_full = jnp.concatenate([v_rows, v.astype(v_rows.dtype)], axis=1)
+            out = continue_attention(q, k_full, v_full, positions, key_pos)
+            attn.new_kv = (k, v)
             return out
 
         out, _, _ = _attn_mlp(x, layer, c, positions, attn)
-        return out, attn.updated
+        return out, attn.new_kv
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pages["k"], pages["v"])
+    )
+    # one scatter commits the suffix blocks for every layer
+    L = new_k.shape[0]
+    blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
+    flat_ids = page_ids.reshape(-1)
+    k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
+    v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits
 
 
 def decode_step_paged(
@@ -562,10 +602,19 @@ def decode_step_paged(
     use_pallas: bool = False,
     mesh=None,  # required for the pallas path when the mesh has tp > 1
 ) -> tuple[dict, jax.Array]:
-    """One decode step for all slots against the paged cache."""
-    from ..ops.paged import paged_decode_attention_reference, write_token_to_pages
+    """One decode step for all slots against the paged cache.
+
+    Same HBM discipline as :func:`decode_step`: pages ride the layer scan
+    READ-ONLY, the new token attends via a self term (folded outside the
+    Pallas kernel from its unnormalized (acc, m, l) output), and one
+    scatter after the scan commits every layer's new K/V to the pages."""
+    from ..ops.paged import (
+        TRASH_PAGE,
+        paged_decode_attention_reference_cache_plus_new,
+    )
 
     c = config
+    S = tokens.shape[0]
     positions = seq_lens[:, None]
     x = _embed(params, tokens[:, None], c)
     tp_size = 1
@@ -574,37 +623,54 @@ def decode_step_paged(
 
     def body(carry, scanned):
         x = carry
-        layer, k_pages_l, v_pages_l = scanned
+        layer, k_pages_l, v_pages_l = scanned  # read-only
 
         def attn(q, k, v):
-            k_l, v_l = write_token_to_pages(
-                k_pages_l, v_pages_l, block_tables, seq_lens, active, k[:, 0], v[:, 0]
-            )
             if use_pallas and tp_size > 1:
-                from ..ops.pallas.paged_attention import paged_decode_attention_sharded
+                from ..ops.pallas.paged_attention import (
+                    paged_decode_attention_cache_plus_new_sharded,
+                )
 
-                out = paged_decode_attention_sharded(
-                    mesh, q[:, 0], k_l, v_l, block_tables, seq_lens + 1
+                out = paged_decode_attention_cache_plus_new_sharded(
+                    mesh, q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
+                    k[:, 0], v[:, 0],
                 )
             elif use_pallas:
-                from ..ops.pallas.paged_attention import paged_decode_attention
-
-                out = paged_decode_attention(q[:, 0], k_l, v_l, block_tables, seq_lens + 1)
-            else:
-                out = paged_decode_attention_reference(
-                    q[:, 0], k_l, v_l, block_tables, seq_lens + 1
+                from ..ops.pallas.paged_attention import (
+                    paged_decode_attention_cache_plus_new,
                 )
-            attn.updated = (k_l, v_l)
+
+                out = paged_decode_attention_cache_plus_new(
+                    q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
+                    k[:, 0], v[:, 0],
+                )
+            else:
+                out = paged_decode_attention_reference_cache_plus_new(
+                    q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
+                    k[:, 0], v[:, 0],
+                )
+            attn.new_kv = (k[:, 0], v[:, 0])
             return out[:, None]
 
         out, _, _ = _attn_mlp(x, layer, c, positions, attn)
-        return out, attn.updated
+        return out, attn.new_kv
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], pages["k"], pages["v"]))
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], pages["k"], pages["v"])
+    )
+    # one scatter commits all layers: (l, page(slot), offset(slot)); inactive
+    # slots land on the trash page
+    P = pages["k"].shape[2]
+    page_idx = seq_lens // P
+    offset = seq_lens % P
+    target = block_tables[jnp.arange(S), page_idx]
+    target = jnp.where(active, target, TRASH_PAGE)
+    k_all = pages["k"].at[:, target, offset].set(new_k.astype(pages["k"].dtype))
+    v_all = pages["v"].at[:, target, offset].set(new_v.astype(pages["v"].dtype))
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits
 
 
 def decode_step(
@@ -619,7 +685,15 @@ def decode_step(
     occupancy the engine dispatches a power-of-two W covering the active
     slots, so one live request doesn't pay max_slots of compute. Inactive
     slots inside W compute garbage that is never read; cache rows beyond W
-    pass through untouched. Returns (cache, logits [W, V])."""
+    pass through untouched. Returns (cache, logits [W, V]).
+
+    HBM discipline (measured on v5e through the hot loop): the cache rides
+    the layer scan as READ-ONLY xs, the new token attends via an explicit
+    self term (decode_attention_cache_plus_new), and all L layers' new K/V
+    commit in ONE scatter after the scan. Writing inside the scan — whether
+    as stacked ys or as a scatter on a carried cache — makes XLA's copy
+    insertion duplicate the entire cache every step (44ms/step vs 13.5 for
+    this form at bench-1b 64x512)."""
     c = config
     W = tokens.shape[0]
     positions = seq_lens[:, None]  # the new token's position, [W, 1]
@@ -627,20 +701,26 @@ def decode_step(
 
     def body(carry, scanned):
         x = carry
-        layer, k_cache_l, v_cache_l = scanned
+        layer, k_rows, v_rows = scanned  # cache rows: read-only
 
         def attn(q, k, v):
-            # write the new token, then attend over the first W cache rows
-            k_l, v_l = write_kv_token(k_cache_l, v_cache_l, seq_lens, k[:, 0], v[:, 0])
-            out = decode_attention(q[:, 0], k_l[:W], v_l[:W], seq_lens + 1)
-            attn.updated = (k_l, v_l)
+            out = decode_attention_cache_plus_new(
+                q[:, 0], k_rows[:W], v_rows[:W], k[:, 0], v[:, 0], seq_lens
+            )
+            attn.new_kv = (k[:, 0], v[:, 0])
             return out[:, None]
 
         out, _, _ = _attn_mlp(x, layer, c, positions, attn)
-        return out, attn.updated
+        return out, attn.new_kv
 
-    x, (new_k, new_v) = jax.lax.scan(body, x, (params["layers"], cache["k"], cache["v"]))
+    x, (new_k, new_v) = jax.lax.scan(
+        body, x, (params["layers"], cache["k"], cache["v"])
+    )
+    # one scatter commits every layer's token: rows (l, s, seq_lens[s])
+    slot_idx = jnp.arange(W)
+    k_all = cache["k"].at[:, slot_idx, seq_lens].set(new_k.astype(cache["k"].dtype))
+    v_all = cache["v"].at[:, slot_idx, seq_lens].set(new_v.astype(cache["v"].dtype))
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)  # [S, D]
     head = params["embed"].T if c.tie_embeddings else params["lm_head"]
     logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
-    return {"k": new_k, "v": new_v}, logits
+    return {"k": k_all, "v": v_all}, logits

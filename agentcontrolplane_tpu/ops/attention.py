@@ -78,6 +78,46 @@ def decode_attention(
     return jnp.einsum("shc,schd->shd", probs, v)
 
 
+def decode_attention_cache_plus_new(
+    q: jax.Array,  # [S, H, d] — one new token per slot
+    k_cache: jax.Array,  # [S, C, H_kv, d] — WITHOUT the new token
+    v_cache: jax.Array,
+    k_new: jax.Array,  # [S, H_kv, d] — the new token's K/V (not yet written)
+    v_new: jax.Array,
+    seq_lens: jax.Array,  # [S] int32 — tokens valid in the CACHE (excl. new)
+) -> jax.Array:
+    """Decode attention over read-only cache rows plus an explicit
+    self-attention term for the not-yet-written token.
+
+    This split is the hot-loop enabler: the cache stays a READ-ONLY scan
+    input through the layer stack (xs reads are free; in-place scatter
+    inside a nested scan is not — XLA's copy insertion rewrites it into a
+    full cache copy per layer, ~3x the whole step time at bench-1b/64x512),
+    and the step commits every layer's new K/V with ONE scatter afterwards.
+    GQA via q-reshape (no repeated-KV materialization)."""
+    S, C, H_kv, d = k_cache.shape
+    H = q.shape[1]
+    r = H // H_kv
+    q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    logits = (
+        jnp.einsum("skrd,sckd->sckr", q4, k_cache.astype(jnp.float32)) * scale
+    )  # [S, C, H_kv, r]
+    mask = jnp.arange(C)[None, :, None, None] < seq_lens[:, None, None, None]
+    logits = jnp.where(mask, logits, NEG_INF)
+    self_logit = (
+        jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale
+    )  # [S, H_kv, r]
+    m = jnp.maximum(jnp.max(logits, axis=1), self_logit)
+    p = jnp.exp(logits - m[:, None])
+    p_self = jnp.exp(self_logit - m)
+    denom = jnp.sum(p, axis=1) + p_self
+    out = jnp.einsum("sckr,sckd->skrd", p, v_cache.astype(jnp.float32))
+    out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
+    out = out / denom[..., None]
+    return out.reshape(S, H, d).astype(q.dtype)
+
+
 def online_softmax_step(qf, kf, vf, mask, m, l, acc, scale):
     """One flash-style accumulation step over a K/V block: given f32 query
     [B,Tq,H,d], block keys/values [B,Tk,H,d] (kv heads already repeated),
@@ -167,14 +207,17 @@ def blocked_causal_attention(
 
 def continue_attention(
     q: jax.Array,  # [B, T, H, d] — suffix queries
-    k_rows: jax.Array,  # [B, C, H_kv, d] — the slots' full cache rows
+    k_rows: jax.Array,  # [B, C, H_kv, d] — cache rows (and/or suffix keys)
     v_rows: jax.Array,
     positions: jax.Array,  # [B, T] absolute query positions (-1 = padding)
+    key_positions: jax.Array | None = None,  # [B, C]; -1 = invalid key
 ) -> jax.Array:
     """Suffix-over-cache attention (prefix-cache continuation): each query
-    attends to every cache position <= its own absolute position — exactly
-    causal, because everything below the query is valid prefix or
-    just-written suffix."""
+    attends to every key whose absolute position is <= its own — exactly
+    causal. Without ``key_positions`` the keys are assumed to be cache rows
+    at positions 0..C-1 (the write-then-attend form). With it, the caller
+    supplies each key's position (-1 = invalid) — the read-only form passes
+    [prefix-rows ++ own-suffix] with stale cache regions masked out."""
     B, T, H, d = q.shape
     C = k_rows.shape[1]
     n_rep = H // k_rows.shape[-2]
@@ -182,8 +225,11 @@ def continue_attention(
     v = repeat_kv(v_rows, n_rep)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     logits = jnp.einsum("bthd,bchd->bhtc", q, k).astype(jnp.float32) * scale
+    if key_positions is None:
+        key_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
     mask = (
-        (jnp.arange(C)[None, None, None, :] <= positions[:, None, :, None])
+        (key_positions[:, None, None, :] <= positions[:, None, :, None])
+        & (key_positions >= 0)[:, None, None, :]
         & (positions >= 0)[:, None, :, None]
     )
     logits = jnp.where(mask, logits, NEG_INF)

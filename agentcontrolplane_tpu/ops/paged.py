@@ -89,6 +89,46 @@ def paged_decode_attention_reference(
     return jnp.einsum("shc,schd->shd", probs, v)
 
 
+def paged_decode_attention_reference_cache_plus_new(
+    q: jax.Array,  # [S, H, d]
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — WITHOUT the new token
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [S, max_pages]
+    seq_lens: jax.Array,  # [S] — tokens valid in the pages (excl. new)
+    k_new: jax.Array,  # [S, H_kv, d]
+    v_new: jax.Array,
+) -> jax.Array:
+    """Exact reference for the read-only-pages + self-term decode form (the
+    hot-loop shape: pages stay a read-only operand, the new token attends
+    via an explicit term, writes happen once per step outside the layer
+    scan — see models/llama.py decode_step_paged)."""
+    S, H, d = q.shape
+    num_pages, P, H_kv, _ = k_pages.shape
+    max_pages = block_tables.shape[1]
+    r = H // H_kv
+    k = k_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
+    v = v_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
+    logits = jnp.einsum("skrd,sckd->sckr", q4, k.astype(jnp.float32)) * scale
+    mask = (
+        jnp.arange(max_pages * P)[None, :, None, None]
+        < seq_lens[:, None, None, None]
+    )
+    logits = jnp.where(mask, logits, NEG_INF)
+    self_logit = (
+        jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale
+    )  # [S, H_kv, r]
+    m = jnp.maximum(jnp.max(logits, axis=1), self_logit)
+    p = jnp.exp(logits - m[:, None])
+    p_self = jnp.exp(self_logit - m)
+    denom = jnp.sum(p, axis=1) + p_self
+    out = jnp.einsum("sckr,sckd->skrd", p, v.astype(jnp.float32))
+    out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
+    out = out / jnp.maximum(denom, 1e-30)[..., None]
+    return out.reshape(S, H, d).astype(q.dtype)
+
+
 class PageAllocator:
     """Host-side page free list with reference counts (the engine thread
     owns it; no locking). Page 0 is the reserved trash page and is never

@@ -7,12 +7,27 @@ accumulates a flash-style online softmax — the gathered
 ``[S, max_ctx, H, d]`` copy the pure-XLA reference materializes
 (``ops.paged.paged_decode_attention_reference``) never exists.
 
+The kernel emits the UNNORMALIZED accumulator state ``(acc, m, l)`` per
+slot; normalization — and, in the serving hot loop, the not-yet-written new
+token's self-attention term — merges outside in (fused) XLA. That keeps the
+cache pages a read-only operand: the engine's decode step commits all
+layers' new K/V with one scatter after the layer scan instead of writing
+pages before every attention call (see models/llama.py decode_step_paged).
+
 Grid: one program per slot. Per-program working set is
 2 (double buffer) x 2 (K+V) x [page_size, H_kv * d] — a few hundred KB in
 VMEM for Llama-3-8B geometry (page 16, 8 KV heads, d 128).
 
+Geometry note: the kernel targets head_dim % 128 == 0 (the TPU lane width;
+128 for llama/qwen/mistral, 256 for gemma — both validated compiled on
+hardware); the engine falls back to the XLA reference otherwise. Dots are
+expressed
+as multiply+reduce — a batched matvec (empty lhs non-contracting dims)
+trips a Mosaic TPU_DotDimensionNumbersAttr round-trip bug on real
+hardware, and at these shapes the MXU has nothing to offer over the VPU.
+
 Tested in interpreter mode on CPU against the exact reference; runs compiled
-on TPU.
+on TPU (tests/engine/test_tpu_hardware.py).
 """
 
 from __future__ import annotations
@@ -35,8 +50,10 @@ def _kernel(
     q_ref,  # [1, H, d] (VMEM) — this program's slot
     k_pages_ref,  # [num_pages, P, H_kv * d] (HBM/ANY)
     v_pages_ref,  # [num_pages, P, H_kv * d]
-    # output
-    out_ref,  # [1, H, d] (VMEM)
+    # outputs
+    acc_ref,  # [1, H, d] f32 — unnormalized weighted V sum
+    m_ref,  # [1, 1, H] f32 — running max (unit middle dim: TPU block shapes
+    l_ref,  # [1, 1, H] f32 — need the trailing dims to tile or match)
     # scratch
     k_buf,  # [2, P, H_kv * d] (VMEM)
     v_buf,  # [2, P, H_kv * d]
@@ -73,7 +90,7 @@ def _kernel(
         start_fetch(0, 0)
 
     def body(j, carry):
-        m, l, acc = carry  # [H,1], [H,1], [H,d] running online-softmax state
+        m, l, acc = carry  # [1,H], [1,H], [1,H,d] running online-softmax state
         slot = jax.lax.rem(j, 2)
         # prefetch next page into the other buffer while we wait on this one
         @pl.when(j + 1 < n_pages)
@@ -86,35 +103,38 @@ def _kernel(
         if n_rep > 1:
             k = jnp.repeat(k, n_rep, axis=1)
             v = jnp.repeat(v, n_rep, axis=1)
-        # logits [H, P]
-        logits = jnp.einsum("hd,phd->hp", q, k) * scale
-        pos = j * P + jax.lax.broadcasted_iota(jnp.int32, (1, P), 1)
+        # logits [P, H] via multiply+reduce, NOT dot_general (see module doc)
+        logits = jnp.sum(q[None, :, :] * k, axis=-1) * scale  # [P, H]
+        pos = j * P + jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
         logits = jnp.where(pos < seq_len, logits, NEG_INF)
 
-        m_blk = jnp.max(logits, axis=1, keepdims=True)  # [H,1]
+        m_blk = jnp.max(logits, axis=0, keepdims=True)  # [1,H]
         m_new = jnp.maximum(m, m_blk)
-        p = jnp.exp(logits - m_new)  # [H,P]
-        correction = jnp.exp(m - m_new)  # [H,1]
-        l = l * correction + jnp.sum(p, axis=1, keepdims=True)
-        acc = acc * correction + jnp.einsum("hp,phd->hd", p, v)
+        p = jnp.exp(logits - m_new)  # [P,H]
+        correction = jnp.exp(m - m_new)  # [1,H]
+        l = l * correction + jnp.sum(p, axis=0, keepdims=True)
+        pv = jnp.sum(p[:, :, None] * v, axis=0, keepdims=True)  # [1,H,d]
+        acc = acc * correction[:, :, None] + pv
         return m_new, l, acc
 
-    m0 = jnp.full((H, 1), NEG_INF, dtype=jnp.float32)
-    l0 = jnp.zeros((H, 1), dtype=jnp.float32)
-    acc0 = jnp.zeros((H, d), dtype=jnp.float32)
+    m0 = jnp.full((1, H), NEG_INF, dtype=jnp.float32)
+    l0 = jnp.zeros((1, H), dtype=jnp.float32)
+    acc0 = jnp.zeros((1, H, d), dtype=jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, n_pages, body, (m0, l0, acc0))
-    out = acc / jnp.maximum(l, 1e-30)
-    out_ref[0] = out.astype(out_ref.dtype)
+    acc_ref[0] = acc[0]
+    m_ref[0] = m
+    l_ref[0] = l
 
 
-def paged_decode_attention(
+def _paged_state(
     q: jax.Array,  # [S, H, d]
     k_pages: jax.Array,  # [num_pages, P, H_kv, d]
     v_pages: jax.Array,
     block_tables: jax.Array,  # [S, max_pages] int32
     seq_lens: jax.Array,  # [S] int32
     interpret: bool = False,
-) -> jax.Array:
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Run the kernel -> unnormalized (acc [S,H,d] f32, m [S,H], l [S,H])."""
     S, H, d = q.shape
     num_pages, P, H_kv, _ = k_pages.shape
     max_pages = block_tables.shape[1]
@@ -134,17 +154,25 @@ def paged_decode_attention(
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec(memory_space=pl.ANY),
         ],
-        out_specs=pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+        out_specs=[
+            pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, H), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+        ],
         scratch_shapes=[
             pltpu.VMEM((2, P, H_kv * d), k_pages.dtype),
             pltpu.VMEM((2, P, H_kv * d), v_pages.dtype),
             pltpu.SemaphoreType.DMA((2, 2)),
         ],
     )
-    return pl.pallas_call(
+    acc, m, l = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
-        out_shape=jax.ShapeDtypeStruct((S, H, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((S, H, d), jnp.float32),
+            jax.ShapeDtypeStruct((S, 1, H), jnp.float32),
+            jax.ShapeDtypeStruct((S, 1, H), jnp.float32),
+        ],
         interpret=interpret,
     )(
         block_tables,
@@ -152,6 +180,72 @@ def paged_decode_attention(
         q,
         k_pages.reshape(num_pages, P, H_kv * d),
         v_pages.reshape(num_pages, P, H_kv * d),
+    )
+    return acc, m[:, 0], l[:, 0]
+
+
+def paged_decode_attention(
+    q: jax.Array,  # [S, H, d]
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d]
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # [S, max_pages] int32
+    seq_lens: jax.Array,  # [S] int32 — valid tokens per slot (already written)
+    interpret: bool = False,
+) -> jax.Array:
+    """Attention over written pages only (the classic form)."""
+    acc, _m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
+    out = acc / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def paged_decode_attention_cache_plus_new(
+    q: jax.Array,  # [S, H, d]
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — WITHOUT the new token
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,  # [S] — tokens valid in the PAGES (excl. new)
+    k_new: jax.Array,  # [S, H_kv, d]
+    v_new: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Kernel over the read-only pages + the new token's self term, merged
+    outside the kernel (one more online-softmax fold, fused elementwise)."""
+    S, H, d = q.shape
+    H_kv = k_pages.shape[2]
+    r = H // H_kv
+    acc, m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
+    self_logit = (
+        jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale
+    ).reshape(S, H)
+    m2 = jnp.maximum(m, self_logit)
+    corr = jnp.exp(m - m2)
+    p_self = jnp.exp(self_logit - m2)
+    l2 = l * corr + p_self
+    v_new_rep = (
+        v_new.astype(jnp.float32)[:, :, None, :]
+        .repeat(r, axis=2)
+        .reshape(S, H, d)
+    )
+    out = (acc * corr[..., None] + p_self[..., None] * v_new_rep) / jnp.maximum(
+        l2, 1e-30
+    )[..., None]
+    return out.astype(q.dtype)
+
+
+def _shard_wrap(fn, mesh, interpret, extra_sharded=()):
+    from jax.sharding import PartitionSpec as P
+
+    q_spec = P(None, "tp", None)
+    pages_spec = P(None, None, "tp", None)
+    in_specs = (q_spec, pages_spec, pages_spec, P(None, None), P(None)) + extra_sharded
+    return jax.shard_map(
+        functools.partial(fn, interpret=interpret),
+        mesh=mesh,
+        in_specs=in_specs,
+        out_specs=q_spec,
+        check_vma=False,
     )
 
 
@@ -167,14 +261,28 @@ def paged_decode_attention_sharded(
     """tp>1 wrapper: GSPMD treats pallas_call as opaque, so we shard_map it —
     each shard runs the kernel over its local head slice (attention is
     head-parallel; page tables are shared), no collectives needed."""
+    return _shard_wrap(paged_decode_attention, mesh, interpret)(
+        q, k_pages, v_pages, block_tables, seq_lens
+    )
+
+
+def paged_decode_attention_cache_plus_new_sharded(
+    mesh,
+    q: jax.Array,
+    k_pages: jax.Array,
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,
+    k_new: jax.Array,  # [S, H_kv, d] — KV heads sharded over 'tp'
+    v_new: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
     from jax.sharding import PartitionSpec as P
 
-    q_spec = P(None, "tp", None)
-    pages_spec = P(None, None, "tp", None)
-    return jax.shard_map(
-        functools.partial(paged_decode_attention, interpret=interpret),
-        mesh=mesh,
-        in_specs=(q_spec, pages_spec, pages_spec, P(None, None), P(None)),
-        out_specs=q_spec,
-        check_vma=False,
-    )(q, k_pages, v_pages, block_tables, seq_lens)
+    new_spec = P(None, "tp", None)
+    return _shard_wrap(
+        paged_decode_attention_cache_plus_new,
+        mesh,
+        interpret,
+        extra_sharded=(new_spec, new_spec),
+    )(q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new)

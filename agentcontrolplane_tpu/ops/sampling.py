@@ -3,6 +3,14 @@
 Per-slot parameters (each sequence in the continuous batch can carry its own
 LLM object's sampling config, reference ``llm_types.go:41-71``): temperature
 == 0 means greedy. All math in float32.
+
+TPU note: the textbook top-k/top-p implementation sorts the [S, V] logits
+twice per step — two bitonic sorts over the vocab dominate the whole
+sampler (~4ms/step at [64, 32k] on v5e, comparable to a bench-1b layer
+stack). Both masks only need a *threshold*, so we binary-search the
+threshold value instead: ~32 fused compare+reduce passes, an order of
+magnitude cheaper, and exact up to float bisection (ties at the boundary
+are all kept — the sort-based variant kept an arbitrary subset of ties).
 """
 
 from __future__ import annotations
@@ -11,6 +19,50 @@ import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+_BISECT_ITERS = 32
+
+
+def _topk_threshold(logits: jax.Array, k: jax.Array) -> jax.Array:
+    """Per-row value t such that count(logits >= t) >= k and masking
+    logits < t keeps the k largest (plus boundary ties). k >= V keeps all.
+    [S, V], [S] -> [S, 1]."""
+    lo = jnp.min(logits, axis=-1)  # threshold below lowest keeps everything
+    hi = jnp.max(logits, axis=-1)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        count = jnp.sum(logits >= mid[:, None], axis=-1)
+        ok = count >= k  # mid keeps enough -> can raise the floor
+        return jnp.where(ok, mid, lo), jnp.where(ok, hi, mid)
+
+    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
+    return lo[:, None]
+
+
+def _topp_threshold(
+    logits: jax.Array, top_p: jax.Array
+) -> tuple[jax.Array, jax.Array]:
+    """Returns (prob threshold t [S, 1], probs [S, V]): keeping probs >= t
+    keeps exactly the nucleus — every token whose strictly-greater-prob mass
+    is < top_p. For top_p >= 1 the bisection converges toward 0, keeping all
+    tokens except those with probability below ~max_p * 2^-32 (which the old
+    sort-based cumsum also effectively never sampled)."""
+    probs = jax.nn.softmax(logits, axis=-1)
+    lo = jnp.zeros(probs.shape[0])  # prob-space threshold
+    hi = jnp.max(probs, axis=-1)
+
+    def body(_, carry):
+        lo, hi = carry
+        mid = 0.5 * (lo + hi)
+        mass_above = jnp.sum(jnp.where(probs > mid[:, None], probs, 0.0), axis=-1)
+        ok = mass_above < top_p  # mid admits the whole nucleus -> go lower
+        return jnp.where(ok, lo, mid), jnp.where(ok, mid, hi)
+
+    lo, hi = jax.lax.fori_loop(0, _BISECT_ITERS, body, (lo, hi))
+    # hi is the smallest valid prob threshold; the call site compares in
+    # prob space directly (no need to map back to logits)
+    return hi[:, None], probs
 
 
 def sample(
@@ -25,24 +77,13 @@ def sample(
     S, V = logits.shape
 
     # top-k mask: keep the k largest (k==0 -> keep all)
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]  # [S, V]
     k = jnp.where(top_k > 0, top_k, V)
-    kth = jnp.take_along_axis(
-        sorted_desc, jnp.clip(k - 1, 0, V - 1)[:, None], axis=-1
-    )  # [S, 1]
+    kth = _topk_threshold(logits, k)
     logits = jnp.where(logits < kth, NEG_INF, logits)
 
     # top-p (nucleus) mask over the remaining distribution
-    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
-    probs_sorted = jax.nn.softmax(sorted_desc, axis=-1)
-    cumprobs = jnp.cumsum(probs_sorted, axis=-1)
-    # keep tokens while cumulative prob (exclusive) < top_p
-    keep_sorted = (cumprobs - probs_sorted) < top_p[:, None]
-    # threshold = smallest logit still kept
-    thresh = jnp.min(
-        jnp.where(keep_sorted, sorted_desc, jnp.inf), axis=-1, keepdims=True
-    )
-    logits = jnp.where(logits < thresh, NEG_INF, logits)
+    p_thresh, probs = _topp_threshold(logits, top_p)
+    logits = jnp.where(probs < p_thresh, NEG_INF, logits)
 
     greedy = jnp.argmax(logits, axis=-1)
     temp = jnp.maximum(temperature, 1e-6)[:, None]

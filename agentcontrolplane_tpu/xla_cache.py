@@ -1,0 +1,51 @@
+"""Persistent XLA compilation cache.
+
+TPU compiles are the dominant cold-start cost (20-40s per jit entry
+through the axon remote-compile service), and the serving engine has a
+bounded-but-real matrix of programs (prefill buckets x batch sizes,
+decode widths, constrained variants). The persistent cache makes every
+compile a once-per-machine cost instead of once-per-process: the second
+`acp-tpu run`, the driver's round-end `bench.py`, and every test process
+reuse the same compiled artifacts.
+
+Enabled by default; opt out with ``ACP_XLA_CACHE=0`` or point
+``ACP_XLA_CACHE_DIR`` somewhere else (default ``~/.cache/acp_tpu_xla``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+
+log = logging.getLogger("acp_tpu.xla_cache")
+
+_enabled = False
+
+
+def enable_persistent_compilation_cache() -> bool:
+    """Idempotent; safe to call before or after backend init (jax only
+    consults the config at compile time). Returns True when active."""
+    global _enabled
+    if _enabled:
+        return True
+    if os.environ.get("ACP_XLA_CACHE", "1") in ("0", "false", "no"):
+        return False
+    cache_dir = os.environ.get("ACP_XLA_CACHE_DIR") or os.path.join(
+        os.path.expanduser("~"), ".cache", "acp_tpu_xla"
+    )
+    try:
+        os.makedirs(cache_dir, exist_ok=True)
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        # cache everything: the engine's programs are individually small but
+        # numerous, and the default min-compile-time filter would skip the
+        # narrow decode widths whose recompiles still cost a tunnel RTT
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        _enabled = True
+        log.info("persistent XLA compilation cache at %s", cache_dir)
+    except Exception as e:  # never let cache plumbing break serving
+        log.warning("persistent compilation cache unavailable: %s", e)
+        return False
+    return True

@@ -145,6 +145,8 @@ def main() -> None:
     config = PRESETS[preset]
     if config.max_seq_len < max_ctx:  # small presets (tiny) honor the knob
         config = dataclasses.replace(config, max_seq_len=max_ctx)
+    ttft_on = os.environ.get("ACP_BENCH_TTFT", "1") != "0"
+
     def build_engine(layout: str):
         eng = Engine(
             config=config,
@@ -158,6 +160,12 @@ def main() -> None:
             quantize=quantize,
             seed=0,
         )
+        if ttft_on:
+            # build the constraint token table up front so EVERY program in
+            # this process (headline warm included) traces against the real
+            # table shape — otherwise the TTFT phase's table build would
+            # orphan the dummy-shaped compiles the headline phase paid for
+            eng._get_token_table()
         eng.start()
         return eng
 
@@ -214,7 +222,7 @@ def main() -> None:
     )
 
     extra: dict = {}
-    if os.environ.get("ACP_BENCH_TTFT", "1") != "0":
+    if ttft_on:
         try:
             extra["ttft_first_toolcall_ms"] = _bench_ttft(engine)
         except Exception as e:  # TTFT failure must not lose the headline number
@@ -282,22 +290,13 @@ def _bench_ttft(engine) -> dict:
         # can't fit; the generation would hit max_ctx before closing the JSON
         return {"skipped": f"engine max_ctx {engine.max_ctx} < 256", "n": 0}
 
-    # warm the constrained-decoding jit entries (token table, forced prefill
-    # batches, constrained decode at every width the burst will hit) outside
-    # the measured window
-    prefix = tuple(engine.tokenizer.encode('{"name": "delegate_to_agent__leaf", "arguments": {'))
-    # long warm prompts land in the SAME (largest) prefill bucket the
-    # operator's rendered system+tools prompts use
-    warm_prompt = "warm " * (engine.prefill_buckets[-1] // 2)
-    warm = [
-        engine.submit(
-            f"{i} {warm_prompt}",
-            SamplingParams(max_tokens=4, json_only=True, forced_prefix=prefix),
-        )
-        for i in range(n_tasks)
-    ]
-    for f in warm:
-        f.result(timeout=600)
+    # compile every program the staggered operator traffic will hit (token
+    # table, every prefill bucket x batch size, every decode width) OUTSIDE
+    # the measured window. The previous ad-hoc warm here missed the
+    # mid-size batches and narrow widths that staggered reconcile arrivals
+    # produce — each miss was a 20-40s tunnel compile COUNTED INTO TTFT
+    # (r1's 41s p50 was compile stalls, not serving latency).
+    engine.prewarm(constrained=True)
 
     async def run() -> dict:
         op = Operator(

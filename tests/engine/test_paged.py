@@ -115,6 +115,83 @@ def test_page_allocator():
         a.alloc(8)
 
 
+def test_pallas_cache_plus_new_matches_reference_interpret():
+    """The serving hot-path form (read-only pages + self term, merged from
+    the kernel's unnormalized (acc, m, l)) == the exact XLA reference."""
+    from agentcontrolplane_tpu.ops.paged import (
+        paged_decode_attention_reference_cache_plus_new,
+    )
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_cache_plus_new,
+    )
+
+    for seed, kw in ((3, {}), (4, dict(S=3, H=8, Hkv=2, d=16, P=8, max_pages=4, num_pages=16))):
+        q, k_pages, v_pages, tables, seq_lens, _ = _setup(seed=seed, **kw)
+        rng = np.random.default_rng(seed + 10)
+        Hkv, d = k_pages.shape[2], k_pages.shape[3]
+        S = q.shape[0]
+        k_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+        v_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+        ref = paged_decode_attention_reference_cache_plus_new(
+            q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+        )
+        out = paged_decode_attention_cache_plus_new(
+            q, k_pages, v_pages, tables, seq_lens, k_new, v_new, interpret=True
+        )
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_reference_cache_plus_new_equals_write_then_attend():
+    """The self-term form must equal writing the token then attending —
+    the two decode formulations are semantically identical."""
+    from agentcontrolplane_tpu.ops.paged import (
+        paged_decode_attention_reference_cache_plus_new,
+    )
+
+    q, k_pages, v_pages, tables, seq_lens, _ = _setup(seed=5)
+    rng = np.random.default_rng(15)
+    S, (Hkv, d) = q.shape[0], k_pages.shape[2:]
+    k_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    active = jnp.ones(S, dtype=bool)
+    with_self = paged_decode_attention_reference_cache_plus_new(
+        q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+    )
+    kw, vw = write_token_to_pages(
+        k_pages, v_pages, tables, seq_lens, active, k_new, v_new
+    )
+    written = paged_decode_attention_reference(q, kw, vw, tables, seq_lens + 1)
+    np.testing.assert_allclose(
+        np.asarray(with_self), np.asarray(written), rtol=1e-5, atol=1e-5
+    )
+
+
+def test_pallas_cache_plus_new_sharded_tp2_interpret():
+    from agentcontrolplane_tpu.ops.paged import (
+        paged_decode_attention_reference_cache_plus_new,
+    )
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_cache_plus_new_sharded,
+    )
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    q, k_pages, v_pages, tables, seq_lens, _ = _setup(
+        seed=6, S=3, H=8, Hkv=2, d=16, P=8, max_pages=4, num_pages=16
+    )
+    rng = np.random.default_rng(16)
+    S, (Hkv, d) = q.shape[0], k_pages.shape[2:]
+    k_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    ref = paged_decode_attention_reference_cache_plus_new(
+        q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+    )
+    out = paged_decode_attention_cache_plus_new_sharded(
+        mesh, q, k_pages, v_pages, tables, seq_lens, k_new, v_new, interpret=True
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
 def test_pallas_kernel_sharded_tp2_interpret():
     """shard_map wrapper over head-sharded pages (tp=2) == reference."""
     import jax

@@ -1,0 +1,90 @@
+"""Bisection-threshold sampler vs an exact numpy nucleus/top-k oracle.
+
+The sampler replaces the two full-vocab sorts with threshold binary
+searches (ops/sampling.py); these tests pin the masking semantics: a
+sampled token must always lie inside the exact allowed set, and greedy
+(temperature 0) must be untouched by the masks.
+"""
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from agentcontrolplane_tpu.ops.sampling import sample
+
+
+def _exact_allowed(logits: np.ndarray, top_k: int, top_p: float) -> set:
+    """Oracle: indices surviving top-k (keep k largest, ties kept) then
+    top-p (keep tokens whose strictly-greater-prob mass is < top_p)."""
+    V = logits.shape[0]
+    x = logits.astype(np.float64).copy()
+    if top_k > 0 and top_k < V:
+        kth = np.sort(x)[::-1][top_k - 1]
+        x[x < kth] = -np.inf
+    e = np.exp(x - np.max(x[np.isfinite(x)]))
+    e[~np.isfinite(x)] = 0.0
+    p = e / e.sum()
+    allowed = set()
+    # mass of strictly-greater-probability tokens, per token
+    for i in range(V):
+        if p[i] <= 0:
+            continue
+        mass_above = p[p > p[i]].sum()
+        if mass_above < top_p:
+            allowed.add(i)
+    return allowed
+
+
+def test_sampled_tokens_stay_inside_exact_nucleus():
+    rng = np.random.default_rng(0)
+    V, S = 64, 4
+    logits_np = rng.normal(scale=3.0, size=(S, V)).astype(np.float32)
+    logits = jnp.asarray(logits_np)
+    temps = jnp.asarray([0.7, 1.3, 0.9, 2.0])
+    top_ks = jnp.asarray([0, 5, 3, 8], dtype=jnp.int32)
+    top_ps = jnp.asarray([0.8, 1.0, 0.5, 0.9])
+    allowed = [
+        _exact_allowed(logits_np[s], int(top_ks[s]), float(top_ps[s]))
+        for s in range(S)
+    ]
+    for trial in range(64):
+        toks = np.asarray(
+            sample(logits, jax.random.key(trial), temps, top_ks, top_ps)
+        )
+        for s in range(S):
+            assert int(toks[s]) in allowed[s], (
+                f"slot {s} trial {trial}: token {toks[s]} outside exact "
+                f"top_k={int(top_ks[s])}/top_p={float(top_ps[s])} set"
+            )
+
+
+def test_greedy_unaffected_by_masks():
+    rng = np.random.default_rng(1)
+    logits_np = rng.normal(size=(3, 128)).astype(np.float32)
+    toks = np.asarray(
+        sample(
+            jnp.asarray(logits_np),
+            jax.random.key(0),
+            jnp.zeros(3),  # temperature 0 -> greedy
+            jnp.asarray([4, 0, 1], dtype=jnp.int32),
+            jnp.asarray([0.3, 0.01, 1.0]),
+        )
+    )
+    np.testing.assert_array_equal(toks, logits_np.argmax(-1))
+
+
+def test_top_k_one_is_greedy_even_at_high_temperature():
+    rng = np.random.default_rng(2)
+    logits_np = rng.normal(size=(2, 256)).astype(np.float32)
+    for trial in range(16):
+        toks = np.asarray(
+            sample(
+                jnp.asarray(logits_np),
+                jax.random.key(trial),
+                jnp.full((2,), 5.0),
+                jnp.ones(2, dtype=jnp.int32),  # top_k=1
+                jnp.ones(2),
+            )
+        )
+        np.testing.assert_array_equal(toks, logits_np.argmax(-1))

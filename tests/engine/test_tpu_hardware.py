@@ -87,6 +87,50 @@ def test_compiled_pallas_gqa_shapes(tpu):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
 
 
+def test_compiled_pallas_gemma_geometry(tpu):
+    """head_dim 256 (gemma's head_dim_override) with MQA compiles and
+    matches — the engine's Pallas gate admits head_dim % 128 == 0."""
+    import jax
+
+    from agentcontrolplane_tpu.ops.paged import paged_decode_attention_reference
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import paged_decode_attention
+
+    q, k_pages, v_pages, tables, seq_lens = _setup_tpu_shapes(
+        seed=2, S=4, H=8, Hkv=1, d=256, P=16, max_pages=4, num_pages=32
+    )
+    ref = jax.jit(paged_decode_attention_reference)(q, k_pages, v_pages, tables, seq_lens)
+    out = jax.jit(paged_decode_attention)(q, k_pages, v_pages, tables, seq_lens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
+def test_compiled_pallas_cache_plus_new(tpu):
+    """The serving hot-path form (kernel (acc,m,l) + external self-term
+    merge) compiled on hardware == the XLA reference."""
+    import jax
+    import jax.numpy as jnp
+
+    from agentcontrolplane_tpu.ops.paged import (
+        paged_decode_attention_reference_cache_plus_new,
+    )
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_cache_plus_new,
+    )
+
+    q, k_pages, v_pages, tables, seq_lens = _setup_tpu_shapes(seed=3)
+    rng = np.random.default_rng(13)
+    S = q.shape[0]
+    Hkv, d = k_pages.shape[2], k_pages.shape[3]
+    k_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    ref = jax.jit(paged_decode_attention_reference_cache_plus_new)(
+        q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+    )
+    out = jax.jit(paged_decode_attention_cache_plus_new)(
+        q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+
 def test_engine_slot_and_paged_agree_on_tpu(tpu):
     """Greedy decode through BOTH kv layouts on hardware must produce the
     same tokens (the paged path uses the compiled Pallas kernel: engine
@@ -97,7 +141,13 @@ def test_engine_slot_and_paged_agree_on_tpu(tpu):
     from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
     from agentcontrolplane_tpu.models.llama import PRESETS
 
-    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    # hardware-native geometry (head_dim 128) so the paged engine takes the
+    # compiled Pallas path — the tiny CPU config's head_dim 16 would fall
+    # back to the XLA reference and test nothing new here
+    cfg = dataclasses.replace(
+        PRESETS["tiny"], vocab_size=512, dim=512, n_heads=4, n_kv_heads=2,
+        head_dim_override=128,
+    )
     results = {}
     for layout in ("slot", "paged"):
         eng = Engine(
