@@ -155,7 +155,7 @@ class Engine:
         max_ctx: int = 2048,
         prefill_buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048),
         prefill_batch_max: int = 8,  # burst admissions batch up to this many prompts
-        width_buckets: Sequence[int] = (1, 2, 4, 8),  # low-occupancy decode widths
+        width_buckets: Sequence[int] = (1, 2, 4, 8, 16, 32),  # low-occupancy decode widths
         prefix_cache_entries: int = 4,  # 0 disables (slot: KV copies; paged: shared pages)
         prefix_cache_max_tokens: int = 4096,  # HBM bound: total cached KV tokens
         decode_block_size: int = 8,
@@ -199,7 +199,17 @@ class Engine:
         )
 
         t0 = time.monotonic()
-        if params is None:
+        if quantize not in (None, "int8"):
+            raise ValueError(f"unsupported quantization {quantize!r}")
+        tp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
+        if params is None and quantize == "int8" and tp_size == 1:
+            # host-side quantized random init: the device-init path below
+            # peaks at the FULL bf16 model + one tensor (16GB for 8B — by
+            # itself a whole v5e chip); this one only ever places int8+scales
+            from .weights import random_quantized_init
+
+            params = random_quantized_init(config, seed=seed)
+        elif params is None:
             from ..models.llama import init_params as _init
 
             abstract = jax.eval_shape(lambda k: _init(config, k), jax.random.key(0))
@@ -207,8 +217,6 @@ class Engine:
             params = jax.jit(
                 lambda k: _init(config, k), out_shardings=shardings
             )(jax.random.key(seed))
-        if quantize not in (None, "int8"):
-            raise ValueError(f"unsupported quantization {quantize!r}")
         if quantize == "int8":
             # Quantize per-matrix, dropping each bf16 original as its int8
             # replacement lands (in-place layer-dict mutation) so peak device

@@ -189,3 +189,68 @@ def sharded_init(
     return jax.tree_util.tree_map(
         lambda x, s: jax.device_put(x, s), params, shardings
     )
+
+
+def random_quantized_init(config: LlamaConfig, seed: int = 0) -> dict:
+    """Random int8 params built HOST-SIDE tensor-by-tensor (benchmarks).
+
+    The device-init-then-quantize path peaks at the full bf16 model plus
+    one tensor — 16GB for Llama-3-8B, which alone fills a v5e chip. This
+    mirrors the load-time quantization of ``params_from_state_dict``: each
+    quantizable matrix is generated and quantized in host RAM and only the
+    int8 values + f32 scales (plus the bf16 embeddings/norms/head) ever
+    reach the device. Same pytree layout as ``models.llama.init_params``."""
+    from ..ops.quant import QUANTIZABLE, QuantizedTensor
+
+    c = config
+    rng = np.random.default_rng(seed)
+    d, hd = c.dim, c.head_dim
+    scale = d**-0.5
+
+    def put(arr: np.ndarray, keep_dtype: bool = False) -> jax.Array:
+        return jnp.asarray(arr, dtype=arr.dtype if keep_dtype else c.dtype)
+
+    def quantized(shape: tuple, init_scale: float) -> QuantizedTensor:
+        stacked = (
+            rng.standard_normal((c.n_layers, *shape), dtype=np.float32) * init_scale
+        )
+        absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
+        qscale = np.maximum(absmax, 1e-8) / 127.0
+        q = np.clip(np.round(stacked / qscale), -127, 127).astype(np.int8)
+        return QuantizedTensor(
+            q=put(q, keep_dtype=True), scale=put(qscale.astype(np.float32), True)
+        )
+
+    shapes = {
+        "wq": ((d, c.n_heads * hd), scale),
+        "wk": ((d, c.n_kv_heads * hd), scale),
+        "wv": ((d, c.n_kv_heads * hd), scale),
+        "wo": ((c.n_heads * hd, d), scale),
+        "w1": ((d, c.ffn_dim), scale),
+        "w3": ((d, c.ffn_dim), scale),
+        "w2": ((c.ffn_dim, d), c.ffn_dim**-0.5),
+    }
+    layers: dict = {
+        "ln1": put(np.ones((c.n_layers, d), dtype=np.float32)),
+        "ln2": put(np.ones((c.n_layers, d), dtype=np.float32)),
+    }
+    for key, (shape, s) in shapes.items():
+        assert key in QUANTIZABLE
+        layers[key] = quantized(shape, s)
+    if c.qkv_bias:
+        for key, width in (
+            ("bq", c.n_heads * hd), ("bk", c.n_kv_heads * hd), ("bv", c.n_kv_heads * hd),
+        ):
+            layers[key] = put(np.zeros((c.n_layers, width), dtype=np.float32))
+    params = {
+        "embed": put(
+            rng.standard_normal((c.vocab_size, d), dtype=np.float32) * scale
+        ),
+        "layers": layers,
+        "norm": put(np.ones((d,), dtype=np.float32)),
+    }
+    if not c.tie_embeddings:
+        params["lm_head"] = put(
+            rng.standard_normal((d, c.vocab_size), dtype=np.float32) * scale
+        )
+    return params

@@ -314,7 +314,15 @@ def _bench_ttft(engine) -> dict:
                 metadata=ObjectMeta(name="tpu-llm"),
                 spec=LLMSpec(
                     provider="tpu",
-                    parameters=BaseConfig(model=preset, max_tokens=48, temperature=0.7),
+                    # tight tool-call budget: the grammar's budget-aware
+                    # closure always yields a COMPLETE JSON object within
+                    # max_tokens, and time-to-first-ToolCall includes the
+                    # whole generation — every extra token is pure latency
+                    parameters=BaseConfig(
+                        model=preset,
+                        max_tokens=int(os.environ.get("ACP_BENCH_TTFT_MAX_TOKENS", "24")),
+                        temperature=0.7,
+                    ),
                     tpu=TPUProviderConfig(preset=preset),
                     provider_config={"tool_choice": "required"},
                 ),

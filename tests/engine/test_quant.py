@@ -84,6 +84,30 @@ def test_engine_serves_int8():
         eng.stop()
 
 
+def test_engine_tp1_int8_host_side_random_init():
+    """tp=1 + quantize=int8 + no params takes the host-side quantized init
+    (the device path would peak at the full bf16 model — 16GB for 8B): the
+    quantizable leaves arrive as QuantizedTensor and the engine serves."""
+    cfg = dataclasses.replace(TINY, vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]),
+        max_slots=2,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        quantize="int8",
+    )
+    assert isinstance(eng.params["layers"]["w1"], QuantizedTensor)
+    assert eng.params["layers"]["w1"].q.dtype == jnp.int8
+    eng.start()
+    try:
+        r = eng.generate("hello int8", SamplingParams(temperature=0.0, max_tokens=6))
+        assert r.finish_reason in ("stop", "length")
+    finally:
+        eng.stop()
+
+
 def test_engine_rejects_unknown_quantization():
     with pytest.raises(ValueError, match="unsupported quantization"):
         Engine(config=TINY, quantize="fp4", mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]))
