@@ -201,8 +201,7 @@ class Engine:
         t0 = time.monotonic()
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantization {quantize!r}")
-        tp_size = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
-        if params is None and quantize == "int8" and tp_size == 1:
+        if params is None and quantize == "int8" and tp == 1:
             # host-side quantized random init: the device-init path below
             # peaks at the FULL bf16 model + one tensor (16GB for 8B — by
             # itself a whole v5e chip); this one only ever places int8+scales
@@ -333,6 +332,12 @@ class Engine:
         # slots are released at the next engine-loop iteration so orphaned
         # generations don't pin capacity to max_tokens
         self._cancelled: set[str] = set()
+        # device-resident decode state (see _decode_once): None until the
+        # first block; _state_dirty forces a re-upload of the host mirrors
+        # whenever slot assignment changed (admission/finish/cancel/restart)
+        self._dev: Optional[dict] = None
+        self._state_dirty = True
+        self._tables_dirty = True
         self.decode_steps = 0
         self.tokens_generated = 0
 
@@ -381,12 +386,21 @@ class Engine:
             return toks, new_states
 
         def make_decode_block(step_fn):
+            # trace-time constants: finish detection runs ON DEVICE so decode
+            # blocks can chain device-resident state (see _decode_once) —
+            # a slot that samples a stop token, exhausts its budget, or hits
+            # the context edge deactivates itself mid-block and stops
+            # advancing/writing, keeping the device state consistent with the
+            # host's bookkeeping without a per-block re-upload.
+            stop_toks = tuple(sorted({int(t) for t in self.tokenizer.stop_tokens}))
+            max_ctx = self.max_ctx
+
             def decode_block(
                 params, cache, tokens, seq_lens, active, rng, temps, top_ks, top_ps,
                 table, con_states, constrained, min_close, budgets, *extra,
             ):
                 def step(carry, _):
-                    cache, tokens, seq_lens, con_states, budgets, rng = carry
+                    cache, tokens, seq_lens, con_states, budgets, active, rng = carry
                     rng, sub = jax.random.split(rng)
                     cache, logits = step_fn(params, cache, tokens, seq_lens, active, *extra)
                     logits = constrain_logits(
@@ -397,15 +411,19 @@ class Engine:
                     con_states = advance_constraint(table, con_states, constrained, next_toks)
                     seq_lens = seq_lens + active.astype(jnp.int32)
                     budgets = budgets - active.astype(jnp.int32)
-                    return (cache, next_toks, seq_lens, con_states, budgets, rng), next_toks
+                    is_stop = jnp.zeros_like(active)
+                    for st in stop_toks:
+                        is_stop = is_stop | (next_toks == st)
+                    active = active & ~is_stop & (budgets > 0) & (seq_lens + 1 < max_ctx)
+                    return (cache, next_toks, seq_lens, con_states, budgets, active, rng), next_toks
 
-                (cache, tokens, seq_lens, con_states, budgets, rng), toks = jax.lax.scan(
-                    step, (cache, tokens, seq_lens, con_states, budgets, rng), None,
+                (cache, tokens, seq_lens, con_states, budgets, active, rng), toks = jax.lax.scan(
+                    step, (cache, tokens, seq_lens, con_states, budgets, active, rng), None,
                     length=self.decode_block_size,
                 )
-                return cache, toks, con_states
+                return cache, toks, (tokens, seq_lens, con_states, budgets, active, rng)
 
-            return jax.jit(decode_block, donate_argnums=(1,))
+            return jax.jit(decode_block, donate_argnums=(1, 2, 3, 4, 5, 10, 13))
 
         if self.kv_layout == "paged":
             from ..models.llama import (
@@ -471,6 +489,9 @@ class Engine:
         """(Re)build the device KV cache and host allocator state — shared
         by __init__ and crash recovery (ensure_running) so the restart path
         can never diverge from fresh construction."""
+        self._dev = None
+        self._state_dirty = True
+        self._tables_dirty = True
         if self.kv_layout == "slot":
             self.cache = jax.jit(
                 lambda: init_kv_cache(self.config, self.max_slots, self.max_ctx),
@@ -1288,9 +1309,9 @@ class Engine:
             temps[i] = s.temperature
             top_ks[i] = s.top_k
             top_ps[i] = s.top_p
-            # ctx-bounded: 1 token now + whole decode blocks that still fit
-            K = self.decode_block_size
-            budgets[i] = min(s.max_tokens, 1 + ((self.max_ctx - plen) // K) * K)
+            # ctx-bounded: 1 token now + decode capacity to the ctx edge
+            # (the decode block deactivates the slot device-side at max_ctx-1)
+            budgets[i] = min(s.max_tokens, 1 + max(0, self.max_ctx - 1 - plen))
             if s.json_only:
                 con_states0[i] = (
                     self._seed_con_state(s.forced_prefix)
@@ -1360,6 +1381,7 @@ class Engine:
         # one combined round trip (see _decode_once; the tunnel RTT floor
         # applies per fetch, not per byte)
         firsts, con_states = jax.device_get((firsts, con_states))
+        self._state_dirty = True  # new slots: decode must re-upload state
         now = time.monotonic()
         for i, (req, slot, _, _m) in enumerate(chunk):
             s = req.sampling
@@ -1399,11 +1421,12 @@ class Engine:
         K = self.decode_block_size
         for slot in list(self._slots):
             needed = -(-(int(self._seq_lens[slot]) + K) // self.page_size)
-            if needed > self.max_pages_per_seq:
-                # can't guarantee K in-bounds steps: finishing here keeps the
-                # kernel's page walk inside the block table
-                self._finish(slot, "length")
-                continue
+            # ctx edge: the decode block deactivates the slot on device at
+            # max_ctx-1, so a fully-populated table is always enough — clamp
+            # instead of force-finishing (a force-finish here could truncate
+            # a json_only generation whose budget-aware closure planned on
+            # the last few tokens before the edge)
+            needed = min(needed, self.max_pages_per_seq)
             have = len(self._slot_pages.get(slot, []))
             if needed <= have:
                 continue
@@ -1415,6 +1438,7 @@ class Engine:
             table = self._slot_pages[slot]
             self._block_tables[slot, have : have + len(new_pages)] = new_pages
             table.extend(new_pages)
+            self._tables_dirty = True
 
     def _decode_once(self) -> None:
         if self._cancelled:
@@ -1424,70 +1448,88 @@ class Engine:
         if not self._slots:
             return
         K = self.decode_block_size
-        # Pre-finish slots that can't take K more tokens in-bounds: a block
-        # starting at s0 writes positions s0..s0+K-1 and reads at most s0+K
-        # entries, so dispatch is safe iff s0 + K <= max_ctx. The block runs
-        # unconditionally on device and paged page walks must never step
-        # past the block table (slot mode merely clamps harmlessly).
-        for slot in list(self._slots):
-            if int(self._seq_lens[slot]) + K > self.max_ctx:
-                self._finish(slot, "length")
-        if not self._slots:
-            return
         if self.kv_layout == "paged":
             self._ensure_pages_for_block()
             if not self._slots:
                 return
-        # width bucketing: dispatch the smallest compiled width covering the
-        # active slots (allocation is lowest-slot-first, so occupancy stays
-        # compacted) — one live request doesn't pay max_slots of compute
-        max_active = max(self._slots) + 1
-        W = next(w for w in self.width_buckets if w >= max_active)
-        active_mask = np.zeros(W, dtype=bool)
-        for slot in self._slots:
-            active_mask[slot] = True
-        self._rng, step_rng = jax.random.split(self._rng)
-        # once the token table exists it is passed unconditionally (matching
-        # the prefill path): keying jit entries on "any slot constrained"
-        # would DOUBLE the decode-width program matrix, and the table is a
-        # device-resident array with no per-dispatch transfer cost
-        use_real = self._token_table is not None
-        table = self._token_table if use_real else self._dummy_table
-        min_close = self._min_close if use_real else self._dummy_min_close
-        for slot, sl in self._slots.items():
-            token_left = sl.request.sampling.max_tokens - (
-                len(sl.generated) - sl.prefix_len
-            )
-            # ctx bound: the slot is force-finished once the next block can't
-            # fit, so only whole blocks of capacity remain
-            ctx_left = ((self.max_ctx - int(self._seq_lens[slot])) // K) * K
-            self._budgets[slot] = min(token_left, ctx_left)
+        # Device-resident decode state: the per-slot arrays (tokens,
+        # seq_lens, con_states, budgets, active, rng) round-trip through the
+        # decode block's carry and are fed back DONATED on the next block.
+        # Only a "dirty" block — admission, finish, cancel (anything that
+        # changed host-side slot assignment) — re-uploads the host mirrors.
+        # Through a high-RTT link (axon tunnel ~80ms/transfer) the old
+        # upload-8-arrays-every-block pattern cost ~10x the block compute;
+        # steady-state blocks now cost one dispatch + one result fetch.
+        if self._state_dirty or self._dev is None:
+            # width bucketing: dispatch the smallest compiled width covering
+            # the active slots (allocation is lowest-slot-first, so occupancy
+            # stays compacted) — one live request doesn't pay max_slots of
+            # compute. Width is recomputed only on dirty blocks; finishes
+            # mark dirty, so the decay through narrower widths is preserved.
+            max_active = max(self._slots) + 1
+            W = next(w for w in self.width_buckets if w >= max_active)
+            active_mask = np.zeros(W, dtype=bool)
+            for slot in self._slots:
+                active_mask[slot] = True
+            self._rng, step_rng = jax.random.split(self._rng)
+            # once the token table exists it is passed unconditionally
+            # (matching the prefill path): keying jit entries on "any slot
+            # constrained" would DOUBLE the decode-width program matrix, and
+            # the table is a device-resident array with no per-dispatch
+            # transfer cost
+            use_real = self._token_table is not None
+            for slot, sl in self._slots.items():
+                token_left = sl.request.sampling.max_tokens - (
+                    len(sl.generated) - sl.prefix_len
+                )
+                # true remaining capacity: the device deactivates a slot
+                # after the token that lands it at max_ctx-1
+                ctx_left = self.max_ctx - 1 - int(self._seq_lens[slot])
+                self._budgets[slot] = max(0, min(token_left, ctx_left))
+            self._dev = {
+                "W": W,
+                "tokens": jnp.asarray(self._last_tokens[:W]),
+                "seq_lens": jnp.asarray(self._seq_lens[:W]),
+                "active": jnp.asarray(active_mask),
+                "rng": step_rng,
+                "temps": jnp.asarray(self._temps[:W]),
+                "top_ks": jnp.asarray(self._top_ks[:W]),
+                "top_ps": jnp.asarray(self._top_ps[:W]),
+                "table": self._token_table if use_real else self._dummy_table,
+                "con_states": jnp.asarray(self._con_states[:W]),
+                "constrained": jnp.asarray(self._constrained[:W]),
+                "min_close": self._min_close if use_real else self._dummy_min_close,
+                "budgets": jnp.asarray(self._budgets[:W]),
+            }
+            self._state_dirty = False
+        d = self._dev
+        W = d["W"]
         common = (
-            jnp.asarray(self._last_tokens[:W]),
-            jnp.asarray(self._seq_lens[:W]),
-            jnp.asarray(active_mask),
-            step_rng,
-            jnp.asarray(self._temps[:W]),
-            jnp.asarray(self._top_ks[:W]),
-            jnp.asarray(self._top_ps[:W]),
-            table,
-            jnp.asarray(self._con_states[:W]),
-            jnp.asarray(self._constrained[:W]),
-            min_close,
-            jnp.asarray(self._budgets[:W]),
+            d["tokens"], d["seq_lens"], d["active"], d["rng"],
+            d["temps"], d["top_ks"], d["top_ps"], d["table"],
+            d["con_states"], d["constrained"], d["min_close"], d["budgets"],
         )
         if self.kv_layout == "paged":
-            cache, tok_block, con_states = self._jit_decode_paged(
-                self.params, self.cache, *common, jnp.asarray(self._block_tables[:W])
+            # block tables ride the same dirty discipline: re-uploaded only
+            # when a page was appended (or the state itself was re-uploaded),
+            # not on every block
+            if self._tables_dirty or "block_tables" not in d:
+                d["block_tables"] = jnp.asarray(self._block_tables[:W])
+                self._tables_dirty = False
+            cache, tok_block, carry = self._jit_decode_paged(
+                self.params, self.cache, *common, d["block_tables"]
             )
         else:
-            cache, tok_block, con_states = self._jit_decode(
+            cache, tok_block, carry = self._jit_decode(
                 self.params, self.cache, *common
             )
+        d["tokens"], d["seq_lens"], con_states_dev, d["budgets"], d["active"], d["rng"] = carry
+        d["con_states"] = con_states_dev
         # ONE host round trip for both results — through a high-RTT link
-        # (axon tunnel ~80ms/fetch) sequential np.asarray fetches double the
-        # per-block latency floor
-        con_states, tok_block = jax.device_get((con_states, tok_block))
+        # sequential np.asarray fetches double the per-block latency floor.
+        # con_states must stay mirrored so the next dirty upload (admission
+        # into some other slot) doesn't clobber live automaton states.
+        con_states, tok_block = jax.device_get((con_states_dev, tok_block))
         self._con_states[:W] = con_states
         self.cache = cache
         # tok_block: [K, W]
@@ -1523,6 +1565,7 @@ class Engine:
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.pop(slot)
+        self._state_dirty = True  # device lane must be re-uploaded inactive
         self._cancelled.discard(sl.request.rid)
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0

@@ -46,6 +46,14 @@ class OperatorOptions:
     llm_probe: bool = True
     verify_channel_credentials: bool = True
     engine: object | None = None  # engine.Engine for provider: tpu
+    # Reconcile concurrency for the two hot controllers. A Task worker spends
+    # almost all its time awaiting the LLM send, so the worker count bounds how
+    # many requests the continuous-batching engine can see at once — 4 workers
+    # over 16 simultaneous Tasks means 4 serialized waves of prefill+decode.
+    # Size it to the engine's slot count, not to CPU parallelism (workers are
+    # coroutines; controller-runtime's MaxConcurrentReconciles equivalent).
+    task_workers: int = 32
+    toolcall_workers: int = 16
 
 
 class Operator:
@@ -130,12 +138,14 @@ class Operator:
             "Task",
             self.task_reconciler,
             owns=["ToolCall"],
+            workers=self.options.task_workers,
         )
         m.add_controller(
             "toolcall",
             "ToolCall",
             self.toolcall_reconciler,
             watches={"Task": map_owner("ToolCall")},
+            workers=self.options.toolcall_workers,
         )
 
     async def start(self) -> None:

@@ -106,6 +106,31 @@ def _wait_for_accelerator(attempt_timeout_s: float, window_s: float) -> bool:
         time.sleep(30)
 
 
+def _already_configured() -> bool:
+    """True when this process has already decided its jax platform — the CPU
+    smoke run (verify skill: jax_platforms forced to cpu before runpy) or a
+    live initialized backend. NOTE: ``"jax" in sys.modules`` is NOT the
+    right check in this image — the harness preimports jax into every
+    Python process, which silently skipped the whole wedge-resistant probe
+    path (round 1's instant 0.0 failure mode)."""
+    if "jax" not in sys.modules:
+        return False
+    import jax
+
+    try:
+        from jax._src import xla_bridge
+
+        if getattr(xla_bridge, "_backends", None):
+            return True  # a backend is already live; probing is moot
+    except Exception:
+        pass
+    try:
+        plats = jax.config.jax_platforms
+    except Exception:
+        return False
+    return bool(plats) and "cpu" in str(plats)
+
+
 def main() -> None:
     preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")
     n_requests = int(os.environ.get("ACP_BENCH_REQUESTS", "64"))
@@ -119,10 +144,15 @@ def main() -> None:
     probe_timeout = float(os.environ.get("ACP_BENCH_DEVICE_TIMEOUT_S", "120"))
 
     window_s = float(os.environ.get("ACP_BENCH_PROBE_WINDOW_S", "600"))
-    # if the caller already imported+configured jax (e.g. the CPU smoke run
-    # via runpy), the platform decision is made — skip tunnel probing
-    already_configured = "jax" in sys.modules
-    if not already_configured and not _wait_for_accelerator(min(probe_timeout, 60.0), window_s):
+    already_configured = _already_configured()
+    # one wall-clock deadline across re-execs (see below): a wedged tunnel
+    # can clear minutes later, but a hung in-process attach taints THIS
+    # process forever, so retries need a fresh process image
+    deadline_env = os.environ.get("ACP_BENCH_ATTACH_DEADLINE")
+    attach_deadline = float(deadline_env) if deadline_env else time.time() + window_s
+    if not already_configured and not _wait_for_accelerator(
+        min(probe_timeout, 60.0), max(60.0, attach_deadline - time.time())
+    ):
         _emit(
             0.0,
             f"FAILED: accelerator unreachable across {window_s:.0f}s retry window (wedged tunnel?)",
@@ -130,6 +160,17 @@ def main() -> None:
         return
     devices = _probe_devices(probe_timeout)
     if devices is None:
+        if not already_configured and time.time() < attach_deadline - 90:
+            print(
+                f"# in-process attach hung ({probe_timeout:.0f}s); re-exec for a "
+                f"fresh attempt, {attach_deadline - time.time():.0f}s left",
+                file=sys.stderr, flush=True,
+            )
+            env = dict(os.environ)
+            env["ACP_BENCH_ATTACH_DEADLINE"] = str(attach_deadline)
+            sys.stderr.flush()
+            sys.stdout.flush()
+            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
         _emit(0.0, f"FAILED: accelerator probe ok but jax.devices() hung within {probe_timeout:.0f}s")
         return
     n_chips = len(devices)
