@@ -204,53 +204,33 @@ def random_quantized_init(config: LlamaConfig, seed: int = 0) -> dict:
 
     c = config
     rng = np.random.default_rng(seed)
-    d, hd = c.dim, c.head_dim
-    scale = d**-0.5
 
     def put(arr: np.ndarray, keep_dtype: bool = False) -> jax.Array:
         return jnp.asarray(arr, dtype=arr.dtype if keep_dtype else c.dtype)
 
-    def quantized(shape: tuple, init_scale: float) -> QuantizedTensor:
-        stacked = (
-            rng.standard_normal((c.n_layers, *shape), dtype=np.float32) * init_scale
-        )
-        absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
-        qscale = np.maximum(absmax, 1e-8) / 127.0
-        q = np.clip(np.round(stacked / qscale), -127, 127).astype(np.int8)
-        return QuantizedTensor(
-            q=put(q, keep_dtype=True), scale=put(qscale.astype(np.float32), True)
-        )
+    # the schema (keys, shapes, optional qkv_bias / tied-head branches) is
+    # DERIVED from init_params via eval_shape — one source of truth; only
+    # the per-leaf value policy (ones for norms, zeros for biases, scaled
+    # normal for matrices, int8 for quantizable layer matrices) lives here
+    schema = jax.eval_shape(lambda: init_params(c, jax.random.key(0)))
 
-    shapes = {
-        "wq": ((d, c.n_heads * hd), scale),
-        "wk": ((d, c.n_kv_heads * hd), scale),
-        "wv": ((d, c.n_kv_heads * hd), scale),
-        "wo": ((c.n_heads * hd, d), scale),
-        "w1": ((d, c.ffn_dim), scale),
-        "w3": ((d, c.ffn_dim), scale),
-        "w2": ((c.ffn_dim, d), c.ffn_dim**-0.5),
-    }
-    layers: dict = {
-        "ln1": put(np.ones((c.n_layers, d), dtype=np.float32)),
-        "ln2": put(np.ones((c.n_layers, d), dtype=np.float32)),
-    }
-    for key, (shape, s) in shapes.items():
-        assert key in QUANTIZABLE
-        layers[key] = quantized(shape, s)
-    if c.qkv_bias:
-        for key, width in (
-            ("bq", c.n_heads * hd), ("bk", c.n_kv_heads * hd), ("bv", c.n_kv_heads * hd),
-        ):
-            layers[key] = put(np.zeros((c.n_layers, width), dtype=np.float32))
-    params = {
-        "embed": put(
-            rng.standard_normal((c.vocab_size, d), dtype=np.float32) * scale
-        ),
-        "layers": layers,
-        "norm": put(np.ones((d,), dtype=np.float32)),
-    }
-    if not c.tie_embeddings:
-        params["lm_head"] = put(
-            rng.standard_normal((d, c.vocab_size), dtype=np.float32) * scale
-        )
-    return params
+    def leaf(path, sds) -> Any:
+        name = str(path[-1].key)
+        in_layers = len(path) >= 2 and str(path[-2].key) == "layers"
+        shape = sds.shape
+        if name.startswith("ln") or name == "norm":
+            return put(np.ones(shape, dtype=np.float32))
+        if name.startswith("b"):
+            return put(np.zeros(shape, dtype=np.float32))
+        fan_in = shape[-1] if name == "embed" else shape[-2]
+        stacked = rng.standard_normal(shape, dtype=np.float32) * fan_in**-0.5
+        if in_layers and name in QUANTIZABLE:
+            absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
+            qscale = np.maximum(absmax, 1e-8) / 127.0
+            q = np.clip(np.round(stacked / qscale), -127, 127).astype(np.int8)
+            return QuantizedTensor(
+                q=put(q, keep_dtype=True), scale=put(qscale.astype(np.float32), True)
+            )
+        return put(stacked)
+
+    return jax.tree_util.tree_map_with_path(leaf, schema)

@@ -150,12 +150,14 @@ def main() -> None:
     # process forever, so retries need a fresh process image
     deadline_env = os.environ.get("ACP_BENCH_ATTACH_DEADLINE")
     attach_deadline = float(deadline_env) if deadline_env else time.time() + window_s
+    probe_window = max(60.0, attach_deadline - time.time())
     if not already_configured and not _wait_for_accelerator(
-        min(probe_timeout, 60.0), max(60.0, attach_deadline - time.time())
+        min(probe_timeout, 60.0), probe_window
     ):
         _emit(
             0.0,
-            f"FAILED: accelerator unreachable across {window_s:.0f}s retry window (wedged tunnel?)",
+            f"FAILED: accelerator unreachable across {probe_window:.0f}s of the "
+            f"{window_s:.0f}s retry window (wedged tunnel?)",
         )
         return
     devices = _probe_devices(probe_timeout)

@@ -141,3 +141,58 @@ def test_load_time_quantization_from_state_dict():
     a = np.asarray(forward(dense, tokens, TINY))
     b = np.asarray(forward(quant, tokens, TINY))
     assert np.corrcoef(a.ravel(), b.ravel())[0, 1] > 0.999
+
+
+def test_random_quantized_init_matches_init_params_schema():
+    """The host-side int8 random init (the 8B-on-one-chip bench path) must
+    track init_params' layout exactly — its schema is DERIVED via
+    eval_shape, so any new key/shape in init_params flows through; this
+    guards the value policy and the quantized-leaf placement."""
+    from agentcontrolplane_tpu.engine.weights import random_quantized_init
+    from agentcontrolplane_tpu.ops.quant import QUANTIZABLE
+
+    is_qt = lambda x: isinstance(x, QuantizedTensor)
+    for cfg in (
+        TINY,
+        dataclasses.replace(TINY, qkv_bias=True, tie_embeddings=True),
+    ):
+        dense = init_params(cfg, jax.random.key(0))
+        quant = random_quantized_init(cfg, seed=0)
+        dense_by_key = {
+            jax.tree_util.keystr(p): leaf
+            for p, leaf in jax.tree_util.tree_leaves_with_path(dense)
+        }
+        quant_by_key = {
+            jax.tree_util.keystr(p): leaf
+            for p, leaf in jax.tree_util.tree_leaves_with_path(quant, is_leaf=is_qt)
+        }
+        assert quant_by_key.keys() == dense_by_key.keys()
+        for ks, leaf in quant_by_key.items():
+            name = ks.rsplit("['", 1)[-1].rstrip("']")
+            if is_qt(leaf):
+                assert name in QUANTIZABLE and ks.startswith("['layers']")
+                assert leaf.q.dtype == jnp.int8
+                assert leaf.q.shape == dense_by_key[ks].shape
+            else:
+                assert not (ks.startswith("['layers']") and name in QUANTIZABLE)
+                assert leaf.shape == dense_by_key[ks].shape, ks
+
+
+def test_engine_serves_from_random_quantized_init():
+    """quantize='int8' with no params (the bench path) must build the
+    host-side quantized random init and serve a generation from it."""
+    from agentcontrolplane_tpu.engine.weights import random_quantized_init
+
+    cfg = dataclasses.replace(TINY, max_seq_len=128)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(), max_slots=2, max_ctx=128,
+        prefill_buckets=(64,), decode_block_size=4, quantize="int8", seed=0,
+        mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]),
+    )
+    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor)
+    eng.start()
+    try:
+        out = eng.generate("hello world", SamplingParams(temperature=0.0, max_tokens=8))
+    finally:
+        eng.stop()
+    assert len(out.tokens) > 0
