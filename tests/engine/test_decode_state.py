@@ -1,0 +1,117 @@
+"""Device-resident decode state (clean-block carry reuse) equivalence.
+
+The decode loop only re-uploads host mirrors on dirty blocks
+(admission/finish/cancel); between those, per-slot state chains through
+the jitted block's carry with finish detection on device. These tests pin
+the riskiest property: a workload full of staggered admissions, mid-stream
+joins, early stops, and cancels must generate EXACTLY the same tokens as
+the same engine forced to re-upload state every block (the pre-rework
+behavior, emulated by dirtying the flag before each block).
+"""
+
+import dataclasses
+import threading
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+TINY = dataclasses.replace(PRESETS["tiny"], max_seq_len=128)
+
+
+def _build(kv_layout: str) -> Engine:
+    return Engine(
+        config=TINY,
+        tokenizer=ByteTokenizer(),
+        max_slots=4,
+        max_ctx=128,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        seed=0,
+        mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]),
+    )
+
+
+def _force_dirty_every_block(eng: Engine) -> None:
+    orig = eng._decode_once
+
+    def dirty_then_decode():
+        eng._state_dirty = True
+        orig()
+
+    eng._decode_once = dirty_then_decode
+
+
+def _staggered_workload(eng: Engine) -> list[list[int]]:
+    """Greedy generations with DETERMINISTICALLY staggered arrivals: the
+    engine loop is driven manually (no thread, no sleeps) so both engines
+    see identical admission points, block boundaries, and dispatch widths
+    — exact token equality across runs is then a sound assertion."""
+    eng._thread = threading.main_thread()  # white-box: satisfy submit()
+
+    def step(n: int) -> None:
+        for _ in range(n):
+            eng._admit(block=False)
+            if eng._slots:
+                eng._decode_once()
+
+    futs = []
+    # wave 1: two requests join together, then decode clean blocks
+    for i in range(2):
+        futs.append(
+            eng.submit(
+                [1 + i] * (20 + 3 * i),
+                SamplingParams(temperature=0.0, max_tokens=24 + 5 * i),
+            )
+        )
+    step(3)
+    # wave 2: mid-stream join (admission dirty) + a short one that
+    # finishes early (finish dirty) while wave 1 is still decoding
+    futs.append(eng.submit([9] * 40, SamplingParams(temperature=0.0, max_tokens=30)))
+    futs.append(eng.submit([5] * 8, SamplingParams(temperature=0.0, max_tokens=3)))
+    step(2)
+    # a cancel processed at a fixed block boundary
+    doomed = eng.submit([7] * 16, SamplingParams(temperature=0.0, max_tokens=64))
+    step(1)
+    eng.cancel(doomed)
+    for _ in range(100):
+        if all(f.done() for f in futs) and doomed.done():
+            break
+        step(1)
+    out = [f.result(timeout=0).tokens for f in futs]
+    assert doomed.done()
+    return out
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_clean_block_reuse_matches_forced_upload(kv_layout):
+    fresh = _staggered_workload(_build(kv_layout))
+    forced = _build(kv_layout)
+    _force_dirty_every_block(forced)
+    assert _staggered_workload(forced) == fresh
+    assert all(len(t) > 0 for t in fresh)
+
+
+def test_ctx_edge_generates_to_the_last_token():
+    """A slot near max_ctx decodes to exactly max_ctx-1 (device-side
+    deactivation), not to the next block boundary short of it."""
+    eng = _build("slot")
+    eng.start()
+    try:
+        # max_tokens=28 keeps submit's tail-truncation off (reserve=28,
+        # budget=100, prompt exactly fits). The ctx edge stops the slot:
+        # 1 prefill-sampled token + 27 decode steps walks seq from 100 to
+        # 127 (max_ctx-1), then the device deactivates the lane
+        prompt = [3] * 100
+        out = eng.generate(prompt, SamplingParams(temperature=0.0, max_tokens=28))
+    finally:
+        eng.stop()
+    stops = set(eng.tokenizer.stop_tokens)
+    if not (set(out.tokens) & stops):
+        assert len(out.tokens) == 28, len(out.tokens)
+        assert out.finish_reason == "length"

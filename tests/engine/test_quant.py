@@ -176,23 +176,3 @@ def test_random_quantized_init_matches_init_params_schema():
             else:
                 assert not (ks.startswith("['layers']") and name in QUANTIZABLE)
                 assert leaf.shape == dense_by_key[ks].shape, ks
-
-
-def test_engine_serves_from_random_quantized_init():
-    """quantize='int8' with no params (the bench path) must build the
-    host-side quantized random init and serve a generation from it."""
-    from agentcontrolplane_tpu.engine.weights import random_quantized_init
-
-    cfg = dataclasses.replace(TINY, max_seq_len=128)
-    eng = Engine(
-        config=cfg, tokenizer=ByteTokenizer(), max_slots=2, max_ctx=128,
-        prefill_buckets=(64,), decode_block_size=4, quantize="int8", seed=0,
-        mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]),
-    )
-    assert isinstance(eng.params["layers"]["wq"], QuantizedTensor)
-    eng.start()
-    try:
-        out = eng.generate("hello world", SamplingParams(temperature=0.0, max_tokens=8))
-    finally:
-        eng.stop()
-    assert len(out.tokens) > 0
