@@ -1,0 +1,125 @@
+"""Segment the task-create -> first-ToolCall latency: control plane vs
+engine (run on CPU for control-plane numbers, on TPU for the real thing).
+
+Per task: create -> send (reconcile: watch wake, validation, lease, tool
+collection) -> engine_done (prefill + constrained generation) -> tc
+(toolparse + ToolCall CR create). BASELINE.md's 500 ms p50 target is the
+"total" row; `create->send` + `engine_done->tc` is the pure control-plane
+share (measured ~23 ms p50 at 16 concurrent tasks on CPU)."""
+
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM, BaseConfig, LLMSpec, TPUProviderConfig,
+)
+from agentcontrolplane_tpu.engine.engine import Engine
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+from tests.fixtures import make_agent, make_task, setup_with_status
+
+N = 16
+
+engine = Engine(
+    config=PRESETS["tiny"], tokenizer=ByteTokenizer(), max_slots=N,
+    max_ctx=512, prefill_buckets=(256, 512), decode_block_size=8, seed=0,
+)
+engine._get_token_table()
+engine.start()
+engine.prewarm(constrained=True)
+
+marks: dict[str, dict[str, float]] = {}
+
+# instrument the engine client seam
+from agentcontrolplane_tpu.engine import client as eng_client
+
+orig_send = eng_client.TPUEngineClient.send_request
+
+async def timed_send(self, messages, tools):
+    name = None
+    for m in messages:
+        if m.role == "user" and m.content.startswith("task "):
+            name = "ttft-" + m.content.split()[-1]
+    if name and name in marks and "send" not in marks[name]:
+        marks[name]["send"] = time.monotonic()
+    out = await orig_send(self, messages, tools)
+    if name and name in marks and "engine_done" not in marks[name]:
+        marks[name]["engine_done"] = time.monotonic()
+    return out
+
+eng_client.TPUEngineClient.send_request = timed_send
+
+
+async def main():
+    op = Operator(options=OperatorOptions(
+        enable_rest=False, llm_probe=False,
+        verify_channel_credentials=False, engine=engine,
+    ))
+    op.task_reconciler.requeue_delay = 0.02
+    op.toolcall_reconciler.poll_interval = 0.02
+    store = op.store
+    setup_with_status(
+        store,
+        LLM(metadata=ObjectMeta(name="tpu-llm"),
+            spec=LLMSpec(provider="tpu",
+                         parameters=BaseConfig(model="tiny", max_tokens=24, temperature=0.7),
+                         tpu=TPUProviderConfig(preset="tiny"),
+                         provider_config={"tool_choice": "required"})),
+        lambda o: (setattr(o.status, "ready", True), setattr(o.status, "status", "Ready")),
+    )
+    make_agent(store, name="leaf", llm="tpu-llm", system="leaf")
+    make_agent(store, name="rooter", llm="tpu-llm", system="use tools", sub_agents=("leaf",))
+    await op.start()
+    watch = store.watch("ToolCall")
+    for i in range(N):
+        name = f"ttft-{i}"
+        marks[name] = {"create": time.monotonic()}
+        make_task(store, name=name, agent="rooter", user_message=f"task {i}")
+    deadline = time.monotonic() + 180
+    done = 0
+    while done < N and time.monotonic() < deadline:
+        ev = await watch.next(timeout=deadline - time.monotonic())
+        if ev is None:
+            break
+        if ev.type != "ADDED":
+            continue
+        tn = ev.object.metadata.labels.get("acp.tpu/task", "")
+        if tn in marks and "tc" not in marks[tn]:
+            marks[tn]["tc"] = time.monotonic()
+            done += 1
+    watch.stop()
+    await op.stop()
+
+    segs = {"create->send": [], "send->engine_done": [], "engine_done->tc": [],
+            "control_plane": [], "total": []}
+    for name, m in marks.items():
+        if "tc" not in m or "send" not in m:
+            continue
+        segs["create->send"].append(m["send"] - m["create"])
+        segs["send->engine_done"].append(m["engine_done"] - m["send"])
+        segs["engine_done->tc"].append(m["tc"] - m["engine_done"])
+        # per-task sum, NOT sum of segment medians (p50(a)+p50(b) != p50(a+b))
+        segs["control_plane"].append(
+            (m["send"] - m["create"]) + (m["tc"] - m["engine_done"])
+        )
+        segs["total"].append(m["tc"] - m["create"])
+    for k, v in segs.items():
+        v.sort()
+        if v:
+            p50 = v[len(v) // 2] * 1e3
+            print(f"{k:20s} p50 {p50:8.1f} ms   max {v[-1]*1e3:8.1f} ms   n={len(v)}")
+
+
+asyncio.run(main())
+engine.stop()
