@@ -119,6 +119,10 @@ class TPUProviderConfig(APIModel):
     checkpoint: Optional[str] = None
     preset: Optional[str] = None
     tensor_parallelism: int = 0  # 0 = all local devices
+    # >1 shards the KV cache's context dim over an 'sp' mesh axis
+    # (context-parallel serving; slot layout only) — long max_context
+    # without growing per-chip HBM
+    context_parallelism: int = 1
     max_sequences: int = 64
     max_context: int = 8192
     page_size: int = 16

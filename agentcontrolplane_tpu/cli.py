@@ -57,6 +57,10 @@ def cmd_run(args) -> int:
             kv_layout=args.tpu_kv_layout,
             quantize=args.tpu_quantize,
         )
+        if args.tpu_tp or args.tpu_sp > 1:
+            from .parallel.mesh import serving_mesh
+
+            kw["mesh"] = serving_mesh(args.tpu_tp, args.tpu_sp)
         if args.tpu_checkpoint:
             from .engine.weights import load_safetensors_dir
 
@@ -508,6 +512,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--tpu-slots", type=int, default=64)
     run.add_argument("--tpu-ctx", type=int, default=2048)
+    run.add_argument(
+        "--tpu-tp", type=int, default=0,
+        help="tensor parallelism (0 = all devices after --tpu-sp)",
+    )
+    run.add_argument(
+        "--tpu-sp", type=int, default=1,
+        help="context parallelism: shard the KV cache's ctx dim over an "
+        "'sp' mesh axis (slot layout; --tpu-ctx must divide evenly)",
+    )
     run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
     run.add_argument("--tpu-quantize", choices=["int8"], default=None)
     run.add_argument(

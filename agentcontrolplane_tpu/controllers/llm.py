@@ -69,8 +69,28 @@ class LLMReconciler:
             if llm.spec.api_key_from is None:
                 raise Invalid(f"provider {provider} requires apiKeyFrom")
             return resolve_secret_key(self.store, ns, llm.spec.api_key_from)
-        if provider == "tpu" and llm.spec.tpu is None:
-            raise Invalid("provider tpu requires a tpu config block")
+        if provider == "tpu":
+            if llm.spec.tpu is None:
+                raise Invalid("provider tpu requires a tpu config block")
+            # the engine is process-wide (built at operator startup, e.g.
+            # acp-tpu run --tpu-tp/--tpu-sp); the CR's parallelism fields
+            # are declarative intent, so a mismatch is a config error the
+            # user must see at LLM validation time, not silently ignored
+            engine = getattr(self.llm_factory, "_engine", None)
+            if engine is not None:
+                shape = dict(engine.mesh.shape)
+                want_tp = llm.spec.tpu.tensor_parallelism
+                if want_tp and shape.get("tp", 1) != want_tp:
+                    raise Invalid(
+                        f"engine mesh tp={shape.get('tp', 1)} != spec "
+                        f"tensorParallelism={want_tp} (set acp-tpu run --tpu-tp)"
+                    )
+                want_sp = llm.spec.tpu.context_parallelism
+                if want_sp > 1 and shape.get("sp", 1) != want_sp:
+                    raise Invalid(
+                        f"engine mesh sp={shape.get('sp', 1)} != spec "
+                        f"contextParallelism={want_sp} (set acp-tpu run --tpu-sp)"
+                    )
         return ""
 
     async def _probe(self, llm: LLM, api_key: str) -> None:

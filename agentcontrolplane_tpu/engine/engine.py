@@ -183,13 +183,28 @@ class Engine:
             self.max_ctx
         ]
         self.mesh = mesh if mesh is not None else serving_mesh()
-        tp = dict(zip(self.mesh.axis_names, self.mesh.devices.shape)).get("tp", 1)
+        tp = dict(self.mesh.shape).get("tp", 1)
+        sp = dict(self.mesh.shape).get("sp", 1)
         if tp > 1 and self.config.n_kv_heads % tp:
             raise ValueError(
                 f"n_kv_heads={self.config.n_kv_heads} cannot shard over tp={tp} "
                 "(MQA/GQA KV heads must divide tp — serve gemma-2b-style MQA "
                 "models with tp=1)"
             )
+        if sp > 1:
+            # context parallelism: the slot cache's ctx dim shards over sp
+            # (kv_cache_specs); paged pages have no contiguous ctx dim to
+            # shard, and the ctx length must split evenly across ranks
+            if kv_layout == "paged":
+                raise ValueError(
+                    "context parallelism (mesh 'sp' axis > 1) requires "
+                    "kv_layout='slot'"
+                )
+            if self.max_ctx % sp:
+                raise ValueError(
+                    f"max_ctx={self.max_ctx} must be divisible by the mesh's "
+                    f"sp={sp} for context-parallel serving"
+                )
         self.prefill_batch_max = max(1, prefill_batch_max)
         # decode dispatch widths: smallest bucket covering the active slots
         # (each width is its own jit cache entry; keep the set small so cold

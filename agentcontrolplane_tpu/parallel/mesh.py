@@ -43,9 +43,30 @@ def make_mesh(
     return Mesh(grid, tuple(names))
 
 
-def serving_mesh(tensor_parallelism: int = 0) -> Mesh:
-    n = tensor_parallelism or len(jax.devices())
-    return make_mesh({"tp": n})
+def serving_mesh(tensor_parallelism: int = 0, context_parallelism: int = 1) -> Mesh:
+    """Serving mesh: tp (heads/hidden) x optional sp (context parallelism).
+
+    With ``context_parallelism > 1`` the KV cache's ctx dimension shards
+    over 'sp' (see :func:`kv_cache_specs`): each rank holds 1/sp of every
+    slot's context, and decode/prefill attention compiles to per-shard
+    flash partials merged by small all-reduces — XLA GSPMD emits that
+    pattern from the sharding alone (no all-gather of the cache; pinned by
+    tests/parallel/test_context_parallel_serving.py). This is how a long
+    max_ctx scales across chips without growing per-chip HBM."""
+    sp = max(1, context_parallelism)
+    n = len(jax.devices())
+    if n % sp:
+        raise ValueError(
+            f"context_parallelism={sp} must divide the device count ({n})"
+        )
+    tp = tensor_parallelism or n // sp
+    if tp < 1:
+        raise ValueError(
+            f"no devices left for tp: {n} device(s) / sp={sp}"
+        )
+    if sp > 1:
+        return make_mesh({"sp": sp, "tp": tp})
+    return make_mesh({"tp": tp})
 
 
 # ---------------------------------------------------------------------------
@@ -95,15 +116,28 @@ def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
     )
 
 
-def kv_cache_specs() -> dict:
-    """Slot cache [L, S, C, H_kv, d]: shard KV heads over tp."""
-    return {"k": P(None, None, None, "tp", None), "v": P(None, None, None, "tp", None)}
+def kv_cache_specs(mesh: Mesh | None = None) -> dict:
+    """Slot cache [L, S, C, H_kv, d]: KV heads shard over tp; on a mesh
+    with an 'sp' axis (>1) the ctx dim C additionally shards over sp —
+    context-parallel serving. No model-code change is needed: the decode
+    and prefill softmax reductions over the sharded C compile to partial
+    reductions + [S, H_kv]-sized all-reduces (the online-softmax merge),
+    and the per-token scatter commits land on the owning shard."""
+    seq = (
+        "sp"
+        if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
+        else None
+    )
+    return {
+        "k": P(None, None, seq, "tp", None),
+        "v": P(None, None, seq, "tp", None),
+    }
 
 
 def kv_cache_shardings(mesh: Mesh) -> dict:
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
-        kv_cache_specs(),
+        kv_cache_specs(mesh),
         is_leaf=lambda x: isinstance(x, P),
     )
 

@@ -5,7 +5,7 @@ Per task: create -> send (reconcile: watch wake, validation, lease, tool
 collection) -> engine_done (prefill + constrained generation) -> tc
 (toolparse + ToolCall CR create). BASELINE.md's 500 ms p50 target is the
 "total" row; `create->send` + `engine_done->tc` is the pure control-plane
-share (measured ~23 ms p50 at 16 concurrent tasks on CPU)."""
+share (measured ~21 ms p50 at 16 concurrent tasks on CPU)."""
 
 import asyncio
 import os

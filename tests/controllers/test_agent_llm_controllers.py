@@ -237,3 +237,47 @@ async def test_mcpserver_invalid_spec_terminal(store):
     assert server.status.status == "Error"
     assert "requires a command" in server.status.status_detail
     assert result.requeue_after is None
+
+
+async def test_llm_controller_tpu_mesh_mismatch_is_invalid(store):
+    """A provider:tpu LLM declaring tensorParallelism/contextParallelism
+    that disagrees with the live engine's mesh must fail validation — the
+    fields are declarative intent, not silent no-ops."""
+    from agentcontrolplane_tpu.api.resources import TPUProviderConfig
+
+    class FakeEngine:
+        class mesh:
+            shape = {"sp": 1, "tp": 2}
+
+    class FakeFactory:
+        _engine = FakeEngine()
+
+    rec = LLMReconciler(store, EventRecorder(store), FakeFactory(), probe=False)
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="tpu-bad"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="bench-1b"),
+                tpu=TPUProviderConfig(preset="bench-1b", context_parallelism=4),
+            ),
+        )
+    )
+    await rec.reconcile(("LLM", "default", "tpu-bad"))
+    llm = store.get("LLM", "tpu-bad")
+    assert not llm.status.ready
+    assert "contextParallelism" in llm.status.status_detail
+
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="tpu-ok"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="bench-1b"),
+                tpu=TPUProviderConfig(preset="bench-1b", tensor_parallelism=2),
+            ),
+        )
+    )
+    await rec.reconcile(("LLM", "default", "tpu-ok"))
+    llm = store.get("LLM", "tpu-ok")
+    assert llm.status.status_detail == "" or "Parallelism" not in llm.status.status_detail
