@@ -27,6 +27,7 @@ lease layer never serializes the engine.
 
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import heapq
 import logging
@@ -179,6 +180,12 @@ class Engine:
         self.tokenizer = tokenizer or ByteTokenizer()
         self.max_slots = max_slots
         self.max_ctx = min(max_ctx, config.max_seq_len)
+        if self.max_ctx < max_ctx:
+            log.warning(
+                "max_ctx %d clamped to the model's max_seq_len %d — prompts "
+                "beyond it are tail-truncated (and skip the prefix cache)",
+                max_ctx, config.max_seq_len,
+            )
         self.prefill_buckets = [b for b in prefill_buckets if b <= self.max_ctx] or [
             self.max_ctx
         ]
@@ -347,6 +354,7 @@ class Engine:
         # slots are released at the next engine-loop iteration so orphaned
         # generations don't pin capacity to max_tokens
         self._cancelled: set[str] = set()
+        self._admission_held = 0  # hold depth; see hold_admission()
         # device-resident decode state (see _decode_once): None until the
         # first block; _state_dirty forces a re-upload of the host mirrors
         # whenever slot assignment changed (admission/finish/cancel/restart)
@@ -676,26 +684,28 @@ class Engine:
         for json_only in [constrained]:
             # phase a: staggered decay burst (barrier: the next phase must
             # find every slot free, or its batch can't form at full width)
-            futs = []
-            for i in range(self.max_slots):
-                # slot i outlives slot j>i: the active set decays through
-                # every width bucket
-                blocks = 1 + sum(1 for w in widths if i < w)
-                sp = SamplingParams(
-                    temperature=0.0, max_tokens=blocks * K + 1, json_only=json_only
-                )
-                futs.append(
-                    self.submit([1] * max(1, decay_bucket - 1), sp, _prewarm=True)
-                )
+            with self.hold_admission():
+                futs = []
+                for i in range(self.max_slots):
+                    # slot i outlives slot j>i: the active set decays through
+                    # every width bucket
+                    blocks = 1 + sum(1 for w in widths if i < w)
+                    sp = SamplingParams(
+                        temperature=0.0, max_tokens=blocks * K + 1, json_only=json_only
+                    )
+                    futs.append(
+                        self.submit([1] * max(1, decay_bucket - 1), sp, _prewarm=True)
+                    )
             for f in futs:
                 f.result(timeout=1800)
             # phase b: full-width burst at the largest bucket
             if self.prefill_buckets[-1] != decay_bucket:
                 one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
-                futs = [
-                    self.submit([1] * (self.prefill_buckets[-1] - 1), one, _prewarm=True)
-                    for _ in range(self.max_slots)
-                ]
+                with self.hold_admission():
+                    futs = [
+                        self.submit([1] * (self.prefill_buckets[-1] - 1), one, _prewarm=True)
+                        for _ in range(self.max_slots)
+                    ]
                 for f in futs:
                     f.result(timeout=1800)
             # phase c: lone-request shapes, sequential so admission can't
@@ -718,10 +728,11 @@ class Engine:
                     if b - Bsz <= prev:
                         continue  # bucket too narrow for Bsz distinct lengths
                     for _attempt in range(5):
-                        futs = [
-                            self.submit([1] * (b - 1 - i), one, _prewarm=True)
-                            for i in range(Bsz)
-                        ]
+                        with self.hold_admission():
+                            futs = [
+                                self.submit([1] * (b - 1 - i), one, _prewarm=True)
+                                for i in range(Bsz)
+                            ]
                         for f in futs:
                             f.result(timeout=1800)
                         if (b, Bsz) in self._full_batch_shapes:
@@ -749,10 +760,11 @@ class Engine:
                     # the batch size actually DISPATCHED and retry, rather
                     # than assuming the b submits landed in one group
                     for attempt in range(5):
-                        futs = [
-                            self.submit([1] * seed_len + [2] * (8 + i), one)
-                            for i in range(b)
-                        ]
+                        with self.hold_admission():
+                            futs = [
+                                self.submit([1] * seed_len + [2] * (8 + i), one)
+                                for i in range(b)
+                            ]
                         for f in futs:
                             f.result(timeout=1800)
                         d_hits += b
@@ -780,10 +792,11 @@ class Engine:
                 b = 1
                 while b <= min(self.prefill_batch_max, self.max_slots):
                     for _attempt in range(5):
-                        futs = [
-                            self.submit([1] * (long_len + i), one, _prewarm=True)
-                            for i in range(b)
-                        ]
+                        with self.hold_admission():
+                            futs = [
+                                self.submit([1] * (long_len + i), one, _prewarm=True)
+                                for i in range(b)
+                            ]
                         for f in futs:
                             f.result(timeout=1800)
                         if b in self._spill_batch_sizes:
@@ -885,6 +898,21 @@ class Engine:
         for slot in list(self._slots):
             self._finish(slot, "stop")
 
+    @contextlib.contextmanager
+    def hold_admission(self):
+        """Deterministic batch formation: while held, submitted requests
+        accumulate in the waiting deque (the engine keeps decoding active
+        slots) and on release ONE admission group forms with the whole
+        batch. Prewarm uses this so its (bucket, B) / continuation /
+        spill batch shapes form on the first attempt instead of racing the
+        engine loop's drain timing — a missed shape there is a 20-40s cold
+        compile in the middle of real serving."""
+        self._admission_held += 1
+        try:
+            yield
+        finally:
+            self._admission_held -= 1
+
     def _admit(self, block: bool) -> bool:
         """Move queued requests into free slots (prefill), strictly FIFO.
         Returns True if anything was admitted."""
@@ -923,6 +951,11 @@ class Engine:
                 live.update(r.rid for r in self._queue.queue if r is not None)
             self._cancelled &= live
 
+        if self._admission_held:
+            if not self._slots:
+                # idle hold: don't busy-spin against the submitting thread
+                time.sleep(0.002)
+            return False
         admitted = False
         while self._free and self._waiting:
             group = self._collect_group()
