@@ -190,6 +190,15 @@ class Engine:
             self.max_ctx
         ]
         self.mesh = mesh if mesh is not None else serving_mesh()
+        from jax.sharding import NamedSharding, PartitionSpec as _P
+
+        # all per-dispatch host->device uploads go through _put as
+        # mesh-replicated GLOBAL arrays: identical on a single host, and
+        # required for coordinated multi-host serving, where every process
+        # contributes the same replicated value (a plain jnp.asarray would
+        # make a process-local array that cannot mix with the mesh-global
+        # cache/params in one dispatch)
+        self._replicated = NamedSharding(self.mesh, _P())
         tp = dict(self.mesh.shape).get("tp", 1)
         sp = dict(self.mesh.shape).get("sp", 1)
         if tp > 1 and self.config.n_kv_heads % tp:
@@ -289,7 +298,7 @@ class Engine:
                 )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
-        self._rng = jax.random.key(seed)
+        self._rng = jax.device_put(jax.random.key(seed), self._replicated)
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         # admission order is strict FIFO: requests the pool can't fit yet
         # stay at the head of this deque (no starvation of large requests)
@@ -342,8 +351,8 @@ class Engine:
         self._token_table = None
         self._min_close = None
         self._table_lock = threading.Lock()
-        self._dummy_table = jnp.full((1, self.config.vocab_size), -1, dtype=jnp.int32)
-        self._dummy_min_close = jnp.zeros((1,), dtype=jnp.int32)
+        self._dummy_table = self._put(np.full((1, self.config.vocab_size), -1, dtype=np.int32))
+        self._dummy_min_close = self._put(np.zeros((1,), dtype=np.int32))
         # remaining sampled-token budget per slot (budget-aware constraint)
         self._budgets = np.zeros(max_slots, dtype=np.int32)
         self._thread: Optional[threading.Thread] = None
@@ -365,6 +374,9 @@ class Engine:
         self.tokens_generated = 0
 
         self._build_jitted()
+
+    def _put(self, x) -> jax.Array:
+        return jax.device_put(x, self._replicated)
 
     # -- jitted programs -------------------------------------------------
 
@@ -956,6 +968,12 @@ class Engine:
                 # idle hold: don't busy-spin against the submitting thread
                 time.sleep(0.002)
             return False
+        return self._fill_slots()
+
+    def _fill_slots(self) -> bool:
+        """Admit from the waiting deque into free slots (the prefill side
+        of _admit, split out so the coordinated multi-host loop can replay
+        broadcast admissions without touching the local submit queue)."""
         admitted = False
         while self._free and self._waiting:
             group = self._collect_group()
@@ -1029,14 +1047,14 @@ class Engine:
                 self._rng, step_rng = jax.random.split(self._rng)
                 tail = (
                     step_rng,
-                    jnp.zeros(B, dtype=np.float32),  # temps (unused sample)
-                    jnp.zeros(B, dtype=np.int32),
-                    jnp.ones(B, dtype=np.float32),
+                    self._put(np.zeros(B, dtype=np.float32)),  # temps (unused sample)
+                    self._put(np.zeros(B, dtype=np.int32)),
+                    self._put(np.ones(B, dtype=np.float32)),
                     self._dummy_table,
-                    jnp.zeros(B, dtype=np.int32),
-                    jnp.zeros(B, dtype=bool),  # unconstrained
+                    self._put(np.zeros(B, dtype=np.int32)),
+                    self._put(np.zeros(B, dtype=bool)),  # unconstrained
                     self._dummy_min_close,
-                    jnp.ones(B, dtype=np.int32),
+                    self._put(np.ones(B, dtype=np.int32)),
                 )
                 if self.kv_layout == "paged":
                     P = self.page_size
@@ -1044,16 +1062,16 @@ class Engine:
                     for i, (item, start) in enumerate(batch):
                         _req, slot, _, _m = item
                         page_ids[i] = self._slot_pages[slot][start // P : (start + CH) // P]
-                    block_tables = jnp.asarray(
+                    block_tables = self._put(
                         self._block_tables[[it[0][1] for it in batch]]
                     )
                     self.cache, _tok, _state = self._jit_prefill_paged_continue(
                         self.params,
                         self.cache,
-                        jnp.asarray(toks),
+                        self._put(toks),
                         jnp.full(B, CH, dtype=np.int32),
-                        jnp.asarray(starts),
-                        jnp.asarray(page_ids),
+                        self._put(starts),
+                        self._put(page_ids),
                         block_tables,
                         *tail,
                     )
@@ -1061,10 +1079,10 @@ class Engine:
                     self.cache, _tok, _state = self._jit_prefill_continue(
                         self.params,
                         self.cache,
-                        jnp.asarray(toks),
+                        self._put(toks),
                         jnp.full(B, CH, dtype=np.int32),
-                        jnp.asarray(starts),
-                        jnp.asarray(slots),
+                        self._put(starts),
+                        self._put(slots),
                         *tail,
                     )
                 for e in batch:
@@ -1295,9 +1313,9 @@ class Engine:
                 width = min(self.config.vocab_size, table.token_trans.shape[1])
                 padded[:, :width] = table.token_trans[:, :width]
                 self._token_table_np = padded  # host-side walks (prefix seeding)
-                self._min_close = jnp.asarray(table.min_close.astype(np.int32))
+                self._min_close = self._put(table.min_close.astype(np.int32))
                 self._table_start = table.start_state
-                self._token_table = jnp.asarray(padded)  # LAST: publishes the rest
+                self._token_table = self._put(padded)  # LAST: publishes the rest
                 log.info(
                     "built JSON constraint table: %d states x %d tokens in %.1fs",
                     *table.token_trans.shape, time.monotonic() - t0,
@@ -1369,19 +1387,19 @@ class Engine:
                 constrained0[i] = True
         self._rng, step_rng = jax.random.split(self._rng)
         common = (
-            jnp.asarray(tokens),
-            jnp.asarray(lengths),
+            self._put(tokens),
+            self._put(lengths),
         )
         tail = (
             step_rng,
-            jnp.asarray(temps),
-            jnp.asarray(top_ks),
-            jnp.asarray(top_ps),
+            self._put(temps),
+            self._put(top_ks),
+            self._put(top_ps),
             table,
-            jnp.asarray(con_states0),
-            jnp.asarray(constrained0),
+            self._put(con_states0),
+            self._put(constrained0),
             min_close,
-            jnp.asarray(budgets),
+            self._put(budgets),
         )
         if self.kv_layout == "paged":
             P = self.page_size
@@ -1396,26 +1414,26 @@ class Engine:
                 page_ids[i, : len(fresh)] = fresh
             if starts_np is not None:
                 self._cont_batch_sizes.add(B)
-                block_tables = jnp.asarray(
+                block_tables = self._put(
                     self._block_tables[[slot for _, slot, _, _ in chunk]]
                 )
                 cache, firsts, con_states = self._jit_prefill_paged_continue(
                     self.params, self.cache, *common,
-                    jnp.asarray(starts), jnp.asarray(page_ids), block_tables, *tail,
+                    self._put(starts), self._put(page_ids), block_tables, *tail,
                 )
             else:
                 cache, firsts, con_states = self._jit_prefill_paged(
-                    self.params, self.cache, *common, jnp.asarray(page_ids), *tail
+                    self.params, self.cache, *common, self._put(page_ids), *tail
                 )
         elif starts_np is not None:
             self._cont_batch_sizes.add(B)
             cache, firsts, con_states = self._jit_prefill_continue(
                 self.params, self.cache, *common,
-                jnp.asarray(starts), jnp.asarray(slots), *tail,
+                self._put(starts), self._put(slots), *tail,
             )
         else:
             cache, firsts, con_states = self._jit_prefill(
-                self.params, self.cache, *common, jnp.asarray(slots), *tail
+                self.params, self.cache, *common, self._put(slots), *tail
             )
         self.cache = cache
         # snapshot prefixes for future hits (engine thread; the state can't
@@ -1536,18 +1554,18 @@ class Engine:
                 self._budgets[slot] = max(0, min(token_left, ctx_left))
             self._dev = {
                 "W": W,
-                "tokens": jnp.asarray(self._last_tokens[:W]),
-                "seq_lens": jnp.asarray(self._seq_lens[:W]),
-                "active": jnp.asarray(active_mask),
+                "tokens": self._put(self._last_tokens[:W]),
+                "seq_lens": self._put(self._seq_lens[:W]),
+                "active": self._put(active_mask),
                 "rng": step_rng,
-                "temps": jnp.asarray(self._temps[:W]),
-                "top_ks": jnp.asarray(self._top_ks[:W]),
-                "top_ps": jnp.asarray(self._top_ps[:W]),
+                "temps": self._put(self._temps[:W]),
+                "top_ks": self._put(self._top_ks[:W]),
+                "top_ps": self._put(self._top_ps[:W]),
                 "table": self._token_table if use_real else self._dummy_table,
-                "con_states": jnp.asarray(self._con_states[:W]),
-                "constrained": jnp.asarray(self._constrained[:W]),
+                "con_states": self._put(self._con_states[:W]),
+                "constrained": self._put(self._constrained[:W]),
                 "min_close": self._min_close if use_real else self._dummy_min_close,
-                "budgets": jnp.asarray(self._budgets[:W]),
+                "budgets": self._put(self._budgets[:W]),
             }
             self._state_dirty = False
         d = self._dev
@@ -1562,7 +1580,7 @@ class Engine:
             # when a page was appended (or the state itself was re-uploaded),
             # not on every block
             if self._tables_dirty or "block_tables" not in d:
-                d["block_tables"] = jnp.asarray(self._block_tables[:W])
+                d["block_tables"] = self._put(self._block_tables[:W])
                 self._tables_dirty = False
             cache, tok_block, carry = self._jit_decode_paged(
                 self.params, self.cache, *common, d["block_tables"]
