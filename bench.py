@@ -8,122 +8,71 @@ v5e-8 — or one chip with ``ACP_BENCH_QUANTIZE=int8``).
 
 Prints ONE JSON line:
   {"metric": "decode_tok_s_per_chip", "value": N, "unit": "tok/s/chip",
-   "vs_baseline": N/1000}
+   "vs_baseline": N/1000, "ttft_first_toolcall_ms": {...}, ...}
 vs_baseline is against BASELINE.md's >1,000 tok/s/chip north-star target.
+
+Wedge-resistant architecture (round-3 rework): the PARENT process NEVER
+initializes PJRT — not even ``jax.devices()``. Every accelerator-touching
+phase runs in a watchdogged CHILD process:
+
+  parent ──probe child──▶ ``python -c "import jax; jax.devices()"`` (disposable)
+         ──main child───▶ ``bench.py --phase main``  (attach → engine → burst → TTFT)
+         ──ab child─────▶ ``bench.py --phase ab``    (the other KV layout)
+
+Children report progress via ``MARK <name>`` / ``RESULT <key> <json>`` lines
+on stdout; the parent enforces a per-mark deadline schedule and SIGKILLs a
+child that misses one (a hung PJRT attach leaves threads alive, so
+heartbeats prove nothing — only forward progress counts). A killed phase is
+retried after a fresh probe while budget remains; partial results that
+already arrived are kept. Whatever happens, the parent emits its one JSON
+line before ``ACP_BENCH_TOTAL_BUDGET_S`` expires.
 
 Knobs (env): ACP_BENCH_PRESET, ACP_BENCH_REQUESTS, ACP_BENCH_MAX_TOKENS,
 ACP_BENCH_PROMPT_LEN, ACP_BENCH_MAX_CTX, ACP_BENCH_BLOCK,
 ACP_BENCH_KV_LAYOUT (slot|paged), ACP_BENCH_QUANTIZE (int8),
-ACP_BENCH_DEADLINE_S (per-burst wall-clock cap; partial results are
-reported honestly), ACP_BENCH_DEVICE_TIMEOUT_S (device-probe watchdog),
-ACP_BENCH_PROBE_WINDOW_S (tunnel retry window),
-ACP_BENCH_TTFT=0 / ACP_BENCH_TTFT_TASKS / ACP_BENCH_TTFT_DEADLINE_S
-(first-ToolCall latency phase), ACP_BENCH_AB=0 / ACP_BENCH_AB_BUDGET_S
-(slot-vs-paged A/B leg).
-
-If the accelerator cannot be reached within the watchdog window (e.g. a
-wedged tunnel), prints value 0.0 with the failure on stderr rather than
-hanging the driver.
+ACP_BENCH_DEADLINE_S (per-burst wall-clock cap),
+ACP_BENCH_DEVICE_TIMEOUT_S (attach watchdog), ACP_BENCH_PROBE_WINDOW_S,
+ACP_BENCH_BUILD_TIMEOUT_S, ACP_BENCH_WARM_TIMEOUT_S,
+ACP_BENCH_TTFT=0 / ACP_BENCH_TTFT_TASKS / ACP_BENCH_TTFT_DEADLINE_S /
+ACP_BENCH_TTFT_TIMEOUT_S, ACP_BENCH_AB=0 / ACP_BENCH_AB_BUDGET_S,
+ACP_BENCH_TOTAL_BUDGET_S, ACP_BENCH_RETRIES.
 """
 
 from __future__ import annotations
 
+import argparse
 import json
 import os
+import signal
+import subprocess
 import sys
 import threading
 import time
 
-
-def _emit(value: float, note: str, extra: dict | None = None) -> None:
-    doc = {
-        "metric": "decode_tok_s_per_chip",
-        "value": round(value, 1),
-        "unit": "tok/s/chip",
-        "vs_baseline": round(value / 1000.0, 3),
-    }
-    if extra:
-        doc.update(extra)
-    print(json.dumps(doc), flush=True)
-    print(f"# {note}", file=sys.stderr, flush=True)
+TARGET_TOK_S = 1000.0
+_THIS = os.path.abspath(__file__)
 
 
-def _probe_devices(timeout_s: float):
-    """jax.devices() in a watchdog thread — a wedged PJRT tunnel hangs it."""
-    result: dict = {}
-
-    def probe():
-        try:
-            import jax
-
-            result["devices"] = jax.devices()
-        except Exception as e:  # pragma: no cover
-            result["error"] = e
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    if t.is_alive():
-        return None
-    if "error" in result:
-        raise result["error"]
-    return result.get("devices")
+def _log(msg: str) -> None:
+    print(f"# {msg}", file=sys.stderr, flush=True)
 
 
-def _wait_for_accelerator(attempt_timeout_s: float, window_s: float) -> bool:
-    """Retry-with-backoff across the whole window using DISPOSABLE probe
-    subprocesses, so a wedged axon tunnel never taints this process's PJRT
-    client. Each probe is a fresh ``python -c "import jax; jax.devices()"``
-    under a timeout; on success the main process can safely init jax."""
-    import subprocess
-
-    deadline = time.monotonic() + window_s
-    attempt = 0
-    while True:
-        attempt += 1
-        try:
-            out = subprocess.run(
-                [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
-                capture_output=True,
-                timeout=attempt_timeout_s,
-                text=True,
-            )
-            if out.returncode == 0 and out.stdout.strip():
-                print(
-                    f"# probe attempt {attempt}: {out.stdout.strip().splitlines()[-1]} device(s)",
-                    file=sys.stderr, flush=True,
-                )
-                return True
-        except subprocess.TimeoutExpired:
-            pass
-        remaining = deadline - time.monotonic()
-        print(
-            f"# probe attempt {attempt} failed; {remaining:.0f}s left in retry window",
-            file=sys.stderr, flush=True,
-        )
-        if remaining <= 30:
-            return False
-        time.sleep(30)
+# ---------------------------------------------------------------------------
+# parent side
+# ---------------------------------------------------------------------------
 
 
-def _already_configured() -> bool:
-    """True when this process has already decided its jax platform — the CPU
-    smoke run (verify skill: jax_platforms forced to cpu before runpy) or a
-    live initialized backend. NOTE: ``"jax" in sys.modules`` is NOT the
-    right check in this image — the harness preimports jax into every
-    Python process, which silently skipped the whole wedge-resistant probe
-    path (round 1's instant 0.0 failure mode)."""
+def _cpu_forced_inline() -> bool:
+    """True when THIS process already pinned jax to cpu (the verify-skill
+    smoke path runs bench.py under runpy after ``jax.config.update(
+    'jax_platforms', 'cpu')``). Children must then be pinned via --force-cpu
+    because the axon harness OVERRIDES the JAX_PLATFORMS env var. NOTE:
+    ``"jax" in sys.modules`` alone proves nothing — the harness preimports
+    jax into every process."""
     if "jax" not in sys.modules:
         return False
     import jax
 
-    try:
-        from jax._src import xla_bridge
-
-        if getattr(xla_bridge, "_backends", None):
-            return True  # a backend is already live; probing is moot
-    except Exception:
-        pass
     try:
         plats = jax.config.jax_platforms
     except Exception:
@@ -131,106 +80,374 @@ def _already_configured() -> bool:
     return bool(plats) and "cpu" in str(plats)
 
 
-def main() -> None:
+def _probe_once(timeout_s: float) -> int | None:
+    """One DISPOSABLE probe subprocess. Returns device count or None.
+    The parent's own PJRT state stays virgin no matter what happens here."""
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            capture_output=True,
+            timeout=timeout_s,
+            text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if out.returncode == 0 and out.stdout.strip():
+        try:
+            return int(out.stdout.strip().splitlines()[-1])
+        except ValueError:
+            return None
+    return None
+
+
+def _probe_until(deadline: float, attempt_timeout: float) -> int | None:
+    attempt = 0
+    while True:
+        attempt += 1
+        n = _probe_once(min(attempt_timeout, max(10.0, deadline - time.monotonic())))
+        if n:
+            _log(f"probe attempt {attempt}: {n} device(s)")
+            return n
+        remaining = deadline - time.monotonic()
+        _log(f"probe attempt {attempt} failed; {remaining:.0f}s left in window")
+        if remaining <= 30:
+            return None
+        time.sleep(min(30.0, remaining - 25))
+
+
+_ACTIVE_RUN: "_PhaseRun | None" = None
+
+
+def _parent_signal_cleanup(signum, frame):  # pragma: no cover - signal path
+    """A driver-killed parent must not orphan a TPU-holding child: the child
+    lives in its own session (start_new_session), so a group-kill of the
+    parent misses it and it would hold the single chip for minutes."""
+    if _ACTIVE_RUN is not None:
+        _ACTIVE_RUN.kill()
+    sys.exit(128 + signum)
+
+
+class _PhaseRun:
+    """One child process + the MARK/RESULT reader + deadline enforcement."""
+
+    def __init__(self, argv: list[str]):
+        global _ACTIVE_RUN
+        _ACTIVE_RUN = self
+        self.results: dict[str, object] = {}
+        self.marks: list[str] = []
+        self._cond = threading.Condition()
+        self.proc = subprocess.Popen(
+            [sys.executable, _THIS, *argv],
+            stdout=subprocess.PIPE,
+            stderr=None,  # child diagnostics flow to the parent's stderr
+            text=True,
+            errors="replace",
+            start_new_session=True,
+        )
+        self._reader = threading.Thread(target=self._read, daemon=True)
+        self._reader.start()
+
+    def _read(self) -> None:
+        assert self.proc.stdout is not None
+        try:
+            self._read_lines()
+        except Exception as e:  # a dead reader must never strand the child
+            _log(f"reader thread error: {e!r}")
+        finally:
+            with self._cond:
+                self._cond.notify_all()
+
+    def _read_lines(self) -> None:
+        for line in self.proc.stdout:
+            line = line.rstrip("\n")
+            with self._cond:
+                if line.startswith("MARK ") and line.split(None, 1)[1:]:
+                    self.marks.append(line.split(None, 1)[1])
+                elif line.startswith("RESULT "):
+                    parts = line.split(None, 2)
+                    if len(parts) == 3:
+                        try:
+                            self.results[parts[1]] = json.loads(parts[2])
+                        except json.JSONDecodeError:
+                            _log(f"unparseable RESULT {parts[1]}: {parts[2][:200]}")
+                    else:
+                        _log(f"malformed protocol line: {line[:200]}")
+                else:
+                    _log(f"child: {line}")
+                self._cond.notify_all()
+
+    def _satisfied(self, want: str) -> bool:
+        if want.startswith("RESULT "):
+            return want.split(None, 1)[1] in self.results
+        return want in self.marks or any(m.split()[0] == want for m in self.marks)
+
+    def wait_for(self, want: str, timeout: float) -> bool:
+        """Block until the mark/result arrives, the child exits, or the
+        deadline passes. True only if the mark arrived."""
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while not self._satisfied(want):
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                if self.proc.poll() is not None and not self._reader.is_alive():
+                    return self._satisfied(want)
+                self._cond.wait(min(remaining, 1.0))
+            return True
+
+    def kill(self) -> None:
+        if self.proc.poll() is None:
+            try:
+                os.killpg(os.getpgid(self.proc.pid), signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                self.proc.kill()
+        try:
+            self.proc.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+    def run_schedule(self, schedule: list[tuple[str, float]], hard_deadline: float) -> str:
+        """Walk the (mark, timeout)-schedule. Returns 'ok' or the name of the
+        first mark that never arrived. Always reaps the child."""
+        for want, timeout in schedule:
+            timeout = min(timeout, max(5.0, hard_deadline - time.monotonic()))
+            if not self.wait_for(want, timeout):
+                _log(f"phase overdue waiting for '{want}' ({timeout:.0f}s) — killing child")
+                self.kill()
+                return want
+        # schedule satisfied; give the child a moment to exit cleanly
+        try:
+            self.proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            self.kill()
+        return "ok"
+
+
+def _parent() -> None:
+    """Orchestrates the phases. The one JSON line is emitted no matter what
+    — a parent-side exception must never eat an already-captured headline."""
+    doc: dict = {
+        "metric": "decode_tok_s_per_chip",
+        "value": 0.0,
+        "unit": "tok/s/chip",
+        "vs_baseline": 0.0,
+    }
+    notes: list[str] = []
+    try:
+        _parent_run(doc, notes)
+    except Exception as e:
+        notes.append(f"parent error: {e!r}")
+    finally:
+        print(json.dumps(doc), flush=True)
+        for n in notes:
+            _log(n)
+
+
+def _parent_run(doc: dict, notes: list[str]) -> None:
+    for sig in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP):
+        try:
+            signal.signal(sig, _parent_signal_cleanup)
+        except (ValueError, OSError):  # non-main thread (tests) / unsupported
+            pass
+    total_budget = float(os.environ.get("ACP_BENCH_TOTAL_BUDGET_S", "4500"))
+    t0 = time.monotonic()
+    hard_deadline = t0 + total_budget
+    probe_timeout = float(os.environ.get("ACP_BENCH_DEVICE_TIMEOUT_S", "120"))
+    window_s = float(os.environ.get("ACP_BENCH_PROBE_WINDOW_S", "900"))
+    build_timeout = float(os.environ.get("ACP_BENCH_BUILD_TIMEOUT_S", "2400"))
+    warm_timeout = float(os.environ.get("ACP_BENCH_WARM_TIMEOUT_S", "1200"))
+    deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "420"))
+    ttft_on = os.environ.get("ACP_BENCH_TTFT", "1") != "0"
+    ttft_timeout = float(os.environ.get("ACP_BENCH_TTFT_TIMEOUT_S", "1500"))
+    ab_on = os.environ.get("ACP_BENCH_AB", "1") != "0"
+    ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "1500"))
+    retries = int(os.environ.get("ACP_BENCH_RETRIES", "2"))
+    kv_layout = os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
+
+    force_cpu = _cpu_forced_inline()
+    cpu_flag = ["--force-cpu"] if force_cpu else []
+
+    if not force_cpu:
+        n = _probe_until(min(hard_deadline, time.monotonic() + window_s), probe_timeout)
+        if n is None:
+            notes.append(
+                f"FAILED: accelerator unreachable across {window_s:.0f}s probe window"
+            )
+            return
+
+    headline: dict | None = None
+    ttft: dict | None = None
+    main_schedule: list[tuple[str, float]] = [
+        ("attach_ok", probe_timeout),
+        ("engine_built", build_timeout),
+        ("warm_done", warm_timeout),
+        ("RESULT headline", deadline_s + 240),
+    ]
+    if ttft_on:
+        main_schedule.append(("RESULT ttft", ttft_timeout))
+
+    for attempt in range(1, retries + 1):
+        if time.monotonic() > hard_deadline - 120:
+            notes.append("total budget exhausted before main phase completed")
+            break
+        only_ttft = headline is not None
+        argv = ["--phase", "main", *cpu_flag]
+        if only_ttft:
+            argv.append("--only-ttft")
+        elif not ttft_on:
+            argv.append("--no-ttft")
+        schedule = (
+            [("attach_ok", probe_timeout), ("engine_built", build_timeout),
+             ("RESULT ttft", ttft_timeout)]
+            if only_ttft
+            else main_schedule
+        )
+        _log(f"main phase attempt {attempt} ({'ttft-only' if only_ttft else 'full'})")
+        run = _PhaseRun(argv)
+        status = run.run_schedule(schedule, hard_deadline)
+        got = run.results.get("headline")  # keep partials from killed children
+        headline = headline or (got if isinstance(got, dict) else None)
+        got = run.results.get("ttft")
+        ttft = ttft or (got if isinstance(got, dict) else None)
+        if status == "ok":
+            break
+        notes.append(f"main attempt {attempt} stalled at '{status}'")
+        if headline is not None and (not ttft_on or ttft is not None):
+            break
+        if attempt < retries and not force_cpu:
+            if _probe_until(
+                min(hard_deadline, time.monotonic() + window_s), probe_timeout
+            ) is None:
+                notes.append("tunnel did not come back for a retry")
+                break
+
+    if headline:
+        doc["value"] = headline.get("tok_s_per_chip", 0.0)
+        doc["vs_baseline"] = round(doc["value"] / TARGET_TOK_S, 3)
+        notes.append(str(headline.get("note", "")))
+    else:
+        notes.append("FAILED: no headline result captured from any child attempt")
+    if ttft_on:
+        doc["ttft_first_toolcall_ms"] = ttft if ttft else {"error": "ttft phase did not complete"}
+
+    remaining = hard_deadline - time.monotonic()
+    if ab_on and headline and remaining > 300:
+        other = "paged" if kv_layout == "slot" else "slot"
+        budget = min(ab_budget, remaining - 60)
+        _log(f"A/B phase ({other}) with {budget:.0f}s budget")
+        run = _PhaseRun(
+            ["--phase", "ab", "--layout", other, "--budget", str(budget), *cpu_flag]
+        )
+        status = run.run_schedule(
+            [("attach_ok", probe_timeout),
+             ("engine_built", min(build_timeout, budget)),
+             ("RESULT ab", budget)],
+            hard_deadline,
+        )
+        ab = run.results.get("ab")
+        if isinstance(ab, dict) and "tok_s_per_chip" in ab:
+            doc[f"{other}_tok_s_per_chip"] = ab["tok_s_per_chip"]
+            doc["kv_layout_winner"] = (
+                kv_layout if doc["value"] >= ab["tok_s_per_chip"] else other
+            )
+            notes.append(f"A/B {other}: {ab.get('note', '')}")
+        else:
+            doc["ab_error"] = f"ab phase stalled at '{status}'"
+    elif ab_on and headline:
+        doc["ab_skipped"] = f"only {remaining:.0f}s of total budget left"
+
+
+# ---------------------------------------------------------------------------
+# child side — the only code that may touch PJRT
+# ---------------------------------------------------------------------------
+
+
+def _mark(name: str) -> None:
+    print(f"MARK {name}", flush=True)
+
+
+def _result(key: str, payload: dict) -> None:
+    print(f"RESULT {key} {json.dumps(payload)}", flush=True)
+
+
+def _child(args: argparse.Namespace) -> None:
+    import jax
+
+    if args.force_cpu:
+        jax.config.update("jax_platforms", "cpu")
+
     preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")
     n_requests = int(os.environ.get("ACP_BENCH_REQUESTS", "64"))
     max_tokens = int(os.environ.get("ACP_BENCH_MAX_TOKENS", "64"))
     prompt_len = int(os.environ.get("ACP_BENCH_PROMPT_LEN", "128"))
     max_ctx = int(os.environ.get("ACP_BENCH_MAX_CTX", "512"))
     block = int(os.environ.get("ACP_BENCH_BLOCK", "16"))
-    kv_layout = os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
     quantize = os.environ.get("ACP_BENCH_QUANTIZE") or None
     deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "420"))
-    probe_timeout = float(os.environ.get("ACP_BENCH_DEVICE_TIMEOUT_S", "120"))
+    kv_layout = args.layout or os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
+    if args.budget:
+        deadline_s = min(deadline_s, args.budget / 3)
 
-    window_s = float(os.environ.get("ACP_BENCH_PROBE_WINDOW_S", "600"))
-    already_configured = _already_configured()
-    # one wall-clock deadline across re-execs (see below): a wedged tunnel
-    # can clear minutes later, but a hung in-process attach taints THIS
-    # process forever, so retries need a fresh process image
-    deadline_env = os.environ.get("ACP_BENCH_ATTACH_DEADLINE")
-    attach_deadline = float(deadline_env) if deadline_env else time.time() + window_s
-    probe_window = max(60.0, attach_deadline - time.time())
-    if not already_configured and not _wait_for_accelerator(
-        min(probe_timeout, 60.0), probe_window
-    ):
-        _emit(
-            0.0,
-            f"FAILED: accelerator unreachable across {probe_window:.0f}s of the "
-            f"{window_s:.0f}s retry window (wedged tunnel?)",
-        )
-        return
-    devices = _probe_devices(probe_timeout)
-    if devices is None:
-        if not already_configured and time.time() < attach_deadline - 90:
-            print(
-                f"# in-process attach hung ({probe_timeout:.0f}s); re-exec for a "
-                f"fresh attempt, {attach_deadline - time.time():.0f}s left",
-                file=sys.stderr, flush=True,
-            )
-            env = dict(os.environ)
-            env["ACP_BENCH_ATTACH_DEADLINE"] = str(attach_deadline)
-            sys.stderr.flush()
-            sys.stdout.flush()
-            os.execve(sys.executable, [sys.executable, os.path.abspath(__file__)], env)
-        _emit(0.0, f"FAILED: accelerator probe ok but jax.devices() hung within {probe_timeout:.0f}s")
-        return
+    devices = jax.devices()  # the parent watchdogs this line
     n_chips = len(devices)
-    bench_t0 = time.monotonic()
+    _mark(f"attach_ok {n_chips}")
+
+    import dataclasses
 
     from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
     from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
     from agentcontrolplane_tpu.models.llama import PRESETS
     from agentcontrolplane_tpu.parallel.mesh import serving_mesh
 
-    import dataclasses
-
     config = PRESETS[preset]
     if config.max_seq_len < max_ctx:  # small presets (tiny) honor the knob
         config = dataclasses.replace(config, max_seq_len=max_ctx)
-    ttft_on = os.environ.get("ACP_BENCH_TTFT", "1") != "0"
+    ttft_on = args.phase == "main" and not args.no_ttft
 
-    def build_engine(layout: str):
-        eng = Engine(
-            config=config,
-            tokenizer=ByteTokenizer(),
-            mesh=serving_mesh(),
-            max_slots=n_requests,
-            max_ctx=max_ctx,
-            prefill_buckets=(prompt_len, max_ctx),
-            decode_block_size=block,
-            kv_layout=layout,
-            quantize=quantize,
-            seed=0,
-        )
-        if ttft_on:
-            # build the constraint token table up front so EVERY program in
-            # this process (headline warm included) traces against the real
-            # table shape — otherwise the TTFT phase's table build would
-            # orphan the dummy-shaped compiles the headline phase paid for
-            eng._get_token_table()
-        eng.start()
-        return eng
+    engine = Engine(
+        config=config,
+        tokenizer=ByteTokenizer(),
+        mesh=serving_mesh(),
+        max_slots=n_requests,
+        max_ctx=max_ctx,
+        prefill_buckets=(prompt_len, max_ctx),
+        decode_block_size=block,
+        kv_layout=kv_layout,
+        quantize=quantize,
+        seed=0,
+    )
+    if ttft_on or (args.phase == "ab" and os.environ.get("ACP_BENCH_TTFT", "1") != "0"):
+        # build the constraint token table up front so EVERY program in this
+        # process (headline warm included) traces against the real table
+        # shape — otherwise the TTFT phase's table build would orphan the
+        # dummy-shaped compiles the headline phase paid for. The ab child
+        # mirrors the headline child's condition so the two layouts are
+        # measured under identical HBM/compiled-program conditions.
+        engine._get_token_table()
+    engine.start()
+    _mark("engine_built")
 
     prompt = [1 + (i % 250) for i in range(prompt_len - 1)]
     sampling = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=max_tokens)
 
     def measure(
-        eng, deadline_s: float = deadline_s, warm_timeout: float = 600.0
+        warm_timeout: float = float(os.environ.get("ACP_BENCH_WARM_TIMEOUT_S", "1200")),
+        drain: bool = True,
     ) -> tuple[float, int, float, int]:
         """Warmup (compiles every jit entry the burst hits: batched prefill
         chunks, max-width decode, the narrow decay widths) then the measured
         full-width burst. Returns (tok/s/chip, tokens, elapsed, done)."""
         warm = [
-            eng.submit(list(prompt), SamplingParams(temperature=0.0, max_tokens=block + 1))
+            engine.submit(list(prompt), SamplingParams(temperature=0.0, max_tokens=block + 1))
             for _ in range(n_requests)
         ]
         warm_deadline = time.monotonic() + warm_timeout
         for f in warm:
             f.result(timeout=max(1.0, warm_deadline - time.monotonic()))
+        _mark("warm_done")
         t0 = time.monotonic()
-        toks0 = eng.tokens_generated
-        futures = [eng.submit(list(prompt), sampling) for _ in range(n_requests)]
+        toks0 = engine.tokens_generated
+        futures = [engine.submit(list(prompt), sampling) for _ in range(n_requests)]
         deadline = t0 + deadline_s
         done = 0
         for f in futures:
@@ -243,72 +460,55 @@ def main() -> None:
             except Exception:
                 break
         elapsed = time.monotonic() - t0
-        total = eng.tokens_generated - toks0
-        # drain leftovers so the next phase measures an idle engine
+        total = engine.tokens_generated - toks0
+        # drain leftovers so any next phase in THIS process measures an idle
+        # engine; skipped when the result is about to be emitted and the
+        # process exits (the parent's mark deadline must not eat the drain)
         for f in futures:
-            eng.cancel(f)
-        drain_deadline = time.monotonic() + 120
-        while time.monotonic() < drain_deadline:
-            s = eng.stats()
-            if s["active_slots"] == 0 and s["waiting"] == 0:
-                break
-            time.sleep(0.2)
+            engine.cancel(f)
+        if drain:
+            drain_deadline = time.monotonic() + 120
+            while time.monotonic() < drain_deadline:
+                s = engine.stats()
+                if s["active_slots"] == 0 and s["waiting"] == 0:
+                    break
+                time.sleep(0.2)
         return (total / elapsed) / max(n_chips, 1), total, elapsed, done
 
-    engine = build_engine(kv_layout)
-    tok_s_chip, total_tokens, elapsed, done = measure(engine)
-    note = (
-        f"{total_tokens} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
-        f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "
-        f"{done}/{n_requests} requests completed"
-        + ("" if done == n_requests else " (deadline hit; partial but honest)")
-    )
-
-    extra: dict = {}
-    if ttft_on:
-        try:
-            extra["ttft_first_toolcall_ms"] = _bench_ttft(engine)
-        except Exception as e:  # TTFT failure must not lose the headline number
-            extra["ttft_error"] = str(e)
-    engine.stop()
-    del engine  # free weights+KV HBM before building the A/B engine
-
-    # slot-vs-paged A/B: re-run the same burst against the other KV layout
-    # and record which wins (VERDICT r1 #2). Budgeted: never runs past
-    # ACP_BENCH_AB_BUDGET_S of total bench wall time, so a slow first phase
-    # can't push the headline emit past the driver's patience.
-    ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "900"))
-    spent = time.monotonic() - bench_t0
-    remaining = ab_budget - spent
-    # approximately bounded: warmup and the measured burst each get a
-    # quarter of the remaining budget, the drain adds <=120s; engine-build
-    # compile time is the one unbounded piece (first build of this layout)
-    if os.environ.get("ACP_BENCH_AB", "1") != "0" and remaining > 240:
-        other = "paged" if kv_layout == "slot" else "slot"
-        try:
-            eng2 = build_engine(other)
-            ab_tok_s, ab_total, ab_elapsed, ab_done = measure(
-                eng2,
-                deadline_s=min(deadline_s, remaining / 4),
-                warm_timeout=max(60.0, remaining / 4),
-            )
-            eng2.stop()
-            extra[f"{other}_tok_s_per_chip"] = round(ab_tok_s, 1)
-            extra["kv_layout_winner"] = (
-                kv_layout if tok_s_chip >= ab_tok_s else other
-            )
-            print(
-                f"# A/B {other}: {ab_total} tokens in {ab_elapsed:.2f}s "
-                f"({ab_done}/{n_requests} done)",
-                file=sys.stderr, flush=True,
-            )
-        except Exception as e:
-            extra["ab_error"] = str(e)
-    elif remaining <= 240:
-        extra["ab_skipped"] = (
-            f"only {remaining:.0f}s of ACP_BENCH_AB_BUDGET_S left after {spent:.0f}s"
+    if args.phase == "ab":
+        tok_s, total, elapsed, done = measure(
+            warm_timeout=max(60.0, (args.budget or 900) / 3), drain=False
         )
-    _emit(tok_s_chip, note, extra or None)
+        _result("ab", {
+            "tok_s_per_chip": round(tok_s, 1),
+            "note": (
+                f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); kv={kv_layout} "
+                f"quant={quantize or 'bf16'}; {done}/{n_requests} done"
+            ),
+        })
+        engine.stop()
+        return
+
+    if not args.only_ttft:
+        tok_s, total, elapsed, done = measure(drain=ttft_on)
+        _result("headline", {
+            "tok_s_per_chip": round(tok_s, 1),
+            "note": (
+                f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
+                f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "
+                f"{done}/{n_requests} requests completed"
+                + ("" if done == n_requests else " (deadline hit; partial but honest)")
+            ),
+        })
+    else:
+        _mark("warm_done")
+
+    if ttft_on or args.only_ttft:
+        try:
+            _result("ttft", _bench_ttft(engine))
+        except Exception as e:  # TTFT failure must not lose the headline
+            _result("ttft", {"error": str(e)})
+    engine.stop()
 
 
 def _bench_ttft(engine) -> dict:
@@ -322,7 +522,6 @@ def _bench_ttft(engine) -> dict:
     from agentcontrolplane_tpu.api.resources import (
         LLM, BaseConfig, LLMSpec, TPUProviderConfig,
     )
-    from agentcontrolplane_tpu.engine.engine import SamplingParams
     from agentcontrolplane_tpu.operator import Operator, OperatorOptions
     from tests.fixtures import make_agent, make_task, setup_with_status
 
@@ -340,6 +539,7 @@ def _bench_ttft(engine) -> dict:
     # produce — each miss was a 20-40s tunnel compile COUNTED INTO TTFT
     # (r1's 41s p50 was compile stalls, not serving latency).
     engine.prewarm(constrained=True)
+    _mark("ttft_prewarmed")
 
     async def run() -> dict:
         op = Operator(
@@ -414,6 +614,21 @@ def _bench_ttft(engine) -> dict:
         }
 
     return asyncio.run(run())
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--phase", choices=["main", "ab"], default=None)
+    ap.add_argument("--force-cpu", action="store_true")
+    ap.add_argument("--no-ttft", action="store_true")
+    ap.add_argument("--only-ttft", action="store_true")
+    ap.add_argument("--layout", default=None)
+    ap.add_argument("--budget", type=float, default=None)
+    args = ap.parse_args()
+    if args.phase:
+        _child(args)
+    else:
+        _parent()
 
 
 if __name__ == "__main__":

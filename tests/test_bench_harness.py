@@ -1,0 +1,162 @@
+"""The bench parent/child watchdog protocol (bench.py).
+
+Rounds 1 and 2 both shipped BENCH_rNN.json = 0.0 because the bench's main
+process initialized PJRT itself and hung on a wedged tunnel. The round-3
+contract: the parent NEVER touches PJRT, children report MARK/RESULT lines,
+and the parent kills + retries a child that misses a mark deadline. These
+tests drive that protocol against stub children (no JAX involved).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import textwrap
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+import bench
+
+
+@pytest.fixture
+def stub_child(tmp_path, monkeypatch):
+    """Point bench._THIS at a stub script; returns a setter for its body."""
+
+    def make(body: str) -> str:
+        path = tmp_path / "stub_child.py"
+        path.write_text(
+            "import sys, time, json\n" + textwrap.dedent(body)
+        )
+        monkeypatch.setattr(bench, "_THIS", str(path))
+        return str(path)
+
+    return make
+
+
+def test_phase_run_collects_marks_and_results(stub_child):
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        print("diagnostic noise", flush=True)
+        print("MARK engine_built", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 123.4, "note": "n"}', flush=True)
+        """
+    )
+    run = bench._PhaseRun(["--phase", "main"])
+    status = run.run_schedule(
+        [("attach_ok", 10), ("engine_built", 10), ("RESULT headline", 10)],
+        hard_deadline=time.monotonic() + 30,
+    )
+    assert status == "ok"
+    assert run.results["headline"]["tok_s_per_chip"] == 123.4
+
+
+def test_phase_run_kills_child_that_misses_a_mark(stub_child):
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        time.sleep(600)  # simulates a hung PJRT attach after the first mark
+        """
+    )
+    run = bench._PhaseRun(["--phase", "main"])
+    t0 = time.monotonic()
+    status = run.run_schedule(
+        [("attach_ok", 10), ("engine_built", 2), ("RESULT headline", 10)],
+        hard_deadline=time.monotonic() + 60,
+    )
+    assert status == "engine_built"
+    assert time.monotonic() - t0 < 30  # did not wait out the sleep
+    assert run.proc.poll() is not None  # child is dead
+
+
+def test_phase_run_keeps_partial_results_from_killed_child(stub_child):
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        print("MARK engine_built", flush=True)
+        print("MARK warm_done", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 999.0, "note": "n"}', flush=True)
+        time.sleep(600)  # hangs during the TTFT leg
+        """
+    )
+    run = bench._PhaseRun(["--phase", "main"])
+    status = run.run_schedule(
+        [("attach_ok", 10), ("engine_built", 10), ("warm_done", 10),
+         ("RESULT headline", 10), ("RESULT ttft", 2)],
+        hard_deadline=time.monotonic() + 60,
+    )
+    assert status == "RESULT ttft"
+    assert run.results["headline"]["tok_s_per_chip"] == 999.0  # partial kept
+
+
+def test_phase_run_child_exit_without_mark_is_a_miss(stub_child):
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        sys.exit(3)  # crashed before building the engine
+        """
+    )
+    run = bench._PhaseRun(["--phase", "main"])
+    status = run.run_schedule(
+        [("attach_ok", 10), ("engine_built", 5)],
+        hard_deadline=time.monotonic() + 30,
+    )
+    assert status == "engine_built"
+
+
+def test_unparseable_result_line_does_not_crash_reader(stub_child):
+    stub_child(
+        """
+        print("RESULT headline {not json", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 1.0}', flush=True)
+        """
+    )
+    run = bench._PhaseRun(["--phase", "main"])
+    status = run.run_schedule(
+        [("RESULT headline", 10)], hard_deadline=time.monotonic() + 30
+    )
+    assert status == "ok"
+    assert run.results["headline"] == {"tok_s_per_chip": 1.0}
+
+
+def test_parent_never_imports_engine_or_inits_pjrt():
+    """Static contract: the parent path must not call jax.devices() or
+    import the engine — only children may. Guards against regressing to the
+    r01/r02 architecture."""
+    import ast
+    import inspect
+
+    parent_src = textwrap.dedent(inspect.getsource(bench._parent)) + "\n" + textwrap.dedent(
+        inspect.getsource(bench._parent_run)
+    )
+    tree = ast.parse(parent_src)
+    calls = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Attribute) and n.attr in ("devices", "local_devices")
+    ]
+    assert not calls, "parent must never call jax.devices()"
+    imports = [
+        n for n in ast.walk(tree)
+        if isinstance(n, (ast.Import, ast.ImportFrom))
+        and "agentcontrolplane_tpu" in ast.dump(n)
+    ]
+    assert not imports, "parent must not import the engine package"
+
+
+def test_parent_emits_json_line_even_when_run_raises(monkeypatch, capsys):
+    """A parent-side crash must still print the one JSON line (driver
+    contract) — the r01/r02 artifacts were unusable precisely because a
+    failure path skipped the emit."""
+    import json
+
+    def boom(doc, notes):
+        doc["value"] = 0.0
+        raise RuntimeError("synthetic parent failure")
+
+    monkeypatch.setattr(bench, "_parent_run", boom)
+    bench._parent()
+    out = capsys.readouterr().out.strip().splitlines()
+    doc = json.loads(out[-1])
+    assert doc["metric"] == "decode_tok_s_per_chip"
