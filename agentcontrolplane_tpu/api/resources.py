@@ -127,6 +127,13 @@ class TPUProviderConfig(APIModel):
     max_context: int = 8192
     page_size: int = 16
     quantization: Optional[Literal["int8"]] = None
+    # Per-request generation timeout. Defaults to the reference's 30 s
+    # LLMRequestTimeout (task_controller.go:25) so a wedged generation
+    # cannot hold a task lease for minutes; raise it for long generations
+    # under heavy continuous-batching load, or when serving without
+    # prewarm (a cold XLA compile on a first-hit shape costs 20-40s and
+    # would otherwise 504 until the compile cache warms).
+    request_timeout_seconds: float = Field(default=30.0, gt=0)
 
 
 class LLMSpec(APIModel):

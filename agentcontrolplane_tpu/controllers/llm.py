@@ -76,7 +76,7 @@ class LLMReconciler:
             # acp-tpu run --tpu-tp/--tpu-sp); the CR's parallelism fields
             # are declarative intent, so a mismatch is a config error the
             # user must see at LLM validation time, not silently ignored
-            engine = getattr(self.llm_factory, "_engine", None)
+            engine = self.llm_factory.engine
             if engine is not None:
                 shape = dict(engine.mesh.shape)
                 want_tp = llm.spec.tpu.tensor_parallelism

@@ -27,9 +27,15 @@ class TPUEngineClient(LLMClient):
         params: BaseConfig,
         force_json_tools: bool = False,
         tool_choice: str = "auto",
+        request_timeout_s: float = 30.0,
     ):
         self.engine = engine
         self.params = params
+        # LLM.spec.tpu.requestTimeoutSeconds — mirrors the reference's 30 s
+        # LLMRequestTimeout (task_controller.go:25): a wedged generation
+        # fails the request (5xx -> reconciler retry) instead of holding the
+        # task lease for minutes
+        self.request_timeout_s = request_timeout_s
         # LLM.spec.providerConfig["force_json_tools"]: grammar-constrain the
         # response to a JSON object whenever tools are offered (guaranteed
         # parseable tool calls at the cost of forbidding prose answers)
@@ -82,9 +88,20 @@ class TPUEngineClient(LLMClient):
         )
         future = self.engine.submit(prompt, sampling)
         try:
-            result = await asyncio.wait_for(asyncio.wrap_future(future), timeout=600)
+            result = await asyncio.wait_for(
+                asyncio.wrap_future(future), timeout=self.request_timeout_s
+            )
         except asyncio.TimeoutError:
-            raise LLMRequestError(504, "TPU engine generation timed out")
+            self.engine.cancel(future)  # free the slot; don't decode for a dead request
+            raise LLMRequestError(
+                504,
+                f"TPU engine generation timed out after {self.request_timeout_s:.0f}s",
+            )
+        except asyncio.CancelledError:
+            # caller torn down mid-generation (operator shutdown, lease loss):
+            # free the slot instead of decoding to max_tokens for a dead caller
+            self.engine.cancel(future)
+            raise
         except Exception as e:
             raise LLMRequestError(500, f"TPU engine failure: {e}")
         allowed = {t.function.name for t in tools} if tools else None

@@ -364,6 +364,7 @@ class Engine:
         # generations don't pin capacity to max_tokens
         self._cancelled: set[str] = set()
         self._admission_held = 0  # hold depth; see hold_admission()
+        self._admission_lock = threading.Lock()  # guards the depth counter
         # device-resident decode state (see _decode_once): None until the
         # first block; _state_dirty forces a re-upload of the host mirrors
         # whenever slot assignment changed (admission/finish/cancel/restart)
@@ -919,11 +920,13 @@ class Engine:
         spill batch shapes form on the first attempt instead of racing the
         engine loop's drain timing — a missed shape there is a 20-40s cold
         compile in the middle of real serving."""
-        self._admission_held += 1
+        with self._admission_lock:
+            self._admission_held += 1
         try:
             yield
         finally:
-            self._admission_held -= 1
+            with self._admission_lock:
+                self._admission_held -= 1
 
     def _admit(self, block: bool) -> bool:
         """Move queued requests into free slots (prefill), strictly FIFO.
@@ -1069,7 +1072,7 @@ class Engine:
                         self.params,
                         self.cache,
                         self._put(toks),
-                        jnp.full(B, CH, dtype=np.int32),
+                        self._put(np.full(B, CH, dtype=np.int32)),
                         self._put(starts),
                         self._put(page_ids),
                         block_tables,
@@ -1080,7 +1083,7 @@ class Engine:
                         self.params,
                         self.cache,
                         self._put(toks),
-                        jnp.full(B, CH, dtype=np.int32),
+                        self._put(np.full(B, CH, dtype=np.int32)),
                         self._put(starts),
                         self._put(slots),
                         *tail,

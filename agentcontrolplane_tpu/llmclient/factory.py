@@ -10,7 +10,7 @@ from __future__ import annotations
 
 from typing import Optional, Protocol
 
-from ..api.resources import LLM, Secret, SecretKeyRef
+from ..api.resources import LLM, Secret, SecretKeyRef, TPUProviderConfig
 from ..kernel.errors import Invalid, NotFound
 from ..kernel.store import Store
 from .anthropic import AnthropicClient
@@ -21,6 +21,13 @@ from .openai import OpenAICompatibleClient
 
 class LLMClientFactory(Protocol):
     async def create_client(self, llm: LLM, api_key: str) -> LLMClient: ...
+
+    @property
+    def engine(self):
+        """The in-process TPU serving engine, or None when this factory only
+        routes to external providers. Public so reconcilers can validate
+        declarative parallelism specs against the live mesh."""
+        ...
 
 
 def resolve_secret_key(store: Store, namespace: str, ref: Optional[SecretKeyRef]) -> str:
@@ -48,6 +55,10 @@ class DefaultLLMClientFactory:
     def __init__(self, engine=None):
         self._engine = engine
         self._http_pool: dict[tuple, "httpx.AsyncClient"] = {}
+
+    @property
+    def engine(self):
+        return self._engine
 
     def _pooled_http(self, key: tuple, build) -> "httpx.AsyncClient":
         http = self._http_pool.get(key)
@@ -102,6 +113,9 @@ class DefaultLLMClientFactory:
                     llm.spec.provider_config.get("force_json_tools", False)
                 ),
                 tool_choice=str(llm.spec.provider_config.get("tool_choice", "auto")),
+                request_timeout_s=(
+                    llm.spec.tpu or TPUProviderConfig()
+                ).request_timeout_seconds,
             )
         if provider == "mock":
             return MockLLMClient()
@@ -120,6 +134,10 @@ class MockLLMClientFactory:
     def __init__(self, client: LLMClient):
         self.client = client
         self.calls: list[LLM] = []
+
+    @property
+    def engine(self):
+        return None
 
     async def create_client(self, llm: LLM, api_key: str) -> LLMClient:
         self.calls.append(llm)

@@ -250,7 +250,7 @@ async def test_llm_controller_tpu_mesh_mismatch_is_invalid(store):
             shape = {"sp": 1, "tp": 2}
 
     class FakeFactory:
-        _engine = FakeEngine()
+        engine = FakeEngine()
 
     rec = LLMReconciler(store, EventRecorder(store), FakeFactory(), probe=False)
     store.create(

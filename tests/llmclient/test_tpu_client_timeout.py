@@ -1,0 +1,80 @@
+"""Spec-configurable engine request timeout (VERDICT r2 #9).
+
+The reference bounds every LLM call at 30 s (LLMRequestTimeout,
+acp/internal/controller/task/task_controller.go:25) so a wedged provider
+can't hold the per-task lease. provider: tpu must honor the same contract:
+LLM.spec.tpu.requestTimeoutSeconds flows to TPUEngineClient, a timed-out
+generation raises a retryable 5xx, and the request's slot is cancelled so
+the engine stops decoding for a dead caller.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import Future
+
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM,
+    BaseConfig,
+    LLMSpec,
+    Message,
+    TPUProviderConfig,
+)
+from agentcontrolplane_tpu.engine.client import TPUEngineClient
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.llmclient.base import LLMRequestError
+from agentcontrolplane_tpu.llmclient.factory import DefaultLLMClientFactory
+
+
+class _StuckEngine:
+    """Engine stub whose generations never finish (wedged device)."""
+
+    tokenizer = ByteTokenizer()
+
+    def __init__(self):
+        self.cancelled: list[Future] = []
+
+    def ensure_running(self) -> bool:
+        return True
+
+    def submit(self, prompt, sampling) -> Future:
+        return Future()  # never resolves
+
+    def cancel(self, future: Future) -> None:
+        self.cancelled.append(future)
+
+
+def test_timed_out_generation_raises_5xx_and_frees_the_slot():
+    engine = _StuckEngine()
+    client = TPUEngineClient(engine, BaseConfig(), request_timeout_s=0.1)
+
+    async def run():
+        with pytest.raises(LLMRequestError) as ei:
+            await client.send_request([Message(role="user", content="hi")], [])
+        return ei.value
+
+    err = asyncio.run(run())
+    assert err.status_code == 504  # 5xx -> the task reconciler retries
+    assert len(engine.cancelled) == 1  # slot freed; no decode for a dead caller
+
+
+def test_request_timeout_flows_from_llm_spec():
+    factory = DefaultLLMClientFactory(engine=_StuckEngine())
+    llm = LLM(
+        metadata=ObjectMeta(name="l"),
+        spec=LLMSpec(
+            provider="tpu",
+            parameters=BaseConfig(),
+            tpu=TPUProviderConfig(preset="tiny", request_timeout_seconds=7.5),
+        ),
+    )
+    client = asyncio.run(factory.create_client(llm, ""))
+    assert isinstance(client, TPUEngineClient)
+    assert client.request_timeout_s == 7.5
+
+
+def test_request_timeout_default_matches_reference():
+    assert TPUProviderConfig().request_timeout_seconds == 30.0
