@@ -27,14 +27,22 @@ class TPUEngineClient(LLMClient):
         params: BaseConfig,
         force_json_tools: bool = False,
         tool_choice: str = "auto",
-        request_timeout_s: float = 30.0,
+        request_timeout_s: float | None = None,
     ):
         self.engine = engine
         self.params = params
         # LLM.spec.tpu.requestTimeoutSeconds — mirrors the reference's 30 s
         # LLMRequestTimeout (task_controller.go:25): a wedged generation
         # fails the request (5xx -> reconciler retry) instead of holding the
-        # task lease for minutes
+        # task lease for minutes. None = the spec field's default, so the
+        # two never drift. A generation that legitimately needs longer than
+        # the bound (huge max_tokens under full continuous-batching load)
+        # must raise the spec value — the same contract the reference
+        # imposes on every external provider.
+        if request_timeout_s is None:
+            from ..api.resources import TPUProviderConfig
+
+            request_timeout_s = TPUProviderConfig().request_timeout_seconds
         self.request_timeout_s = request_timeout_s
         # LLM.spec.providerConfig["force_json_tools"]: grammar-constrain the
         # response to a JSON object whenever tools are offered (guaranteed
