@@ -192,7 +192,10 @@ def render_system(system: str, tools: Sequence[Tool]) -> str:
 
 
 def _turn(role: str, content: str) -> str:
-    return f"{SH}{ROLE_HEADER[role]}{EH}\n\n{content}{EOT}"
+    # content is trimmed exactly like the official Llama-3 chat template's
+    # ``message['content'] | trim`` — verified token-for-token against HF
+    # transformers' apply_chat_template in tests/engine/test_golden_fidelity.py
+    return f"{SH}{ROLE_HEADER[role]}{EH}\n\n{content.strip()}{EOT}"
 
 
 def render_turns(

@@ -1,0 +1,124 @@
+"""Golden-vector fidelity for the real-checkpoint serving path (VERDICT r2 #4).
+
+``HFTokenizer`` + ``render_prompt`` are the only two steps between a real
+Llama-3 checkpoint directory and the engine; these tests pin both against
+independently generated goldens (HF ``transformers``' fast tokenizer and
+``apply_chat_template`` with the official Llama-3 Jinja template) over a
+Llama-3-structured tokenizer.json — same byte-level BPE pipeline, split
+regex, ByteLevel alphabet, and special-token set as the real checkpoint
+asset. See ``golden/build_goldens.py`` for how the assets are produced;
+with a real downloaded tokenizer.json the code path is identical, so the
+only untested step is the download itself.
+"""
+
+from __future__ import annotations
+
+import json
+import pathlib
+
+import pytest
+
+from agentcontrolplane_tpu.api.resources import Message
+from agentcontrolplane_tpu.engine.tokenizer import HFTokenizer, render_prompt
+
+GOLDEN = pathlib.Path(__file__).parent / "golden"
+
+
+@pytest.fixture(scope="module")
+def tok() -> HFTokenizer:
+    return HFTokenizer(str(GOLDEN / "tokenizer.json"))
+
+
+@pytest.fixture(scope="module")
+def vectors() -> list[dict]:
+    return json.loads((GOLDEN / "vectors.json").read_text())
+
+
+@pytest.fixture(scope="module")
+def chat_goldens() -> list[dict]:
+    return json.loads((GOLDEN / "chat_goldens.json").read_text())
+
+
+def test_encode_matches_transformers_golden_vectors(tok, vectors):
+    for v in vectors:
+        assert tok.encode(v["text"]) == v["ids"], f"encode mismatch: {v['text']!r}"
+
+
+def test_decode_matches_transformers_golden_vectors(tok, vectors):
+    for v in vectors:
+        assert tok.decode(v["ids"]) == v["decoded"], f"decode mismatch: {v['text']!r}"
+
+
+def test_round_trip_is_lossless_for_plain_text(tok, vectors):
+    """Byte-level BPE must reconstruct every input exactly (no normalizer)."""
+    for v in vectors:
+        assert tok.decode(tok.encode(v["text"])) == v["decoded"]
+
+
+def test_stop_tokens_are_the_llama3_terminators(tok):
+    ids = {tok._tok.token_to_id(s) for s in ("<|eot_id|>", "<|end_of_text|>")}
+    assert None not in ids
+    assert tok.stop_tokens == ids
+
+
+def test_token_bytes_inverts_the_bytelevel_alphabet(tok, vectors):
+    """The grammar-constraint engine walks candidate tokens byte-by-byte;
+    token_bytes must agree with what the tokenizer actually decodes."""
+    for v in vectors:
+        ids = v["ids"]
+        specials = tok.stop_tokens | {
+            tok._tok.token_to_id(s)
+            for s in ("<|begin_of_text|>", "<|start_header_id|>",
+                      "<|end_header_id|>", "<|python_tag|>")
+        }
+        if any(i in specials for i in ids):
+            continue  # specials have no byte expansion (token_bytes -> None)
+        blob = b"".join(tok.token_bytes(i) for i in ids)
+        assert blob.decode("utf-8") == v["decoded"]
+
+
+def test_specials_have_no_byte_expansion(tok):
+    for s in ("<|begin_of_text|>", "<|eot_id|>", "<|end_of_text|>"):
+        assert tok.token_bytes(tok._tok.token_to_id(s)) is None
+
+
+def test_chat_template_matches_transformers_render(chat_goldens):
+    """render_prompt == transformers.apply_chat_template (official Llama-3
+    template: bos, header turns, trimmed content, generation prompt)."""
+    for case in chat_goldens:
+        messages = [Message(**m) for m in case["messages"]]
+        assert render_prompt(messages, []) == case["rendered"]
+
+
+def test_chat_template_tokenizes_to_transformers_ids(tok, chat_goldens):
+    """End-to-end: our render + our tokenizer == transformers' tokenized
+    chat — the exact token stream a real checkpoint would be served."""
+    for case in chat_goldens:
+        messages = [Message(**m) for m in case["messages"]]
+        assert tok.encode(render_prompt(messages, [])) == case["ids"]
+
+
+def test_goldens_regenerate_deterministically():
+    """Guard the assets against silent drift: rebuilding from the checked-in
+    builder must reproduce the checked-in vectors byte-for-byte."""
+    import subprocess
+    import sys
+    import tempfile
+    import shutil
+
+    pytest.importorskip("transformers")  # builder-only dependency
+
+    with tempfile.TemporaryDirectory() as td:
+        dst = pathlib.Path(td) / "golden"
+        shutil.copytree(GOLDEN, dst)
+        # regenerate in the copy and compare the derived assets (the BPE
+        # train is deterministic given the same corpus+trainer settings)
+        build = dst / "build_goldens.py"
+        out = subprocess.run(
+            [sys.executable, str(build)], capture_output=True, text=True, timeout=300
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        for name in ("vectors.json", "chat_goldens.json"):
+            assert (dst / name).read_text() == (GOLDEN / name).read_text(), (
+                f"{name} drifted from its builder"
+            )
