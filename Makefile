@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-unit test-e2e bench run lint dryrun ci
+.PHONY: test test-unit test-e2e test-stress bench run lint dryrun ci
 
 test:
 	$(PY) -m pytest tests/ -x -q
@@ -13,6 +13,9 @@ test-unit:
 
 test-e2e:
 	$(PY) -m pytest tests/e2e -x -q
+
+test-stress:
+	ACP_STRESS=1 $(PY) -m pytest tests/e2e/test_tpu_provider.py -k test_64_concurrent_tasks_stress -x -q
 
 bench:
 	$(PY) bench.py

@@ -13,11 +13,6 @@ import json
 import os
 from typing import Any, Optional
 
-try:  # POSIX-only; imported pre-fork (an import inside preexec_fn could
-    import resource as _resource  # deadlock on the import lock in the child)
-except ImportError:  # pragma: no cover
-    _resource = None
-
 PROTOCOL_VERSION = "2024-11-05"
 
 
@@ -55,25 +50,33 @@ class StdioMCPClient:
         self._lock = asyncio.Lock()
         self.server_info: dict[str, Any] = {}
 
-    def _preexec(self):
-        # child-side: apply the memory limit before exec (the standalone
-        # equivalent of the reference's pod resource limits)
-        if self.memory_limit and _resource is not None:
-            _resource.setrlimit(
-                _resource.RLIMIT_AS, (self.memory_limit, self.memory_limit)
-            )
+    def _argv(self) -> list[str]:
+        """Command line, with the memory limit (the standalone equivalent of
+        the reference's pod resource limits) applied via a ``/bin/sh ulimit``
+        shim rather than ``preexec_fn``: preexec_fn forces subprocess down
+        the fork() path, which is deadlock-prone (and warns loudly) in a
+        process whose JAX runtime has live threads — the shim keeps the
+        spawn on posix_spawn."""
+        if not self.memory_limit or os.name != "posix":
+            return [self.command, *self.args]
+        kb = max(1, self.memory_limit // 1024)
+        # ulimit soft-fails (';', stderr dropped): platforms that refuse
+        # RLIMIT_AS still start the server limitless, matching the old
+        # preexec_fn's graceful degradation
+        return [
+            "/bin/sh", "-c", f'ulimit -v {kb} 2>/dev/null; exec "$0" "$@"',
+            self.command, *self.args,
+        ]
 
     async def start(self, timeout: float = 15.0) -> None:
         env = dict(os.environ)
         env.update(self.env)
         self._proc = await asyncio.create_subprocess_exec(
-            self.command,
-            *self.args,
+            *self._argv(),
             stdin=asyncio.subprocess.PIPE,
             stdout=asyncio.subprocess.PIPE,
             stderr=asyncio.subprocess.DEVNULL,
             env=env,
-            preexec_fn=self._preexec if self.memory_limit else None,
         )
         result = await self._request(
             "initialize",
