@@ -3,7 +3,23 @@
 
 PY ?= python
 
-.PHONY: test test-unit test-e2e test-stress bench run lint dryrun ci
+.PHONY: test test-unit test-e2e test-stress bench run lint dryrun ci \
+	docker-build docker-run observability-up observability-down
+
+IMG ?= acp-tpu:dev
+JAX_EXTRA ?=
+
+docker-build:  ## build the operator+engine image (JAX_EXTRA=tpu for TPU VMs)
+	docker build -f deploy/Dockerfile --build-arg JAX_EXTRA=$(JAX_EXTRA) -t $(IMG) .
+
+docker-run:  ## serve BASELINE config 1 shape locally (REST on :8080)
+	docker run --rm -p 8080:8080 $(IMG)
+
+observability-up:  ## otel-collector + prometheus + grafana (dashboard: ACP-TPU)
+	docker compose -f deploy/observability/docker-compose.yaml up -d
+
+observability-down:
+	docker compose -f deploy/observability/docker-compose.yaml down
 
 test:
 	$(PY) -m pytest tests/ -x -q

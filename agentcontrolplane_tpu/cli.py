@@ -96,6 +96,7 @@ def cmd_run(args) -> int:
         identity=args.identity or f"acp-tpu-{os.getpid()}",
         leader_election=args.leader_elect,
         api_port=args.port,
+        api_host=args.host,
         api_token=args.api_token,
         engine=engine,
     )
@@ -496,6 +497,10 @@ def build_parser() -> argparse.ArgumentParser:
     run = sub.add_parser("run", help="run the operator")
     run.add_argument("--db", default=None, help="sqlite state path (default: in-memory)")
     run.add_argument("--port", type=int, default=8082)
+    run.add_argument(
+        "--host", default="127.0.0.1",
+        help="REST bind address (0.0.0.0 inside containers)",
+    )
     run.add_argument("--identity", default=None)
     run.add_argument("--leader-elect", action="store_true")
     run.add_argument(

@@ -1631,6 +1631,10 @@ class Engine:
         REGISTRY.gauge_set(
             "acp_engine_active_slots", len(self._slots), help="occupied decode slots"
         )
+        REGISTRY.gauge_set(
+            "acp_engine_waiting_requests", len(self._waiting),
+            help="admission queue depth",
+        )
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.pop(slot)

@@ -39,6 +39,9 @@ class OperatorOptions:
     identity: str = "acp-tpu-0"
     leader_election: bool = False
     api_port: int = 8082
+    # bind address; 127.0.0.1 for local dev, 0.0.0.0 inside a container
+    # (deploy/Dockerfile) where loopback is unreachable from outside
+    api_host: str = "127.0.0.1"
     # non-empty = require "Authorization: Bearer <token>" on every REST route
     # except health probes (reference posture: acp/cmd/main.go:167-206)
     api_token: str = ""
@@ -107,7 +110,7 @@ class Operator:
         if self.options.enable_rest:
             from .server.rest import RestServer
 
-            self.rest_server = RestServer(self)
+            self.rest_server = RestServer(self, host=self.options.api_host)
             self.manager.add_runnable(
                 self.rest_server.run, leader_gated=self.options.leader_election
             )

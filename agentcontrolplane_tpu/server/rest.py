@@ -925,7 +925,60 @@ class RestServer:
         return web.json_response({"configured": True, **engine.stats()})
 
     async def metrics(self, request: web.Request) -> web.Response:
+        self._update_phase_gauges()
         return web.Response(text=REGISTRY.render(), content_type="text/plain")
+
+    def _update_phase_gauges(self) -> None:
+        """Object counts by kind+phase, computed at scrape time (the store is
+        the source of truth; a cached gauge would drift across restarts).
+        Powers the task/toolcall phase panels in the observability stack
+        (deploy/observability/) — the equivalent of the reference's
+        kube-state-metrics CR phase view."""
+        from ..api.resources import KINDS
+
+        counts: dict[tuple[str, str], int] = {}
+        for kind in KINDS:
+            try:
+                objs = self.store.list(kind, namespace=None)
+            except Exception:
+                continue
+            for o in objs:
+                status = getattr(o, "status", None)  # Event/Lease carry none
+                phase = str(
+                    getattr(status, "phase", "") or getattr(status, "status", "")
+                    or "unknown"
+                )
+                counts[(kind, phase)] = counts.get((kind, phase), 0) + 1
+        # zero out series that existed last scrape but are empty now —
+        # otherwise a drained phase keeps reporting its last nonzero count
+        prev: set[tuple[str, str]] = getattr(self, "_phase_series", set())
+        for key in prev - counts.keys():
+            counts[key] = 0
+        self._phase_series = prev | counts.keys()
+        for (kind, phase), n in counts.items():
+            REGISTRY.gauge_set(
+                "acp_objects",
+                float(n),
+                labels={"kind": kind, "phase": phase},
+                help="live objects by kind and phase",
+            )
+        # engine occupancy/queue-depth refreshed at scrape time too: the
+        # engine loop only updates them per decode step, which reads stale
+        # during admission hold (prewarm) and before the first dispatch
+        engine = getattr(self.operator.options, "engine", None)
+        if engine is not None:
+            try:
+                s = engine.stats()
+                REGISTRY.gauge_set(
+                    "acp_engine_active_slots", float(s["active_slots"]),
+                    help="occupied decode slots",
+                )
+                REGISTRY.gauge_set(
+                    "acp_engine_waiting_requests", float(s["waiting"]),
+                    help="admission queue depth",
+                )
+            except Exception:
+                pass  # a crashed engine must not take /metrics down
 
     async def healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})

@@ -15,7 +15,7 @@ from agentcontrolplane_tpu.llmclient import (
 )
 from agentcontrolplane_tpu.operator import Operator, OperatorOptions
 
-from ..fixtures import make_agent, make_llm
+from ..fixtures import make_agent, make_llm, make_task
 
 
 class RestHarness:
@@ -207,6 +207,29 @@ async def test_metrics_and_health():
         assert (await resp.json())["status"] == "ok"
         resp = await h.http.get(f"{h.base}/metrics")
         assert resp.status == 200
+
+
+async def test_metrics_phase_gauges_track_and_zero_out():
+    """acp_objects{kind,phase} is computed at scrape time and drained
+    series drop to 0 instead of freezing at their last count (dashboard
+    'Tasks by phase' panel depends on this)."""
+    async with RestHarness() as h:
+        make_llm(h.store)
+        make_agent(h.store, name="helper")
+        make_task(h.store, name="t1", agent="helper", user_message="hi")
+        text = await (await h.http.get(f"{h.base}/metrics")).text()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("acp_objects{") and 'kind="Task"' in ln
+        )
+        assert line.endswith(" 1.0")
+        h.store.delete("Task", "t1")
+        text = await (await h.http.get(f"{h.base}/metrics")).text()
+        line = next(
+            ln for ln in text.splitlines()
+            if ln.startswith("acp_objects{") and 'kind="Task"' in ln
+        )
+        assert line.endswith(" 0.0")  # zeroed, not stale
 
 
 async def test_update_agent_patch():
