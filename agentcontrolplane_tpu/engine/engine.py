@@ -163,6 +163,9 @@ class Engine:
         kv_layout: str = "slot",  # "slot" | "paged"
         page_size: int = 16,
         kv_pages: int = 0,  # paged: total pages (0 = slot-equivalent capacity)
+        # paged: how many decode blocks of pages to reserve per slot ahead of
+        # need, so the block table isn't dirtied (re-uploaded) every dispatch
+        page_lookahead_blocks: int = 8,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
     ):
@@ -174,6 +177,7 @@ class Engine:
             raise ValueError(f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
         self.kv_layout = kv_layout
         self.page_size = page_size
+        self.page_lookahead_blocks = max(1, page_lookahead_blocks)
         if isinstance(config, str):
             config = PRESETS[config]
         self.config = config
@@ -373,6 +377,7 @@ class Engine:
         self._tables_dirty = True
         self.decode_steps = 0
         self.tokens_generated = 0
+        self.table_uploads = 0  # paged: block-table host->device re-uploads
 
         self._build_jitted()
 
@@ -863,6 +868,7 @@ class Engine:
                 "total": self.num_pages - 1,
                 "free": self._allocator.free_count,
                 "page_size": self.page_size,
+                "table_uploads": self.table_uploads,
             }
         if self._prefix_enabled:
             with self._prefix_lock:
@@ -1488,6 +1494,10 @@ class Engine:
         tokens before dispatch; slots we can't cover are preempted (finished
         at current length) — admission backpressure frees their pages."""
         K = self.decode_block_size
+        # Pass 1 — strict coverage, identical preemption semantics to the
+        # pre-lookahead code: every slot gets exactly the pages this block
+        # needs; lookahead can never starve a slot that strictly fits.
+        crossed: list[int] = []
         for slot in list(self._slots):
             needed = -(-(int(self._seq_lens[slot]) + K) // self.page_size)
             # ctx edge: the decode block deactivates the slot on device at
@@ -1504,10 +1514,37 @@ class Engine:
             except MemoryError:
                 self._finish(slot, "length")  # preempted: KV pool exhausted
                 continue
-            table = self._slot_pages[slot]
-            self._block_tables[slot, have : have + len(new_pages)] = new_pages
-            table.extend(new_pages)
-            self._tables_dirty = True
+            self._append_pages(slot, new_pages)
+            crossed.append(slot)
+        # Pass 2 — opportunistic lookahead top-up, only for slots whose
+        # table went dirty THIS round (their upload is already being paid):
+        # with K == page_size a slot would otherwise cross a page boundary
+        # on EVERY block, re-uploading the block table (one serialized
+        # host->device RTT in the hot loop) per dispatch. Topping up to
+        # `page_lookahead_blocks` blocks of pages makes it one upload per
+        # lookahead window; a failed top-up is harmless.
+        ahead = K * self.page_lookahead_blocks
+        for slot in crossed:
+            if slot not in self._slot_pages:
+                continue
+            want = min(
+                -(-(int(self._seq_lens[slot]) + ahead) // self.page_size),
+                self.max_pages_per_seq,
+            )
+            have = len(self._slot_pages[slot])
+            if want <= have:
+                continue
+            try:
+                self._append_pages(slot, self._allocator.alloc(want - have))
+            except MemoryError:
+                pass  # pool tight: strict coverage already satisfied
+
+    def _append_pages(self, slot: int, new_pages: list[int]) -> None:
+        table = self._slot_pages[slot]
+        have = len(table)
+        self._block_tables[slot, have : have + len(new_pages)] = new_pages
+        table.extend(new_pages)
+        self._tables_dirty = True
 
     def _decode_once(self) -> None:
         if self._cancelled:
@@ -1585,6 +1622,7 @@ class Engine:
             if self._tables_dirty or "block_tables" not in d:
                 d["block_tables"] = self._put(self._block_tables[:W])
                 self._tables_dirty = False
+                self.table_uploads += 1
             cache, tok_block, carry = self._jit_decode_paged(
                 self.params, self.cache, *common, d["block_tables"]
             )

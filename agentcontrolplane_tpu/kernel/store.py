@@ -228,6 +228,19 @@ class Store:
         except NotFound:
             return None
 
+    def phase_counts(self) -> dict[tuple[str, str], int]:
+        """(kind, phase) -> live object count, in ONE pass under ONE lock
+        hold (the /metrics scrape path; per-kind list() calls would rescan
+        the whole store once per kind). Phase falls back to status.status
+        (LLM/Agent-style readiness) then "unknown"."""
+        out: dict[tuple[str, str], int] = {}
+        with self._lock:
+            for (kind, _ns, _name), doc in self._objects.items():
+                st = doc.get("status") or {}
+                phase = str(st.get("phase") or st.get("status") or "unknown")
+                out[(kind, phase)] = out.get((kind, phase), 0) + 1
+        return out
+
     def list(
         self,
         kind: str,

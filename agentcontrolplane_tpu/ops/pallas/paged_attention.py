@@ -40,6 +40,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 NEG_INF = -1e30
+NBUF = 4  # DMA pipeline depth: NBUF-1 page fetches kept in flight per walk
 
 
 def _kernel(
@@ -55,9 +56,9 @@ def _kernel(
     m_ref,  # [1, 1, H] f32 — running max (unit middle dim: TPU block shapes
     l_ref,  # [1, 1, H] f32 — need the trailing dims to tile or match)
     # scratch
-    k_buf,  # [2, P, H_kv * d] (VMEM)
-    v_buf,  # [2, P, H_kv * d]
-    sems,  # DMA sems [2, 2]
+    k_buf,  # [NBUF, P, H_kv * d] (VMEM)
+    v_buf,  # [NBUF, P, H_kv * d]
+    sems,  # DMA sems [NBUF, 2]
     *,
     page_size: int,
     n_kv_heads: int,
@@ -71,6 +72,7 @@ def _kernel(
     n_rep = H // n_kv_heads
     d = head_dim
     P = page_size
+    NBUF = k_buf.shape[0]
 
     q = q_ref[0].astype(jnp.float32)  # [H, d]
     scale = 1.0 / (d**0.5)
@@ -85,26 +87,36 @@ def _kernel(
         pltpu.make_async_copy(k_pages_ref.at[page], k_buf.at[slot], sems.at[slot, 0]).wait()
         pltpu.make_async_copy(v_pages_ref.at[page], v_buf.at[slot], sems.at[slot, 1]).wait()
 
-    @pl.when(n_pages > 0)
-    def _():
-        start_fetch(0, 0)
+    # page walks are small-transfer latency-bound: keep NBUF-1 fetches in
+    # flight (ramp pages 0..NBUF-2 here, steady state issues j+NBUF-1)
+    def ramp(j, _):
+        @pl.when(j < n_pages)
+        def _():
+            start_fetch(j, j)
+        return 0
+
+    jax.lax.fori_loop(0, NBUF - 1, ramp, 0)
 
     def body(j, carry):
         m, l, acc = carry  # [1,H], [1,H], [1,H,d] running online-softmax state
-        slot = jax.lax.rem(j, 2)
-        # prefetch next page into the other buffer while we wait on this one
-        @pl.when(j + 1 < n_pages)
+        slot = jax.lax.rem(j, NBUF)
+        # issue the deepest prefetch; its buffer was consumed at j-1
+        nxt = j + NBUF - 1
+
+        @pl.when(nxt < n_pages)
         def _():
-            start_fetch(j + 1, 1 - slot)
+            start_fetch(nxt, jax.lax.rem(nxt, NBUF))
 
         wait_fetch(j, slot)
+        # grouped GQA compute: keep K/V at [P, H_kv, d] and fold the repeat
+        # into a reshape of q/p — no [P, H, d] repeated materialization
         k = k_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
         v = v_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
-        if n_rep > 1:
-            k = jnp.repeat(k, n_rep, axis=1)
-            v = jnp.repeat(v, n_rep, axis=1)
-        # logits [P, H] via multiply+reduce, NOT dot_general (see module doc)
-        logits = jnp.sum(q[None, :, :] * k, axis=-1) * scale  # [P, H]
+        qg = q.reshape(n_kv_heads, n_rep, d)
+        # logits via multiply+reduce, NOT dot_general (see module doc)
+        logits = (
+            jnp.sum(qg[None] * k[:, :, None, :], axis=-1).reshape(P, H) * scale
+        )  # [P, H]
         pos = j * P + jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
         logits = jnp.where(pos < seq_len, logits, NEG_INF)
 
@@ -113,7 +125,8 @@ def _kernel(
         p = jnp.exp(logits - m_new)  # [P,H]
         correction = jnp.exp(m - m_new)  # [1,H]
         l = l * correction + jnp.sum(p, axis=0, keepdims=True)
-        pv = jnp.sum(p[:, :, None] * v, axis=0, keepdims=True)  # [1,H,d]
+        pg = p.reshape(P, n_kv_heads, n_rep)
+        pv = jnp.sum(pg[..., None] * v[:, :, None, :], axis=0).reshape(1, H, d)
         acc = acc * correction[:, :, None] + pv
         return m_new, l, acc
 
@@ -160,9 +173,9 @@ def _paged_state(
             pl.BlockSpec((1, 1, H), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
         ],
         scratch_shapes=[
-            pltpu.VMEM((2, P, H_kv * d), k_pages.dtype),
-            pltpu.VMEM((2, P, H_kv * d), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((2, 2)),
+            pltpu.VMEM((NBUF, P, H_kv * d), k_pages.dtype),
+            pltpu.VMEM((NBUF, P, H_kv * d), v_pages.dtype),
+            pltpu.SemaphoreType.DMA((NBUF, 2)),
         ],
     )
     acc, m, l = pl.pallas_call(

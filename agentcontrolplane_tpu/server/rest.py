@@ -934,21 +934,10 @@ class RestServer:
         Powers the task/toolcall phase panels in the observability stack
         (deploy/observability/) — the equivalent of the reference's
         kube-state-metrics CR phase view."""
-        from ..api.resources import KINDS
-
-        counts: dict[tuple[str, str], int] = {}
-        for kind in KINDS:
-            try:
-                objs = self.store.list(kind, namespace=None)
-            except Exception:
-                continue
-            for o in objs:
-                status = getattr(o, "status", None)  # Event/Lease carry none
-                phase = str(
-                    getattr(status, "phase", "") or getattr(status, "status", "")
-                    or "unknown"
-                )
-                counts[(kind, phase)] = counts.get((kind, phase), 0) + 1
+        try:
+            counts = self.store.phase_counts()
+        except Exception:
+            return  # transient store failure: keep last scrape's values
         # zero out series that existed last scrape but are empty now —
         # otherwise a drained phase keeps reporting its last nonzero count
         prev: set[tuple[str, str]] = getattr(self, "_phase_series", set())
