@@ -68,6 +68,20 @@ class Registry:
             sums = self._hist_sum.setdefault(name, {})
             sums[k] = sums.get(k, 0.0) + value
 
+    def series_window(
+        self, name: str, labels: dict[str, str] | None = None
+    ) -> tuple[int, list[float]]:
+        """(total observation count, windowed values) for one histogram
+        series, read under the lock. The count is monotonic (unbounded)
+        while the window is the bounded reservoir — callers measuring a
+        phase snapshot the count before and slice
+        ``window[-min(new, len(window)):]`` after."""
+        with self._lock:
+            k = self._key(labels)
+            count = self._hist_count.get(name, {}).get(k, 0)
+            window = list(self._hist_data.get(name, {}).get(k, ()))
+        return count, window
+
     def quantile(self, name: str, q: float, labels: dict[str, str] | None = None) -> float:
         with self._lock:
             data = sorted(self._hist_data.get(name, {}).get(self._key(labels), []))
