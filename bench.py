@@ -24,8 +24,18 @@ on stdout; the parent enforces a per-mark deadline schedule and SIGKILLs a
 child that misses one (a hung PJRT attach leaves threads alive, so
 heartbeats prove nothing — only forward progress counts). A killed phase is
 retried after a fresh probe while budget remains; partial results that
-already arrived are kept. Whatever happens, the parent emits its one JSON
-line before ``ACP_BENCH_TOTAL_BUDGET_S`` expires.
+already arrived are kept.
+
+Round-4 hardening (VERDICT r3 #1 — three rounds of 0.0):
+  (a) probe AND child assert ``jax.default_backend() == "tpu"`` — when the
+      axon plugin is down JAX silently falls back to 1 CPU device, which must
+      read as *tunnel down*, never as a successful attach
+      (``ACP_BENCH_ALLOW_CPU=1`` opts out for dev boxes);
+  (b) the total budget default is 1500 s — inside any plausible driver
+      timeout — and the parent RE-PRINTS the JSON line the instant each
+      result lands, so a late SIGKILL cannot erase a captured headline (the
+      last parseable line on stdout is always the freshest state);
+  (c) the probed backend + device kind are recorded under ``platform``.
 
 Knobs (env): ACP_BENCH_PRESET, ACP_BENCH_REQUESTS, ACP_BENCH_MAX_TOKENS,
 ACP_BENCH_PROMPT_LEN, ACP_BENCH_MAX_CTX, ACP_BENCH_BLOCK,
@@ -80,12 +90,26 @@ def _cpu_forced_inline() -> bool:
     return bool(plats) and "cpu" in str(plats)
 
 
-def _probe_once(timeout_s: float) -> int | None:
-    """One DISPOSABLE probe subprocess. Returns device count or None.
-    The parent's own PJRT state stays virgin no matter what happens here."""
+_PROBE_SNIPPET = (
+    "import jax, json; d = jax.devices(); print(json.dumps("
+    "{'backend': jax.default_backend(), 'n': len(d), "
+    "'device_kind': d[0].device_kind if d else ''}))"
+)
+
+
+def _allow_cpu() -> bool:
+    return os.environ.get("ACP_BENCH_ALLOW_CPU", "0") == "1"
+
+
+def _probe_once(timeout_s: float) -> dict | None:
+    """One DISPOSABLE probe subprocess. Returns {backend, n, device_kind} or
+    None. The parent's own PJRT state stays virgin no matter what happens
+    here. CRITICAL (r3 failure): a CPU fallback is a probe FAILURE — when the
+    axon plugin is down JAX silently reports 1 CPU device, and r3 burned its
+    whole budget prefilling on CPU because the probe only counted devices."""
     try:
         out = subprocess.run(
-            [sys.executable, "-c", "import jax; print(len(jax.devices()))"],
+            [sys.executable, "-c", _PROBE_SNIPPET],
             capture_output=True,
             timeout=timeout_s,
             text=True,
@@ -94,20 +118,32 @@ def _probe_once(timeout_s: float) -> int | None:
         return None
     if out.returncode == 0 and out.stdout.strip():
         try:
-            return int(out.stdout.strip().splitlines()[-1])
-        except ValueError:
+            info = json.loads(out.stdout.strip().splitlines()[-1])
+        except (ValueError, json.JSONDecodeError):
             return None
+        if not isinstance(info, dict) or not info.get("n"):
+            return None
+        if info.get("backend") != "tpu" and not _allow_cpu():
+            _log(
+                f"probe reached backend={info.get('backend')!r} "
+                f"({info.get('n')} device(s)) — NOT tpu; treating as tunnel-down"
+            )
+            return None
+        return info
     return None
 
 
-def _probe_until(deadline: float, attempt_timeout: float) -> int | None:
+def _probe_until(deadline: float, attempt_timeout: float) -> dict | None:
     attempt = 0
     while True:
         attempt += 1
-        n = _probe_once(min(attempt_timeout, max(10.0, deadline - time.monotonic())))
-        if n:
-            _log(f"probe attempt {attempt}: {n} device(s)")
-            return n
+        info = _probe_once(min(attempt_timeout, max(10.0, deadline - time.monotonic())))
+        if info:
+            _log(
+                f"probe attempt {attempt}: backend={info['backend']} "
+                f"{info['n']} device(s) kind={info.get('device_kind', '?')}"
+            )
+            return info
         remaining = deadline - time.monotonic()
         _log(f"probe attempt {attempt} failed; {remaining:.0f}s left in window")
         if remaining <= 30:
@@ -128,11 +164,17 @@ def _parent_signal_cleanup(signum, frame):  # pragma: no cover - signal path
 
 
 class _PhaseRun:
-    """One child process + the MARK/RESULT reader + deadline enforcement."""
+    """One child process + the MARK/RESULT reader + deadline enforcement.
 
-    def __init__(self, argv: list[str]):
+    ``on_result`` (if given) fires from the reader thread the INSTANT a
+    RESULT line parses — the parent uses it to flush the JSON doc while
+    ``run_schedule`` is still blocked on a later mark, so a driver SIGKILL
+    during a hung TTFT leg cannot erase an already-captured headline."""
+
+    def __init__(self, argv: list[str], on_result=None):
         global _ACTIVE_RUN
         _ACTIVE_RUN = self
+        self.on_result = on_result
         self.results: dict[str, object] = {}
         self.marks: list[str] = []
         self._cond = threading.Condition()
@@ -170,6 +212,12 @@ class _PhaseRun:
                             self.results[parts[1]] = json.loads(parts[2])
                         except json.JSONDecodeError:
                             _log(f"unparseable RESULT {parts[1]}: {parts[2][:200]}")
+                        else:
+                            if self.on_result is not None:
+                                try:
+                                    self.on_result(parts[1], self.results[parts[1]])
+                                except Exception as e:
+                                    _log(f"on_result callback error: {e!r}")
                     else:
                         _log(f"malformed protocol line: {line[:200]}")
                 else:
@@ -223,6 +271,18 @@ class _PhaseRun:
         return "ok"
 
 
+_FLUSH_LOCK = threading.Lock()  # doc is mutated from reader threads too
+
+
+def _flush_doc(doc: dict) -> None:
+    """Print the one JSON line NOW, flushed. Called the moment any result
+    lands (r3 failure (b): the driver SIGKILLed before the final ``finally``
+    fired, erasing everything). If the driver takes the LAST parseable line,
+    later flushes with more fields win; if it kills us mid-run, the most
+    recent flush stands."""
+    print(json.dumps(doc), flush=True)
+
+
 def _parent() -> None:
     """Orchestrates the phases. The one JSON line is emitted no matter what
     — a parent-side exception must never eat an already-captured headline."""
@@ -238,7 +298,9 @@ def _parent() -> None:
     except Exception as e:
         notes.append(f"parent error: {e!r}")
     finally:
-        print(json.dumps(doc), flush=True)
+        with _FLUSH_LOCK:
+            doc["notes"] = [n for n in notes if n]
+            _flush_doc(doc)
         for n in notes:
             _log(n)
 
@@ -249,18 +311,22 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
             signal.signal(sig, _parent_signal_cleanup)
         except (ValueError, OSError):  # non-main thread (tests) / unsupported
             pass
-    total_budget = float(os.environ.get("ACP_BENCH_TOTAL_BUDGET_S", "4500"))
+    # r3 failure (b): 4500s default exceeded the driver's own timeout, so the
+    # driver SIGKILLed the parent before anything flushed. 1500s leaves
+    # comfortable headroom inside any plausible driver budget (VERDICT r3
+    # "next round" #1 demands ≤1800).
+    total_budget = float(os.environ.get("ACP_BENCH_TOTAL_BUDGET_S", "1500"))
     t0 = time.monotonic()
     hard_deadline = t0 + total_budget
     probe_timeout = float(os.environ.get("ACP_BENCH_DEVICE_TIMEOUT_S", "120"))
-    window_s = float(os.environ.get("ACP_BENCH_PROBE_WINDOW_S", "900"))
-    build_timeout = float(os.environ.get("ACP_BENCH_BUILD_TIMEOUT_S", "2400"))
-    warm_timeout = float(os.environ.get("ACP_BENCH_WARM_TIMEOUT_S", "1200"))
-    deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "420"))
+    window_s = float(os.environ.get("ACP_BENCH_PROBE_WINDOW_S", "420"))
+    build_timeout = float(os.environ.get("ACP_BENCH_BUILD_TIMEOUT_S", "600"))
+    warm_timeout = float(os.environ.get("ACP_BENCH_WARM_TIMEOUT_S", "600"))
+    deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "240"))
     ttft_on = os.environ.get("ACP_BENCH_TTFT", "1") != "0"
-    ttft_timeout = float(os.environ.get("ACP_BENCH_TTFT_TIMEOUT_S", "1500"))
+    ttft_timeout = float(os.environ.get("ACP_BENCH_TTFT_TIMEOUT_S", "600"))
     ab_on = os.environ.get("ACP_BENCH_AB", "1") != "0"
-    ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "1500"))
+    ab_budget = float(os.environ.get("ACP_BENCH_AB_BUDGET_S", "600"))
     retries = int(os.environ.get("ACP_BENCH_RETRIES", "2"))
     kv_layout = os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
 
@@ -268,15 +334,45 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
     cpu_flag = ["--force-cpu"] if force_cpu else []
 
     if not force_cpu:
-        n = _probe_until(min(hard_deadline, time.monotonic() + window_s), probe_timeout)
-        if n is None:
+        info = _probe_until(min(hard_deadline, time.monotonic() + window_s), probe_timeout)
+        if info is None:
             notes.append(
-                f"FAILED: accelerator unreachable across {window_s:.0f}s probe window"
+                f"FAILED: tpu backend unreachable across {window_s:.0f}s probe "
+                "window (CPU fallback counts as unreachable)"
             )
             return
+        with _FLUSH_LOCK:
+            doc["platform"] = {
+                "backend": info["backend"],
+                "devices": info["n"],
+                "device_kind": info.get("device_kind", ""),
+            }
+            _flush_doc(doc)
 
-    headline: dict | None = None
-    ttft: dict | None = None
+    # captured results live here; `capture` fires FROM THE READER THREAD the
+    # instant a RESULT line parses, so the doc is flushed while run_schedule
+    # is still blocked on a later mark (a driver SIGKILL during a hung TTFT
+    # leg must not erase an already-captured headline — the r3 failure).
+    got: dict[str, dict | None] = {"headline": None, "ttft": None}
+
+    def capture(key: str, val: object) -> None:
+        if not isinstance(val, dict):
+            return
+        with _FLUSH_LOCK:
+            if key == "platform":
+                doc["platform"] = val  # child-observed; fresher than the probe
+            elif key == "headline" and got["headline"] is None:
+                got["headline"] = val
+                doc["value"] = val.get("tok_s_per_chip", 0.0)
+                doc["vs_baseline"] = round(doc["value"] / TARGET_TOK_S, 3)
+                doc["headline_note"] = str(val.get("note", ""))
+            elif key == "ttft" and got["ttft"] is None:
+                got["ttft"] = val
+                doc["ttft_first_toolcall_ms"] = val
+            else:
+                return
+            _flush_doc(doc)
+
     main_schedule: list[tuple[str, float]] = [
         ("attach_ok", probe_timeout),
         ("engine_built", build_timeout),
@@ -290,7 +386,7 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         if time.monotonic() > hard_deadline - 120:
             notes.append("total budget exhausted before main phase completed")
             break
-        only_ttft = headline is not None
+        only_ttft = got["headline"] is not None
         argv = ["--phase", "main", *cpu_flag]
         if only_ttft:
             argv.append("--only-ttft")
@@ -303,16 +399,12 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
             else main_schedule
         )
         _log(f"main phase attempt {attempt} ({'ttft-only' if only_ttft else 'full'})")
-        run = _PhaseRun(argv)
+        run = _PhaseRun(argv, on_result=capture)
         status = run.run_schedule(schedule, hard_deadline)
-        got = run.results.get("headline")  # keep partials from killed children
-        headline = headline or (got if isinstance(got, dict) else None)
-        got = run.results.get("ttft")
-        ttft = ttft or (got if isinstance(got, dict) else None)
         if status == "ok":
             break
         notes.append(f"main attempt {attempt} stalled at '{status}'")
-        if headline is not None and (not ttft_on or ttft is not None):
+        if got["headline"] is not None and (not ttft_on or got["ttft"] is not None):
             break
         if attempt < retries and not force_cpu:
             if _probe_until(
@@ -321,14 +413,11 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 notes.append("tunnel did not come back for a retry")
                 break
 
-    if headline:
-        doc["value"] = headline.get("tok_s_per_chip", 0.0)
-        doc["vs_baseline"] = round(doc["value"] / TARGET_TOK_S, 3)
-        notes.append(str(headline.get("note", "")))
-    else:
+    headline = got["headline"]
+    if not headline:
         notes.append("FAILED: no headline result captured from any child attempt")
-    if ttft_on:
-        doc["ttft_first_toolcall_ms"] = ttft if ttft else {"error": "ttft phase did not complete"}
+    if ttft_on and got["ttft"] is None:
+        doc["ttft_first_toolcall_ms"] = {"error": "ttft phase did not complete"}
 
     remaining = hard_deadline - time.monotonic()
     if ab_on and headline and remaining > 300:
@@ -336,7 +425,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         budget = min(ab_budget, remaining - 60)
         _log(f"A/B phase ({other}) with {budget:.0f}s budget")
         run = _PhaseRun(
-            ["--phase", "ab", "--layout", other, "--budget", str(budget), *cpu_flag]
+            ["--phase", "ab", "--layout", other, "--budget", str(budget), *cpu_flag],
+            on_result=capture,
         )
         status = run.run_schedule(
             [("attach_ok", probe_timeout),
@@ -346,10 +436,12 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         )
         ab = run.results.get("ab")
         if isinstance(ab, dict) and "tok_s_per_chip" in ab:
-            doc[f"{other}_tok_s_per_chip"] = ab["tok_s_per_chip"]
-            doc["kv_layout_winner"] = (
-                kv_layout if doc["value"] >= ab["tok_s_per_chip"] else other
-            )
+            with _FLUSH_LOCK:
+                doc[f"{other}_tok_s_per_chip"] = ab["tok_s_per_chip"]
+                doc["kv_layout_winner"] = (
+                    kv_layout if doc["value"] >= ab["tok_s_per_chip"] else other
+                )
+                _flush_doc(doc)
             notes.append(f"A/B {other}: {ab.get('note', '')}")
         else:
             doc["ab_error"] = f"ab phase stalled at '{status}'"
@@ -390,7 +482,21 @@ def _child(args: argparse.Namespace) -> None:
 
     devices = jax.devices()  # the parent watchdogs this line
     n_chips = len(devices)
+    backend = jax.default_backend()
+    if backend != "tpu" and not args.force_cpu and not _allow_cpu():
+        # r3 failure (a): the axon plugin died between probe and attach and
+        # JAX silently fell back to CPU; the child then burned the whole
+        # budget prefilling a 1.1B model on CPU. NEVER mark attach_ok here —
+        # exit so the parent's watchdog treats this as a failed attempt and
+        # re-enters the probe/retry window.
+        _log(f"attach reached backend={backend!r}, not tpu — aborting child")
+        sys.exit(3)
     _mark(f"attach_ok {n_chips}")
+    _result("platform", {
+        "backend": backend,
+        "devices": n_chips,
+        "device_kind": devices[0].device_kind if devices else "",
+    })
 
     import dataclasses
 
@@ -523,7 +629,7 @@ def _bench_ttft(engine) -> dict:
         LLM, BaseConfig, LLMSpec, TPUProviderConfig,
     )
     from agentcontrolplane_tpu.operator import Operator, OperatorOptions
-    from tests.fixtures import make_agent, make_task, setup_with_status
+    from agentcontrolplane_tpu.testing import make_agent, make_task, setup_with_status
 
     n_tasks = int(os.environ.get("ACP_BENCH_TTFT_TASKS", "16"))
     preset = os.environ.get("ACP_BENCH_PRESET", "bench-1b")

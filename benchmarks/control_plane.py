@@ -32,7 +32,7 @@ from agentcontrolplane_tpu.kernel import wait_for
 from agentcontrolplane_tpu.llmclient import MockLLMClient, MockLLMClientFactory, assistant
 from agentcontrolplane_tpu.operator import Operator, OperatorOptions
 
-from tests.fixtures import make_agent, make_llm, make_task
+from agentcontrolplane_tpu.testing import make_agent, make_llm, make_task
 
 
 class CountingBackend(SqliteBackend):

@@ -27,7 +27,7 @@ from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
 from agentcontrolplane_tpu.models.llama import PRESETS
 from agentcontrolplane_tpu.operator import Operator, OperatorOptions
 
-from tests.fixtures import make_agent, make_task, setup_with_status
+from agentcontrolplane_tpu.testing import make_agent, make_task, setup_with_status
 
 N = 16
 

@@ -3,7 +3,8 @@
 - Forces JAX onto a virtual 8-device CPU mesh (multi-chip sharding tests run
   without TPU hardware, per the driver contract).
 - Native asyncio test support (async def tests run via asyncio.run).
-- Shared fixtures: store, manager-equivalents live in tests/fixtures.py.
+- Shared builder fixtures live in agentcontrolplane_tpu.testing (shipped in
+  the package so bench.py runs without tests/); tests/fixtures.py re-exports.
 """
 
 import asyncio

@@ -145,6 +145,77 @@ def test_parent_never_imports_engine_or_inits_pjrt():
     assert not imports, "parent must not import the engine package"
 
 
+def test_probe_rejects_cpu_fallback(monkeypatch):
+    """r3 root cause (a): when the axon plugin is down JAX silently reports
+    one CPU device. The probe must read that as tunnel-down, not success."""
+    import subprocess as sp
+
+    def fake_run(argv, **kw):
+        return sp.CompletedProcess(
+            argv, 0, stdout='{"backend": "cpu", "n": 1, "device_kind": "cpu"}\n',
+            stderr="",
+        )
+
+    monkeypatch.delenv("ACP_BENCH_ALLOW_CPU", raising=False)
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    assert bench._probe_once(5.0) is None
+    monkeypatch.setenv("ACP_BENCH_ALLOW_CPU", "1")
+    assert bench._probe_once(5.0)["backend"] == "cpu"
+
+
+def test_probe_accepts_tpu_backend(monkeypatch):
+    import subprocess as sp
+
+    def fake_run(argv, **kw):
+        return sp.CompletedProcess(
+            argv, 0,
+            stdout='{"backend": "tpu", "n": 1, "device_kind": "TPU v5e"}\n',
+            stderr="",
+        )
+
+    monkeypatch.setattr(bench.subprocess, "run", fake_run)
+    info = bench._probe_once(5.0)
+    assert info == {"backend": "tpu", "n": 1, "device_kind": "TPU v5e"}
+
+
+def test_parent_flushes_headline_incrementally(stub_child, monkeypatch, capsys):
+    """r3 root cause (b): the driver SIGKILLed before the final emit. The
+    parent must re-print the JSON line the moment the headline result lands,
+    so the freshest flushed line already carries the number."""
+    import json
+
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        print("MARK engine_built", flush=True)
+        print("MARK warm_done", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 777.0, "note": "stub"}', flush=True)
+        """
+    )
+    monkeypatch.setattr(bench, "_cpu_forced_inline", lambda: False)
+    monkeypatch.setattr(
+        bench, "_probe_until",
+        lambda *a, **k: {"backend": "tpu", "n": 1, "device_kind": "TPU v5e"},
+    )
+    monkeypatch.setenv("ACP_BENCH_TTFT", "0")
+    monkeypatch.setenv("ACP_BENCH_AB", "0")
+    monkeypatch.setenv("ACP_BENCH_TOTAL_BUDGET_S", "600")
+    bench._parent()
+    lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    # ≥3 flushes: platform probe, headline capture, final
+    assert len(lines) >= 3
+    assert lines[0]["platform"]["backend"] == "tpu"
+    assert lines[0]["value"] == 0.0
+    # the headline-capture flush (not just the final one) carries the number
+    assert lines[1]["value"] == 777.0
+    assert lines[-1]["value"] == 777.0
+    assert lines[-1]["vs_baseline"] == 0.777
+
+
 def test_parent_emits_json_line_even_when_run_raises(monkeypatch, capsys):
     """A parent-side crash must still print the one JSON line (driver
     contract) — the r01/r02 artifacts were unusable precisely because a
