@@ -91,8 +91,13 @@ def cmd_run(args) -> int:
                 daemon=True,
             ).start()
 
+    if args.store and (args.db or args.serve_store):
+        raise SystemExit("--store joins a remote store; --db/--serve-store "
+                         "belong to the replica that owns it")
     options = OperatorOptions(
         db_path=args.db,
+        store_address=args.store,
+        serve_store=args.serve_store,
         identity=args.identity or f"acp-tpu-{os.getpid()}",
         leader_election=args.leader_elect,
         api_port=args.port,
@@ -503,6 +508,16 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument("--identity", default=None)
     run.add_argument("--leader-elect", action="store_true")
+    run.add_argument(
+        "--serve-store", default=None, metavar="ADDR",
+        help="serve this replica's store for other replicas "
+        "(unix:///path.sock or tcp://host:port)",
+    )
+    run.add_argument(
+        "--store", default=None, metavar="ADDR",
+        help="join another replica's served store instead of owning one "
+        "(multi-replica: leases + leader election hold across processes)",
+    )
     run.add_argument(
         "--api-token",
         default=os.environ.get("ACP_API_TOKEN", ""),

@@ -84,6 +84,9 @@ class TaskReconciler:
     tracer: Tracer = field(default_factory=lambda: NOOP_TRACER)
     identity: str = "acp-tpu-0"
     requeue_delay: float = REQUEUE_DELAY
+    # instance knob so multi-replica tests can shrink adoption latency; the
+    # default is the reference's 30s TTL (state_machine.go:80)
+    lease_ttl: float = LLM_LEASE_TTL
     notify_backoff: tuple[float, ...] = NOTIFY_BACKOFF
     # per-task in-memory mutex map (state_machine.go:38-44,944-965)
     _locks: dict[str, asyncio.Lock] = field(default_factory=dict)
@@ -186,7 +189,7 @@ class TaskReconciler:
         async with lock:
             lease_name = f"task-llm-{task.name}"
             if not leaselib.try_acquire(
-                self.store, lease_name, self.identity, task.namespace, ttl=LLM_LEASE_TTL
+                self.store, lease_name, self.identity, task.namespace, ttl=self.lease_ttl
             ):
                 return Result.after(self.requeue_delay)
             try:

@@ -1,0 +1,542 @@
+"""Served store — the cross-process control-plane kernel.
+
+The reference's durable store is the kube-apiserver: N operator pods share it
+over the network, which is what makes Lease adoption and leader election
+*mean* something across processes (``acp/internal/controller/task/
+state_machine.go:1069-1145``, ``acp/docs/distributed-locking.md:84-150``).
+This module gives the in-tree Store the same property:
+
+- ``StoreServer`` serves a local :class:`~.store.Store` over a unix or TCP
+  socket speaking newline-delimited JSON frames (create/get/list/update/
+  update_status/delete/watch), so one process owns the sqlite file and any
+  number of operator replicas share it;
+- ``RemoteStore`` is a drop-in Store replacement (same duck-typed API the
+  controllers, Manager, leases, EventRecorder and REST server consume) whose
+  every operation is an RPC against a StoreServer. Lease semantics therefore
+  hold ACROSS PROCESSES: two operator replicas contending on
+  ``task-llm-<name>`` leases really are two processes, and a surviving
+  replica adopts a SIGKILLed replica's expired lease.
+
+Protocol (one JSON object per line, UTF-8):
+  request   {"id": 7, "op": "get", "args": {...}}
+  reply     {"id": 7, "ok": <payload>}  |  {"id": 7, "err": "Conflict", "msg": "..."}
+  watch事件 pushed server->client: {"watch": 3, "type": "ADDED", "object": {...}}
+
+Watch delivery is decoupled from the store lock: the server-side subscriber
+only enqueues onto a bounded per-connection outbox drained by a writer
+thread, so a slow or dead client can never stall ``Store._notify`` (the
+outbox overflowing drops that client's connection, the remote operator's
+watches end, and its level-triggered reconcilers resync on reconnect).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import socket
+import threading
+from typing import Any, Callable, Iterable, Optional
+
+from ..api.meta import Resource
+from ..api.resources import from_doc
+from .errors import AlreadyExists, Conflict, Invalid, NotFound
+from .store import Store, Watch, WatchEvent, _current_loop
+
+log = logging.getLogger("acp_tpu.served")
+
+_ERRORS: dict[str, type[Exception]] = {
+    "NotFound": NotFound,
+    "Conflict": Conflict,
+    "AlreadyExists": AlreadyExists,
+    "Invalid": Invalid,
+}
+
+# A context window with many tool results can be large; frames are one JSON
+# line each, so cap defensively rather than at a "typical" size.
+_MAX_FRAME = 64 * 1024 * 1024
+_OUTBOX_CAP = 10_000
+
+
+def _doc(obj: Resource) -> dict[str, Any]:
+    return json.loads(obj.model_dump_json())
+
+
+def _parse_address(address: str) -> tuple[str, Any]:
+    """'unix:///path/to.sock' -> ('unix', path); 'tcp://host:port' -> ('tcp', (host, port))."""
+    if address.startswith("unix://"):
+        return "unix", address[len("unix://"):]
+    if address.startswith("tcp://"):
+        hostport = address[len("tcp://"):]
+        host, _, port = hostport.rpartition(":")
+        if not host or not port.isdigit():
+            raise Invalid(f"bad tcp address {address!r} (want tcp://host:port)")
+        return "tcp", (host, int(port))
+    raise Invalid(f"bad store address {address!r} (want unix:// or tcp://)")
+
+
+class _Conn:
+    """One client connection on the server: reader executes ops inline (the
+    Store is thread-safe), writer drains the outbox, watches unsubscribe on
+    close."""
+
+    def __init__(self, server: "StoreServer", sock: socket.socket):
+        self.server = server
+        self.sock = sock
+        self.outbox: "queue.Queue[bytes | None]" = queue.Queue(maxsize=_OUTBOX_CAP)
+        self.unsubs: dict[int, Callable[[], None]] = {}
+        self.closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._writer = threading.Thread(target=self._write_loop, daemon=True)
+
+    def start(self) -> None:
+        self._reader.start()
+        self._writer.start()
+
+    # -- outbound --------------------------------------------------------
+
+    def send(self, msg: dict[str, Any]) -> None:
+        try:
+            self.outbox.put_nowait(json.dumps(msg).encode() + b"\n")
+        except queue.Full:
+            # A stalled client must never stall the store's notify path.
+            log.warning("served-store client outbox full; dropping connection")
+            self.close()
+
+    def _write_loop(self) -> None:
+        try:
+            while True:
+                frame = self.outbox.get()
+                if frame is None:
+                    return
+                self.sock.sendall(frame)
+        except OSError:
+            pass
+        finally:
+            self.close()
+
+    # -- inbound ---------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self.sock.makefile("rb"):
+                if len(line) > _MAX_FRAME:
+                    raise Invalid("frame too large")
+                self._handle(json.loads(line))
+        except (OSError, ValueError):
+            pass
+        finally:
+            self.close()
+
+    def _handle(self, req: dict[str, Any]) -> None:
+        rid = req.get("id")
+        op = req.get("op")
+        args = req.get("args") or {}
+        try:
+            payload = self._dispatch(op, args)
+        except Exception as e:
+            self.send({
+                "id": rid,
+                "err": type(e).__name__,
+                "msg": str(e),
+            })
+        else:
+            self.send({"id": rid, "ok": payload})
+
+    def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
+        store = self.server.store
+        if op == "ping":
+            return "pong"
+        if op == "create":
+            return _doc(store.create(from_doc(a["doc"])))
+        if op == "get":
+            return _doc(store.get(a["kind"], a["name"], a.get("namespace", "default")))
+        if op == "list":
+            return [
+                _doc(o)
+                for o in store.list(
+                    a["kind"], a.get("namespace"), a.get("label_selector")
+                )
+            ]
+        if op == "update":
+            return _doc(store.update(from_doc(a["doc"])))
+        if op == "update_status":
+            return _doc(store.update_status(from_doc(a["doc"])))
+        if op == "delete":
+            store.delete(
+                a["kind"], a["name"], a.get("namespace", "default"),
+                resource_version=a.get("resource_version"),
+            )
+            return None
+        if op == "phase_counts":
+            return [[k, p, n] for (k, p), n in store.phase_counts().items()]
+        if op == "watch":
+            return self._start_watch(a)
+        if op == "unwatch":
+            unsub = self.unsubs.pop(int(a["wid"]), None)
+            if unsub is not None:
+                unsub()
+            return None
+        raise Invalid(f"unknown op {op!r}")
+
+    def _start_watch(self, a: dict[str, Any]) -> dict[str, Any]:
+        wid = self.server._next_wid()
+        kinds = frozenset(a["kinds"])
+        namespace = a.get("namespace")
+
+        def relay(type_: str, doc: dict[str, Any]) -> None:
+            # called under the store lock — enqueue only, never block
+            self.send({"watch": wid, "type": type_, "object": doc})
+
+        unsub = self.server.store.subscribe(relay, kinds=kinds, namespace=namespace)
+        self.unsubs[wid] = unsub
+        return {"wid": wid}
+
+    def close(self) -> None:
+        if self.closed.is_set():
+            return
+        self.closed.set()
+        for unsub in self.unsubs.values():
+            unsub()
+        self.unsubs.clear()
+        try:
+            self.outbox.put_nowait(None)
+        except queue.Full:
+            pass
+        try:
+            self.sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        self.server._forget(self)
+
+
+class StoreServer:
+    """Serves one Store to N remote operator processes.
+
+    >>> server = StoreServer(store, "unix:///tmp/acp-store.sock").start()
+    >>> # elsewhere: RemoteStore("unix:///tmp/acp-store.sock")
+    """
+
+    def __init__(self, store: Store, address: str = "tcp://127.0.0.1:0"):
+        self.store = store
+        self._requested = address
+        self._family, self._target = _parse_address(address)
+        self._sock: Optional[socket.socket] = None
+        self._accept_thread: Optional[threading.Thread] = None
+        self._conns: set[_Conn] = set()
+        self._conns_lock = threading.Lock()
+        self._wid = 0
+        self._wid_lock = threading.Lock()
+        self._stopped = threading.Event()
+        self.address = address  # concrete address once started
+
+    def _next_wid(self) -> int:
+        with self._wid_lock:
+            self._wid += 1
+            return self._wid
+
+    def start(self) -> "StoreServer":
+        if self._family == "unix":
+            path = self._target
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.bind(path)
+            self.address = f"unix://{path}"
+        else:
+            sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            sock.bind(self._target)
+            host, port = sock.getsockname()[:2]
+            self.address = f"tcp://{host}:{port}"
+        sock.listen(64)
+        self._sock = sock
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        return self
+
+    def _accept_loop(self) -> None:
+        assert self._sock is not None
+        while not self._stopped.is_set():
+            try:
+                client, _ = self._sock.accept()
+            except OSError:
+                return
+            if self._family == "tcp":
+                client.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            conn = _Conn(self, client)
+            with self._conns_lock:
+                self._conns.add(conn)
+            conn.start()
+
+    def _forget(self, conn: _Conn) -> None:
+        with self._conns_lock:
+            self._conns.discard(conn)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._conns_lock:
+            conns = list(self._conns)
+        for conn in conns:
+            conn.close()
+        if self._family == "unix":
+            try:
+                os.unlink(self._target)
+            except FileNotFoundError:
+                pass
+
+
+class _RemoteWatch:
+    """Client-side watch handle; same interface as :class:`~.store.Watch`."""
+
+    _SENTINEL = Watch._SENTINEL
+
+    def __init__(self, remote: "RemoteStore", wid: int):
+        self._remote = remote
+        self.wid = wid
+        import asyncio
+
+        self.queue: "asyncio.Queue" = asyncio.Queue()
+        self.loop = _current_loop()
+        self._stopped = False
+
+    def _deliver(self, item: Any) -> None:
+        if self.loop is not None and self.loop is not _current_loop():
+            self.loop.call_soon_threadsafe(self.queue.put_nowait, item)
+        else:
+            self.queue.put_nowait(item)
+
+    def __aiter__(self) -> "_RemoteWatch":
+        return self
+
+    async def __anext__(self) -> WatchEvent:
+        ev = await self.queue.get()
+        if ev is self._SENTINEL:
+            raise StopAsyncIteration
+        return ev
+
+    async def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
+        import asyncio
+
+        try:
+            ev = await asyncio.wait_for(self.queue.get(), timeout)
+        except asyncio.TimeoutError:
+            return None
+        if ev is self._SENTINEL:
+            return None
+        return ev
+
+    def stop(self) -> None:
+        if self._stopped:
+            return
+        self._stopped = True
+        self._remote._stop_watch(self)
+        self._deliver(self._SENTINEL)
+
+
+class RemoteStore:
+    """Store-API client over a StoreServer socket.
+
+    Drop-in for :class:`~.store.Store` everywhere the control plane consumes
+    one (Operator(store=RemoteStore(addr))). Synchronous ops block on the
+    RPC round-trip; watches stream asynchronously into the caller's loop.
+    A dead server surfaces as ``ConnectionError`` from any op — replicas
+    treat the store like controllers treat the apiserver (crash, restart,
+    resync)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address
+        self._timeout = timeout
+        family, target = _parse_address(address)
+        if family == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(target)
+        else:
+            sock = socket.create_connection(target, timeout=timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)  # reader thread blocks; per-op timeout below
+        self._sock = sock
+        self._wfile = sock.makefile("wb")
+        self._send_lock = threading.Lock()
+        self._pending: dict[int, dict[str, Any]] = {}
+        self._pending_lock = threading.Lock()
+        self._rid = 0
+        self._watches: dict[int, _RemoteWatch] = {}
+        self._closed = threading.Event()
+        self._reader = threading.Thread(target=self._read_loop, daemon=True)
+        self._reader.start()
+
+    # -- plumbing --------------------------------------------------------
+
+    def _read_loop(self) -> None:
+        try:
+            for line in self._sock.makefile("rb"):
+                if len(line) > _MAX_FRAME:
+                    break
+                msg = json.loads(line)
+                if "watch" in msg:
+                    self._on_watch_event(msg)
+                    continue
+                rid = msg.get("id")
+                with self._pending_lock:
+                    slot = self._pending.get(rid)
+                if slot is not None:
+                    slot["reply"] = msg
+                    slot["event"].set()
+        except (OSError, ValueError):
+            pass
+        finally:
+            self._closed.set()
+            # unblock every caller and end every watch
+            with self._pending_lock:
+                slots = list(self._pending.values())
+            for slot in slots:
+                slot["event"].set()
+            for w in list(self._watches.values()):
+                w._deliver(_RemoteWatch._SENTINEL)
+
+    def _on_watch_event(self, msg: dict[str, Any]) -> None:
+        w = self._watches.get(int(msg["watch"]))
+        if w is None:
+            return
+        try:
+            ev = WatchEvent(type=msg["type"], object=from_doc(msg["object"]))
+        except Exception:
+            log.exception("undeliverable watch event")
+            return
+        w._deliver(ev)
+
+    def _call(self, op: str, **args: Any) -> Any:
+        if self._closed.is_set():
+            raise ConnectionError(f"store connection to {self.address} is closed")
+        with self._pending_lock:
+            self._rid += 1
+            rid = self._rid
+            slot: dict[str, Any] = {"event": threading.Event(), "reply": None}
+            self._pending[rid] = slot
+        try:
+            frame = json.dumps({"id": rid, "op": op, "args": args}).encode() + b"\n"
+            with self._send_lock:
+                self._wfile.write(frame)
+                self._wfile.flush()
+            if not slot["event"].wait(self._timeout):
+                raise TimeoutError(f"store op {op!r} timed out after {self._timeout}s")
+            reply = slot["reply"]
+        finally:
+            with self._pending_lock:
+                self._pending.pop(rid, None)
+        if reply is None:
+            raise ConnectionError(f"store connection to {self.address} lost mid-{op}")
+        if "err" in reply:
+            exc = _ERRORS.get(reply["err"], RuntimeError)
+            raise exc(reply.get("msg", reply["err"]))
+        return reply.get("ok")
+
+    # -- Store API -------------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        return from_doc(self._call("create", doc=_doc(obj)))
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
+        return from_doc(self._call("get", kind=kind, name=name, namespace=namespace))
+
+    def try_get(self, kind: str, name: str, namespace: str = "default") -> Optional[Resource]:
+        try:
+            return self.get(kind, name, namespace)
+        except NotFound:
+            return None
+
+    def list(
+        self,
+        kind: str,
+        namespace: Optional[str] = "default",
+        label_selector: Optional[dict[str, str]] = None,
+    ) -> list[Resource]:
+        docs = self._call(
+            "list", kind=kind, namespace=namespace, label_selector=label_selector
+        )
+        return [from_doc(d) for d in docs]
+
+    def update(self, obj: Resource) -> Resource:
+        return from_doc(self._call("update", doc=_doc(obj)))
+
+    def update_status(self, obj: Resource) -> Resource:
+        return from_doc(self._call("update_status", doc=_doc(obj)))
+
+    def delete(
+        self,
+        kind: str,
+        name: str,
+        namespace: str = "default",
+        resource_version: Optional[int] = None,
+    ) -> None:
+        self._call(
+            "delete", kind=kind, name=name, namespace=namespace,
+            resource_version=resource_version,
+        )
+
+    def phase_counts(self) -> dict[tuple[str, str], int]:
+        return {(k, p): n for k, p, n in self._call("phase_counts")}
+
+    def mutate_status(
+        self,
+        kind: str,
+        name: str,
+        namespace: str,
+        fn: Callable[[Resource], None],
+        attempts: int = 3,
+    ) -> Resource:
+        last: Exception | None = None
+        for _ in range(attempts):
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update_status(obj)
+            except Conflict as e:
+                last = e
+        raise last  # type: ignore[misc]
+
+    def watch(
+        self, kinds: str | Iterable[str], namespace: Optional[str] = None
+    ) -> _RemoteWatch:
+        if isinstance(kinds, str):
+            kinds = [kinds]
+        payload = self._call("watch", kinds=sorted(kinds), namespace=namespace)
+        wid = int(payload["wid"])
+        w = _RemoteWatch(self, wid)
+        self._watches[wid] = w
+        return w
+
+    def _stop_watch(self, w: _RemoteWatch) -> None:
+        self._watches.pop(w.wid, None)
+        if not self._closed.is_set():
+            try:
+                self._call("unwatch", wid=w.wid)
+            except (ConnectionError, TimeoutError):
+                pass
+
+    def ping(self) -> bool:
+        return self._call("ping") == "pong"
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -174,6 +174,8 @@ class Store:
         self._lock = threading.RLock()
         self._objects: dict[Key, dict[str, Any]] = {}
         self._watchers: list[_Watcher] = []
+        self._subscribers: list[tuple[Callable[[str, dict[str, Any]], None],
+                                      Optional[frozenset[str]], Optional[str]]] = []
         rv, docs = self._backend.load_all()
         self._rv = rv
         for doc in docs:
@@ -187,10 +189,44 @@ class Store:
         return self._rv
 
     def _notify(self, type_: str, doc: dict[str, Any]) -> None:
+        for fn, kinds, ns in list(self._subscribers):
+            if kinds is not None and doc["kind"] not in kinds:
+                continue
+            if ns is not None and doc["metadata"]["namespace"] != ns:
+                continue
+            try:
+                fn(type_, doc)
+            except Exception:  # a broken subscriber must not break mutation
+                import logging
+
+                logging.getLogger("acp_tpu.store").exception("subscriber failed")
+        if not self._watchers:
+            return
         ev = WatchEvent(type=type_, object=from_doc(doc))
         for w in list(self._watchers):
             if w.matches(ev):
                 w.deliver(ev)
+
+    def subscribe(
+        self,
+        fn: Callable[[str, dict[str, Any]], None],
+        kinds: Optional[frozenset[str]] = None,
+        namespace: Optional[str] = None,
+    ) -> Callable[[], None]:
+        """Register a SYNCHRONOUS raw-doc event callback (the served-store
+        relay path). ``fn(event_type, doc)`` runs under the store lock on the
+        mutating thread: it must only enqueue, never block or re-enter the
+        store. Returns an unsubscribe callable."""
+        entry = (fn, kinds, namespace)
+        with self._lock:
+            self._subscribers.append(entry)
+
+        def unsubscribe() -> None:
+            with self._lock:
+                if entry in self._subscribers:
+                    self._subscribers.remove(entry)
+
+        return unsubscribe
 
     @staticmethod
     def _doc(obj: Resource) -> dict[str, Any]:

@@ -118,7 +118,9 @@ class DefaultLLMClientFactory:
                 ).request_timeout_seconds,
             )
         if provider == "mock":
-            return MockLLMClient()
+            return MockLLMClient(
+                delay_s=float(llm.spec.provider_config.get("delay_s", 0.0))
+            )
         raise Invalid(f"unknown provider {provider!r}")
 
     async def aclose(self) -> None:

@@ -48,9 +48,17 @@ class MockLLMClient(LLMClient):
     script: list[Scripted] = field(default_factory=list)
     default: Optional[Message] = None
     requests: list[RecordedRequest] = field(default_factory=list)
+    # simulated latency per request — lets multi-replica tests hold a task
+    # in-flight (mid-ReadyForLLM) long enough to SIGKILL the lease holder.
+    # Reachable in a separate operator process via provider_config.delay_s.
+    delay_s: float = 0.0
 
     async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
         self.requests.append(RecordedRequest(messages=list(messages), tools=list(tools)))
+        if self.delay_s > 0:
+            import asyncio
+
+            await asyncio.sleep(self.delay_s)
         if self.script:
             item = self.script.pop(0)
         elif self.default is not None:

@@ -26,7 +26,7 @@ from .humanlayer import (
     LocalHumanBackend,
     LocalHumanLayerClientFactory,
 )
-from .kernel import Manager, SqliteBackend, Store
+from .kernel import Manager, RemoteStore, SqliteBackend, Store, StoreServer
 from .kernel.runtime import map_owner
 from .llmclient import DefaultLLMClientFactory, LLMClientFactory
 from .mcp import MCPManager
@@ -36,6 +36,15 @@ from .observability import MetricsExporter, NOOP_TRACER, Tracer
 @dataclass
 class OperatorOptions:
     db_path: Optional[str] = None  # None = in-memory store
+    # Multi-replica control plane (the reference's N-pods-one-apiserver
+    # topology, cmd/main.go:213-226 + docs/distributed-locking.md):
+    # store_address connects this replica to another replica's served store
+    # (unix:///path or tcp://host:port) instead of owning one; serve_store
+    # makes THIS replica serve its store at the given address so others can
+    # join. With a shared store, task-llm leases and leader election hold
+    # across processes — a surviving replica adopts a dead one's tasks.
+    store_address: Optional[str] = None
+    serve_store: Optional[str] = None
     identity: str = "acp-tpu-0"
     leader_election: bool = False
     api_port: int = 8082
@@ -69,9 +78,16 @@ class Operator:
         tracer: Optional[Tracer] = None,
     ):
         self.options = options or OperatorOptions()
+        if store is None and self.options.store_address:
+            store = RemoteStore(self.options.store_address)
         self.store = store or Store(
             SqliteBackend(self.options.db_path) if self.options.db_path else None
         )
+        self.store_server: Optional[StoreServer] = None
+        if self.options.serve_store:
+            if not isinstance(self.store, Store):
+                raise ValueError("serve_store requires this replica to own a local Store")
+            self.store_server = StoreServer(self.store, self.options.serve_store)
         self.tracer = tracer or Tracer()
         self.mcp_manager = MCPManager(self.store)
         self.human_backend = LocalHumanBackend()
@@ -152,12 +168,16 @@ class Operator:
         )
 
     async def start(self) -> None:
+        if self.store_server is not None:
+            self.store_server.start()
         await self.manager.start()
         self.metrics_exporter.start()
 
     async def stop(self) -> None:
         self.metrics_exporter.stop()
         await self.manager.stop()
+        if self.store_server is not None:
+            self.store_server.stop()
         await self.mcp_manager.close()
         closer = getattr(self.llm_factory, "aclose", None)
         if closer is not None:

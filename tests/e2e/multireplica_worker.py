@@ -1,0 +1,58 @@
+"""Operator replica subprocess for the two-process kill/adopt e2e.
+
+Runs a FULL operator (all six controllers) against a RemoteStore served by
+the test process — one real OS process per replica, the topology the
+reference gets from N pods sharing one apiserver. The LLM is a mock whose
+latency comes from argv, so the test can hold replica A mid-``ReadyForLLM``
+(in-flight send, task-llm lease held) long enough to SIGKILL it.
+
+Usage: python multireplica_worker.py <store-address> <identity> <delay_s> [lease_ttl]
+Prints "READY" once controllers are running; serves until killed.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import sys
+
+
+def main() -> None:
+    address, identity, delay_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
+    lease_ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+
+    from agentcontrolplane_tpu.llmclient import (
+        MockLLMClient,
+        MockLLMClientFactory,
+        assistant,
+    )
+    from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+    op = Operator(
+        options=OperatorOptions(
+            store_address=address,
+            identity=identity,
+            enable_rest=False,
+            llm_probe=False,
+            verify_channel_credentials=False,
+        ),
+        llm_factory=MockLLMClientFactory(
+            MockLLMClient(
+                default=assistant(f"answer from {identity}"), delay_s=delay_s
+            )
+        ),
+    )
+    # fast cadence + short lease so adoption latency fits a test budget
+    op.task_reconciler.requeue_delay = 0.05
+    op.task_reconciler.lease_ttl = lease_ttl
+    op.toolcall_reconciler.poll_interval = 0.05
+
+    async def run() -> None:
+        await op.start()
+        print("READY", flush=True)
+        await asyncio.Event().wait()  # until SIGKILL/SIGTERM
+
+    asyncio.run(run())
+
+
+if __name__ == "__main__":
+    main()

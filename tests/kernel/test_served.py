@@ -1,0 +1,197 @@
+"""Served store: the cross-process kernel (kernel/served.py).
+
+The reference's store is the kube-apiserver — N operator pods share it over
+the network, which is what makes Lease adoption meaningful across processes
+(acp/docs/distributed-locking.md:84-150). These tests drive StoreServer +
+RemoteStore in one process over real sockets; the true two-OS-process
+kill/adopt scenario lives in tests/e2e/test_multireplica.py.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import Task, TaskSpec, LocalObjectRef
+from agentcontrolplane_tpu.kernel import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    RemoteStore,
+    Store,
+    StoreServer,
+    lease,
+)
+
+
+@pytest.fixture
+def served(tmp_path):
+    store = Store()
+    server = StoreServer(store, f"unix://{tmp_path}/store.sock").start()
+    remotes: list[RemoteStore] = []
+
+    def connect() -> RemoteStore:
+        r = RemoteStore(server.address, timeout=10.0)
+        remotes.append(r)
+        return r
+
+    yield store, connect
+    for r in remotes:
+        r.close()
+    server.stop()
+
+
+def _task(name: str, labels=None) -> Task:
+    return Task(
+        metadata=ObjectMeta(name=name, labels=labels or {}),
+        spec=TaskSpec(agent_ref=LocalObjectRef(name="a"), user_message="hi"),
+    )
+
+
+def test_crud_round_trip(served):
+    _, connect = served
+    remote = connect()
+    created = remote.create(_task("t1"))
+    assert created.metadata.resource_version > 0
+
+    got = remote.get("Task", "t1")
+    assert got.spec.user_message == "hi"
+
+    got.status.phase = "Initializing"
+    updated = remote.update_status(got)
+    assert updated.status.phase == "Initializing"
+    assert updated.metadata.resource_version > got.metadata.resource_version
+
+    remote.delete("Task", "t1")
+    assert remote.try_get("Task", "t1") is None
+
+
+def test_error_mapping(served):
+    _, connect = served
+    remote = connect()
+    with pytest.raises(NotFound):
+        remote.get("Task", "missing")
+    remote.create(_task("dup"))
+    with pytest.raises(AlreadyExists):
+        remote.create(_task("dup"))
+    stale = remote.get("Task", "dup")
+    remote.update_status(remote.get("Task", "dup"))  # bump rv
+    with pytest.raises(Conflict):
+        remote.update_status(stale)
+
+
+def test_mutations_visible_across_clients(served):
+    """Two RemoteStores = two replicas sharing one store: a write through one
+    is immediately readable through the other (single source of truth)."""
+    _, connect = served
+    a, b = connect(), connect()
+    a.create(_task("shared"))
+    got = b.get("Task", "shared")
+    got.status.phase = "ReadyForLLM"
+    b.update_status(got)
+    assert a.get("Task", "shared").status.phase == "ReadyForLLM"
+
+
+def test_list_with_label_selector(served):
+    _, connect = served
+    remote = connect()
+    remote.create(_task("t1", labels={"acp.tpu/task": "parent"}))
+    remote.create(_task("t2", labels={"acp.tpu/task": "other"}))
+    out = remote.list("Task", label_selector={"acp.tpu/task": "parent"})
+    assert [o.metadata.name for o in out] == ["t1"]
+    assert len(remote.list("Task")) == 2
+
+
+def test_precondition_delete_conflict(served):
+    _, connect = served
+    remote = connect()
+    remote.create(_task("t1"))
+    old_rv = remote.get("Task", "t1").metadata.resource_version
+    remote.update_status(remote.get("Task", "t1"))
+    with pytest.raises(Conflict):
+        remote.delete("Task", "t1", resource_version=old_rv)
+
+
+def test_phase_counts(served):
+    _, connect = served
+    remote = connect()
+    remote.create(_task("t1"))
+    obj = remote.get("Task", "t1")
+    obj.status.phase = "FinalAnswer"
+    remote.update_status(obj)
+    counts = remote.phase_counts()
+    assert counts[("Task", "FinalAnswer")] == 1
+
+
+async def test_watch_streams_to_remote_client(served):
+    local, connect = served
+    remote = connect()
+    watch = remote.watch("Task")
+    local.create(_task("t1"))  # mutation on the server side
+    ev = await watch.next(timeout=5.0)
+    assert ev is not None and ev.type == "ADDED" and ev.object.metadata.name == "t1"
+
+    other = connect()
+    obj = other.get("Task", "t1")
+    obj.status.phase = "Initializing"
+    other.update_status(obj)  # mutation via a DIFFERENT client
+    ev = await watch.next(timeout=5.0)
+    assert ev is not None and ev.type == "MODIFIED"
+    assert ev.object.status.phase == "Initializing"
+
+    watch.stop()
+    assert await watch.next(timeout=1.0) is None
+
+
+async def test_watch_kind_filter(served):
+    _, connect = served
+    remote = connect()
+    watch = remote.watch("Lease")
+    remote.create(_task("noise"))
+    lease.try_acquire(remote, "task-llm-x", "pod-a")
+    ev = await watch.next(timeout=5.0)
+    assert ev is not None and ev.object.kind == "Lease"
+    watch.stop()
+
+
+def test_cross_client_lease_contention(served):
+    """The headline property: leases over RemoteStores behave like the
+    reference's Lease CRs over the apiserver — one winner, adoption only
+    after expiry (state_machine.go:1069-1132)."""
+    _, connect = served
+    a, b = connect(), connect()
+    assert lease.try_acquire(a, "task-llm-t1", "pod-a", ttl=30, now=100.0)
+    assert not lease.try_acquire(b, "task-llm-t1", "pod-b", ttl=30, now=110.0)
+    # pod-a dies; pod-b adopts after TTL expiry
+    assert lease.try_acquire(b, "task-llm-t1", "pod-b", ttl=30, now=131.0)
+    assert a.get("Lease", "task-llm-t1").spec.holder_identity == "pod-b"
+
+
+def test_remote_store_survives_server_restart_of_client(served):
+    """Closing one client must not disturb the others."""
+    _, connect = served
+    a, b = connect(), connect()
+    a.create(_task("t1"))
+    a.close()
+    assert b.get("Task", "t1").metadata.name == "t1"
+
+
+def test_closed_connection_raises_connection_error(served):
+    _, connect = served
+    remote = connect()
+    remote.close()
+    with pytest.raises((ConnectionError, OSError)):
+        remote.get("Task", "anything")
+
+
+def test_tcp_transport(tmp_path):
+    store = Store()
+    server = StoreServer(store, "tcp://127.0.0.1:0").start()
+    try:
+        assert server.address.startswith("tcp://127.0.0.1:")
+        remote = RemoteStore(server.address, timeout=10.0)
+        remote.create(_task("t1"))
+        assert store.get("Task", "t1").metadata.name == "t1"
+        remote.close()
+    finally:
+        server.stop()
