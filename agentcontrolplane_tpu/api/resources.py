@@ -127,13 +127,19 @@ class TPUProviderConfig(APIModel):
     max_context: int = 8192
     page_size: int = 16
     quantization: Optional[Literal["int8"]] = None
-    # Per-request generation timeout. Defaults to the reference's 30 s
-    # LLMRequestTimeout (task_controller.go:25) so a wedged generation
-    # cannot hold a task lease for minutes; raise it for long generations
-    # under heavy continuous-batching load, or when serving without
-    # prewarm (a cold XLA compile on a first-hit shape costs 20-40s and
-    # would otherwise 504 until the compile cache warms).
+    # Per-request generation timeout, measured FROM SLOT ADMISSION (not
+    # submit). Defaults to the reference's 30 s LLMRequestTimeout
+    # (task_controller.go:25) so a wedged generation cannot hold a task
+    # lease for minutes. Because admission starts the clock, time spent in
+    # the engine's waiting queue under saturation (64 queued requests) or
+    # behind a cold non-prewarmed compile (20-40 s) does not eat the
+    # budget — that wait is bounded separately by queue_timeout_seconds.
     request_timeout_seconds: float = Field(default=30.0, gt=0)
+    # Cap on submit->slot-admission wait (queue depth + cold compiles ahead
+    # of us). Generous by design: expiring it means the engine is wedged or
+    # oversubscribed, and the reconciler should 504/retry rather than hold
+    # the task lease forever.
+    queue_timeout_seconds: float = Field(default=600.0, gt=0)
 
 
 class LLMSpec(APIModel):

@@ -28,6 +28,7 @@ class TPUEngineClient(LLMClient):
         force_json_tools: bool = False,
         tool_choice: str = "auto",
         request_timeout_s: float | None = None,
+        queue_timeout_s: float | None = None,
     ):
         self.engine = engine
         self.params = params
@@ -35,15 +36,22 @@ class TPUEngineClient(LLMClient):
         # LLMRequestTimeout (task_controller.go:25): a wedged generation
         # fails the request (5xx -> reconciler retry) instead of holding the
         # task lease for minutes. None = the spec field's default, so the
-        # two never drift. A generation that legitimately needs longer than
-        # the bound (huge max_tokens under full continuous-batching load)
-        # must raise the spec value — the same contract the reference
-        # imposes on every external provider.
+        # two never drift. The clock starts at SLOT ADMISSION, not submit:
+        # under saturation (e.g. 64 queued requests) or a cold non-prewarmed
+        # compile, queue wait used to eat the 30 s budget and every request
+        # 504'd into timeout-retry churn where nothing ever completed. The
+        # queue wait is bounded separately (and generously) by
+        # LLM.spec.tpu.queueTimeoutSeconds.
         if request_timeout_s is None:
             from ..api.resources import TPUProviderConfig
 
             request_timeout_s = TPUProviderConfig().request_timeout_seconds
+        if queue_timeout_s is None:
+            from ..api.resources import TPUProviderConfig
+
+            queue_timeout_s = TPUProviderConfig().queue_timeout_seconds
         self.request_timeout_s = request_timeout_s
+        self.queue_timeout_s = queue_timeout_s
         # LLM.spec.providerConfig["force_json_tools"]: grammar-constrain the
         # response to a JSON object whenever tools are offered (guaranteed
         # parseable tool calls at the cost of forbidding prose answers)
@@ -96,15 +104,10 @@ class TPUEngineClient(LLMClient):
         )
         future = self.engine.submit(prompt, sampling)
         try:
-            result = await asyncio.wait_for(
-                asyncio.wrap_future(future), timeout=self.request_timeout_s
-            )
-        except asyncio.TimeoutError:
+            result = await self._await_result(future)
+        except asyncio.TimeoutError as e:
             self.engine.cancel(future)  # free the slot; don't decode for a dead request
-            raise LLMRequestError(
-                504,
-                f"TPU engine generation timed out after {self.request_timeout_s:.0f}s",
-            )
+            raise LLMRequestError(504, str(e) or "TPU engine request timed out")
         except asyncio.CancelledError:
             # caller torn down mid-generation (operator shutdown, lease loss):
             # free the slot instead of decoding to max_tokens for a dead caller
@@ -114,3 +117,41 @@ class TPUEngineClient(LLMClient):
             raise LLMRequestError(500, f"TPU engine failure: {e}")
         allowed = {t.function.name for t in tools} if tools else None
         return to_message(result.text, allowed)
+
+    async def _await_result(self, future):
+        """Two-phase wait: queue_timeout_s bounds submit->slot-admission,
+        request_timeout_s bounds admission->completion. Raises
+        asyncio.TimeoutError (message says which phase expired)."""
+        wrapped = asyncio.wrap_future(future)
+        admitted = getattr(future, "admitted", None)
+        if admitted is not None and not admitted.is_set():
+            admit_wait = asyncio.ensure_future(
+                asyncio.to_thread(admitted.wait, self.queue_timeout_s)
+            )
+            try:
+                # completion also ends the queue phase (fast failure paths
+                # complete the future without ever setting admitted)
+                done, _ = await asyncio.wait(
+                    {wrapped, admit_wait}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if wrapped in done:
+                    return wrapped.result()
+                if not admit_wait.result():
+                    raise asyncio.TimeoutError(
+                        f"TPU engine queue wait exceeded {self.queue_timeout_s:.0f}s "
+                        "(engine wedged or oversubscribed)"
+                    )
+            finally:
+                if not admit_wait.done():
+                    # the event-wait thread parks for up to queue_timeout_s;
+                    # signal it instead of leaking a parked thread (the
+                    # engine only ever sets this event, it never reads it)
+                    admitted.set()
+                    admit_wait.cancel()
+        try:
+            return await asyncio.wait_for(wrapped, timeout=self.request_timeout_s)
+        except asyncio.TimeoutError:
+            raise asyncio.TimeoutError(
+                "TPU engine generation timed out "
+                f"{self.request_timeout_s:.0f}s after slot admission"
+            )

@@ -106,6 +106,10 @@ class _Request:
     # neither hit nor usefully seed the prefix cache
     truncated: bool = False
     enqueued: float = field(default_factory=time.monotonic)
+    # set when the request takes a slot (prefill starts). Clients key their
+    # generation timeout off this, so queue wait under saturation doesn't
+    # eat the per-request budget (mirrored onto future.admitted by submit).
+    admitted: threading.Event = field(default_factory=threading.Event)
 
     def emit(self, tokens: list[int]) -> None:
         if self.on_tokens is not None and tokens:
@@ -657,6 +661,7 @@ class Engine:
         self._outstanding.add(req.future)
         req.future.add_done_callback(self._outstanding.discard)
         req.future.rid = req.rid  # type: ignore[attr-defined]  # cancel() handle
+        req.future.admitted = req.admitted  # type: ignore[attr-defined]
         self._queue.put(req)
         return req.future
 
@@ -989,6 +994,8 @@ class Engine:
             if not group:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
+            for item in group:
+                item[0].admitted.set()  # starts the client's generation clock
             # per item: resolve the prefix-cache start (match + page
             # assembly already happened in _collect_group), then spill any
             # overlong remainder through intermediate continuation chunks
@@ -1509,9 +1516,8 @@ class Engine:
             have = len(self._slot_pages.get(slot, []))
             if needed <= have:
                 continue
-            try:
-                new_pages = self._allocator.alloc(needed - have)
-            except MemoryError:
+            new_pages = self._alloc_reclaiming_lookahead(needed - have, slot)
+            if new_pages is None:
                 self._finish(slot, "length")  # preempted: KV pool exhausted
                 continue
             self._append_pages(slot, new_pages)
@@ -1538,6 +1544,42 @@ class Engine:
                 self._append_pages(slot, self._allocator.alloc(want - have))
             except MemoryError:
                 pass  # pool tight: strict coverage already satisfied
+
+    def _alloc_reclaiming_lookahead(self, n: int, requester: int) -> list[int] | None:
+        """Alloc ``n`` pages; on exhaustion, claw back other slots' UNUSED
+        lookahead pages (beyond their strict next-block need) and retry.
+        Without this, pass-2 top-ups from earlier rounds could hoard pages
+        and preempt a strictly-fitting slot in a later round — 'lookahead
+        never starves a strict fit' must hold across rounds, not just within
+        one. The trimmed slots' tables re-upload next boundary crossing;
+        that cost only occurs when the pool is already exhausted."""
+        try:
+            return self._allocator.alloc(n)
+        except MemoryError:
+            pass
+        K = self.decode_block_size
+        reclaimed = False
+        for slot in self._slots:
+            table = self._slot_pages.get(slot)
+            if slot == requester or not table:
+                continue
+            strict = min(
+                -(-(int(self._seq_lens[slot]) + K) // self.page_size),
+                self.max_pages_per_seq,
+            )
+            if len(table) > strict:
+                excess = table[strict:]
+                del table[strict:]
+                self._block_tables[slot, strict : strict + len(excess)] = TRASH_PAGE
+                self._allocator.free(excess)
+                self._tables_dirty = True
+                reclaimed = True
+        if not reclaimed:
+            return None
+        try:
+            return self._allocator.alloc(n)
+        except MemoryError:
+            return None
 
     def _append_pages(self, slot: int, new_pages: list[int]) -> None:
         table = self._slot_pages[slot]

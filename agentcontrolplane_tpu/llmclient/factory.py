@@ -116,6 +116,9 @@ class DefaultLLMClientFactory:
                 request_timeout_s=(
                     llm.spec.tpu or TPUProviderConfig()
                 ).request_timeout_seconds,
+                queue_timeout_s=(
+                    llm.spec.tpu or TPUProviderConfig()
+                ).queue_timeout_seconds,
             )
         if provider == "mock":
             return MockLLMClient(

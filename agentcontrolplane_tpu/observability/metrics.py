@@ -55,6 +55,14 @@ class Registry:
             m = self._get(name, help, "gauge")
             m.values[self._key(labels)] = value
 
+    def gauge_remove(self, name: str, labels: dict[str, str] | None = None) -> None:
+        """Drop one gauge series (cardinality hygiene: a drained kind/phase
+        series is zeroed for one scrape, then removed)."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                m.values.pop(self._key(labels), None)
+
     def observe(self, name: str, value: float, labels: dict[str, str] | None = None, help: str = "") -> None:
         with self._lock:
             self._get(name, help, "histogram")

@@ -938,12 +938,21 @@ class RestServer:
             counts = self.store.phase_counts()
         except Exception:
             return  # transient store failure: keep last scrape's values
-        # zero out series that existed last scrape but are empty now —
-        # otherwise a drained phase keeps reporting its last nonzero count
+        # Drained-series lifecycle (cardinality hygiene): a series that
+        # existed last scrape but is empty now is zeroed for exactly ONE
+        # scrape (so dashboards see the drain, not a frozen last value),
+        # then removed from the registry. Accumulating every (kind, phase)
+        # pair ever observed would re-emit unbounded zeros forever.
+        live = set(counts.keys())
         prev: set[tuple[str, str]] = getattr(self, "_phase_series", set())
-        for key in prev - counts.keys():
+        zeroed_last: set[tuple[str, str]] = getattr(self, "_phase_zeroed", set())
+        for kind, phase in zeroed_last - live:
+            REGISTRY.gauge_remove("acp_objects", labels={"kind": kind, "phase": phase})
+        to_zero = prev - live
+        for key in to_zero:
             counts[key] = 0
-        self._phase_series = prev | counts.keys()
+        self._phase_series = live
+        self._phase_zeroed = to_zero
         for (kind, phase), n in counts.items():
             REGISTRY.gauge_set(
                 "acp_objects",

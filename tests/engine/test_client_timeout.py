@@ -1,0 +1,87 @@
+"""TPUEngineClient's two-phase timeout (ADVICE r3: the 30 s request budget
+must not be consumed by admission-queue wait — under saturation or cold
+compiles every request would 504 into timeout-retry churn).
+
+Phase 1 (submit -> slot admission) is bounded by queue_timeout_seconds;
+phase 2 (admission -> completion) by request_timeout_seconds. These tests
+drive ``_await_result`` with stub futures — no engine, no device.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import threading
+from concurrent.futures import Future
+
+import pytest
+
+from agentcontrolplane_tpu.api.resources import BaseConfig
+from agentcontrolplane_tpu.engine.client import TPUEngineClient
+
+
+def make_client(request_timeout_s: float, queue_timeout_s: float) -> TPUEngineClient:
+    return TPUEngineClient(
+        engine=object(),  # _await_result never touches the engine
+        params=BaseConfig(model="stub"),
+        request_timeout_s=request_timeout_s,
+        queue_timeout_s=queue_timeout_s,
+    )
+
+
+def make_future(admitted: bool | None = False) -> Future:
+    """admitted=None -> legacy future without the attribute."""
+    fut: Future = Future()
+    if admitted is not None:
+        fut.admitted = threading.Event()  # type: ignore[attr-defined]
+        if admitted:
+            fut.admitted.set()  # type: ignore[attr-defined]
+    return fut
+
+
+async def test_queue_wait_does_not_consume_generation_budget():
+    """Admission arrives AFTER the request timeout would have expired; the
+    generation still completes because its clock starts at admission."""
+    client = make_client(request_timeout_s=0.4, queue_timeout_s=30.0)
+    fut = make_future(admitted=False)
+
+    def engine_side():
+        # queued for longer than request_timeout_s...
+        threading.Event().wait(0.6)
+        fut.admitted.set()
+        threading.Event().wait(0.2)  # then generates well inside the budget
+        fut.set_result("generated")
+
+    t = threading.Thread(target=engine_side, daemon=True)
+    t.start()
+    assert await client._await_result(fut) == "generated"
+    t.join()
+
+
+async def test_queue_timeout_expires_with_queue_message():
+    client = make_client(request_timeout_s=30.0, queue_timeout_s=0.2)
+    fut = make_future(admitted=False)
+    with pytest.raises(asyncio.TimeoutError, match="queue wait"):
+        await client._await_result(fut)
+
+
+async def test_generation_timeout_after_admission():
+    client = make_client(request_timeout_s=0.2, queue_timeout_s=30.0)
+    fut = make_future(admitted=True)
+    with pytest.raises(asyncio.TimeoutError, match="after slot admission"):
+        await client._await_result(fut)
+
+
+async def test_completion_while_queued_short_circuits():
+    """Fast failure paths complete the future without ever admitting."""
+    client = make_client(request_timeout_s=30.0, queue_timeout_s=30.0)
+    fut = make_future(admitted=False)
+    threading.Timer(0.1, lambda: fut.set_result("early")).start()
+    assert await client._await_result(fut) == "early"
+
+
+async def test_future_without_admitted_attr_uses_request_timeout():
+    """Futures from engines predating the admitted event still time out."""
+    client = make_client(request_timeout_s=0.2, queue_timeout_s=30.0)
+    fut = make_future(admitted=None)
+    with pytest.raises(asyncio.TimeoutError):
+        await client._await_result(fut)

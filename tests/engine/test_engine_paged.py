@@ -126,3 +126,40 @@ def test_lookahead_one_matches_legacy_per_block_behavior():
     finally:
         a.stop()
         b.stop()
+
+
+def test_pass1_reclaims_other_slots_lookahead_pages():
+    """ADVICE r3: lookahead top-ups must never starve a strictly-fitting
+    slot in a LATER round — on pass-1 exhaustion, unused lookahead pages
+    (beyond other slots' strict next-block need) are clawed back before
+    preempting. White-box: drain the allocator into slot 0's table as
+    lookahead excess, then ask for a strict allocation for slot 1."""
+    # pool sized so slot 0's max table (max_pages_per_seq) drains it exactly
+    # (page 0 is the reserved trash page)
+    eng = make_engine("paged", kv_pages=9)
+    try:
+        K = eng.decode_block_size
+        strict0 = -(-(16 + K) // eng.page_size)  # slot 0's strict need
+        eng._slots[0] = object()  # iterated for keys only
+        eng._seq_lens[0] = 16
+        # hand slot 0 its strict pages plus the rest of the pool as lookahead
+        table = eng._allocator.alloc(strict0)
+        table += eng._allocator.alloc(
+            min(eng._allocator.free_count, eng.max_pages_per_seq - strict0)
+        )
+        eng._slot_pages[0] = list(table)
+        eng._block_tables[0, : len(table)] = table
+        assert eng._allocator.free_count == 0
+
+        got = eng._alloc_reclaiming_lookahead(2, requester=1)
+        assert got is not None and len(got) == 2
+        # slot 0 kept exactly its strict need; the excess was reclaimed
+        assert len(eng._slot_pages[0]) == strict0
+        assert eng._tables_dirty
+
+        # nothing left to reclaim below strict need -> honest failure
+        assert eng._alloc_reclaiming_lookahead(10_000, requester=1) is None
+        assert len(eng._slot_pages[0]) == strict0
+    finally:
+        eng._slots.clear()
+        eng.stop()

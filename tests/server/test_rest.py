@@ -230,6 +230,13 @@ async def test_metrics_phase_gauges_track_and_zero_out():
             if ln.startswith("acp_objects{") and 'kind="Task"' in ln
         )
         assert line.endswith(" 0.0")  # zeroed, not stale
+        # ...and DROPPED on the next scrape (ADVICE r3: re-emitting every
+        # series ever observed is unbounded gauge cardinality under churn)
+        text = await (await h.http.get(f"{h.base}/metrics")).text()
+        assert not any(
+            ln.startswith("acp_objects{") and 'kind="Task"' in ln
+            for ln in text.splitlines()
+        )
 
 
 async def test_update_agent_patch():
