@@ -103,6 +103,9 @@ def cmd_run(args) -> int:
         api_port=args.port,
         api_host=args.host,
         api_token=args.api_token,
+        tls_cert_path=args.tls_cert,
+        tls_key_path=args.tls_key,
+        tls_client_ca_path=args.tls_client_ca,
         engine=engine,
     )
 
@@ -522,6 +525,19 @@ def build_parser() -> argparse.ArgumentParser:
         "--api-token",
         default=os.environ.get("ACP_API_TOKEN", ""),
         help="require this bearer token on the REST API (default: $ACP_API_TOKEN)",
+    )
+    run.add_argument(
+        "--tls-cert", default=os.environ.get("ACP_TLS_CERT") or None,
+        help="serve the REST API over HTTPS with this certificate (PEM); "
+        "rotated files are picked up without restart",
+    )
+    run.add_argument(
+        "--tls-key", default=os.environ.get("ACP_TLS_KEY") or None,
+        help="private key (PEM) for --tls-cert",
+    )
+    run.add_argument(
+        "--tls-client-ca", default=os.environ.get("ACP_TLS_CLIENT_CA") or None,
+        help="require client certificates signed by this CA (mTLS)",
     )
     run.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
     run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")

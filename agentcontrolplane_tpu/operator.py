@@ -54,6 +54,14 @@ class OperatorOptions:
     # non-empty = require "Authorization: Bearer <token>" on every REST route
     # except health probes (reference posture: acp/cmd/main.go:167-206)
     api_token: str = ""
+    # TLS serving posture (reference: cert-watcher-fed TLS options for the
+    # webhook/metrics servers, acp/cmd/main.go:118-166). cert+key => HTTPS;
+    # client_ca additionally demands verified client certs (mTLS). Cert/key
+    # files are re-loaded on change while serving (cert-watcher parity), so
+    # rotation needs no restart.
+    tls_cert_path: Optional[str] = None
+    tls_key_path: Optional[str] = None
+    tls_client_ca_path: Optional[str] = None
     enable_rest: bool = True
     llm_probe: bool = True
     verify_channel_credentials: bool = True

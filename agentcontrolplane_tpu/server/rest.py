@@ -25,6 +25,8 @@ from __future__ import annotations
 
 import asyncio
 import json
+import os
+import ssl
 from typing import TYPE_CHECKING, Any, Optional
 
 from aiohttp import web
@@ -144,6 +146,56 @@ class RestServer:
         self._register_routes()
         self._runner: Optional[web.AppRunner] = None
         self.bound_port: Optional[int] = None
+        # TLS posture (acp/cmd/main.go:118-166 parity): cert+key => HTTPS,
+        # client CA => verified client certs (mTLS). The context is built
+        # eagerly so a bad cert path fails at construction, not mid-serve.
+        opts = operator.options
+        self._tls_paths = (
+            (opts.tls_cert_path, opts.tls_key_path, opts.tls_client_ca_path)
+            if getattr(opts, "tls_cert_path", None) and getattr(opts, "tls_key_path", None)
+            else None
+        )
+        self._ssl_context = self._build_ssl_context() if self._tls_paths else None
+        self._tls_mtimes = self._stat_tls_files()
+
+    def _build_ssl_context(self) -> ssl.SSLContext:
+        cert, key, client_ca = self._tls_paths  # type: ignore[misc]
+        ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+        ctx.minimum_version = ssl.TLSVersion.TLSv1_2
+        ctx.load_cert_chain(cert, key)
+        if client_ca:
+            ctx.load_verify_locations(client_ca)
+            ctx.verify_mode = ssl.CERT_REQUIRED
+        return ctx
+
+    def _stat_tls_files(self) -> tuple:
+        if not self._tls_paths:
+            return ()
+        return tuple(
+            os.stat(p).st_mtime_ns if p else None for p in self._tls_paths
+        )
+
+    async def _tls_reload_loop(self) -> None:
+        """Cert-watcher parity (acp/cmd/main.go:124-136): rotated cert/key
+        files are picked up for NEW handshakes without a restart. Reloading
+        into the live SSLContext is safe — in-flight connections keep their
+        session; only new handshakes see the new chain."""
+        interval = float(os.environ.get("ACP_TLS_RELOAD_INTERVAL_S", "30"))
+        while True:
+            await asyncio.sleep(interval)
+            try:
+                mtimes = self._stat_tls_files()
+            except OSError:
+                continue  # mid-rotation; retry next tick
+            if mtimes != self._tls_mtimes and self._ssl_context is not None:
+                cert, key, client_ca = self._tls_paths  # type: ignore[misc]
+                try:
+                    self._ssl_context.load_cert_chain(cert, key)
+                    if client_ca:
+                        self._ssl_context.load_verify_locations(client_ca)
+                    self._tls_mtimes = mtimes
+                except (OSError, ssl.SSLError):
+                    continue  # partial rotation; keep serving the old chain
 
     def _register_routes(self) -> None:
         r = self.app.router
@@ -182,12 +234,21 @@ class RestServer:
         re-acquisition (see kernel.runtime._leader_gated_runner)."""
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(self._runner, self.host, self.port)
+        site = web.TCPSite(
+            self._runner, self.host, self.port, ssl_context=self._ssl_context
+        )
         await site.start()
         self.bound_port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        reloader = (
+            asyncio.ensure_future(self._tls_reload_loop())
+            if self._ssl_context is not None
+            else None
+        )
         try:
             await asyncio.Event().wait()
         finally:
+            if reloader is not None:
+                reloader.cancel()
             await self.stop()
 
     async def stop(self) -> None:
