@@ -25,7 +25,7 @@ from __future__ import annotations
 
 from typing import Any, Literal, Optional
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from .meta import APIModel, ObjectMeta, Resource, new_meta
 
@@ -142,12 +142,65 @@ class TPUProviderConfig(APIModel):
     queue_timeout_seconds: float = Field(default=600.0, gt=0)
 
 
+class OpenAIProviderConfig(APIModel):
+    """OpenAI-specific options (llm_types.go:74-87)."""
+
+    organization: str = ""  # sent as the OpenAI-Organization header
+    api_type: Literal["OPEN_AI", "AZURE", "AZURE_AD"] = "OPEN_AI"
+    api_version: str = ""  # required for Azure API types (e.g. "2023-05-15")
+
+    @model_validator(mode="after")
+    def _azure_needs_version(self) -> "OpenAIProviderConfig":
+        if self.api_type in ("AZURE", "AZURE_AD") and not self.api_version:
+            raise ValueError(f"apiType {self.api_type} requires apiVersion")
+        return self
+
+
+class AnthropicProviderConfig(APIModel):
+    """Anthropic-specific options (llm_types.go:89-95)."""
+
+    anthropic_beta_header: str = ""  # sent as the anthropic-beta header
+
+
+class VertexProviderConfig(APIModel):
+    """Vertex AI options (llm_types.go:97-107): both fields are required —
+    the endpoint is project/region-scoped. Auth is a service-account JSON
+    credential (apiKeyFrom secret) exchanged for an OAuth2 access token
+    (langchaingo_client.go:65-70 WithCredentialsJSON equivalent)."""
+
+    cloud_project: str
+    cloud_location: str
+
+
+class MistralProviderConfig(APIModel):
+    """Mistral-specific options (llm_types.go:109-123)."""
+
+    max_retries: Optional[int] = Field(default=None, ge=0)
+    timeout: Optional[int] = Field(default=None, ge=1)  # seconds
+    random_seed: Optional[int] = None  # deterministic sampling
+
+
+class GoogleProviderConfig(APIModel):
+    """Google AI (Gemini API) options (llm_types.go:125-133)."""
+
+    cloud_project: str = ""
+    cloud_location: str = ""
+
+
 class LLMSpec(APIModel):
     provider: LLMProvider
     api_key_from: Optional[SecretKeyRef] = None
     parameters: BaseConfig = Field(default_factory=BaseConfig)
     tpu: Optional[TPUProviderConfig] = None
-    # Per-provider extras (llm_types.go:73-138); kept as open maps.
+    # Typed per-provider blocks (llm_types.go:135-141 ProviderConfig);
+    # validated by the LLM controller before the live probe.
+    openai: Optional[OpenAIProviderConfig] = None
+    anthropic: Optional[AnthropicProviderConfig] = None
+    vertex: Optional[VertexProviderConfig] = None
+    mistral: Optional[MistralProviderConfig] = None
+    google: Optional[GoogleProviderConfig] = None
+    # Free-form extras with no reference analogue (e.g. the TPU provider's
+    # tool_choice / force_json_tools); typed fields take precedence.
     provider_config: dict[str, Any] = Field(default_factory=dict)
 
 

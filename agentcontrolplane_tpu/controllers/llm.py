@@ -64,7 +64,13 @@ class LLMReconciler:
         if provider == "vertex" and not llm.spec.parameters.base_url:
             # Vertex has no hardcodable default endpoint (it is
             # project/region-scoped) — never fall back to another vendor's.
-            raise Invalid("provider vertex requires parameters.baseURL")
+            # The typed block (llm_types.go:97-107) derives it from
+            # cloudProject + cloudLocation; baseURL overrides.
+            if llm.spec.vertex is None:
+                raise Invalid(
+                    "provider vertex requires spec.vertex "
+                    "(cloudProject + cloudLocation) or parameters.baseURL"
+                )
         if provider in PROVIDERS_REQUIRING_KEY:
             if llm.spec.api_key_from is None:
                 raise Invalid(f"provider {provider} requires apiKeyFrom")

@@ -76,28 +76,83 @@ class DefaultLLMClientFactory:
         provider = llm.spec.provider
         params = llm.spec.parameters
         if provider in ("openai", "mistral", "google", "vertex"):
+            # typed per-provider blocks (llm_types.go:73-138)
+            headers: dict[str, str] = {"Authorization": f"Bearer {api_key}"}
+            query: dict[str, str] = {}
+            extra_body: dict = {}
+            timeout = REQUEST_TIMEOUT
+            auth = None
+            if provider == "openai" and llm.spec.openai is not None:
+                oc = llm.spec.openai
+                if oc.organization:
+                    headers["OpenAI-Organization"] = oc.organization
+                if oc.api_type == "AZURE":
+                    # Azure OpenAI: key goes in the api-key header, and every
+                    # request carries api-version (AZURE_AD keeps the bearer)
+                    headers = {"api-key": api_key}
+                if oc.api_type in ("AZURE", "AZURE_AD"):
+                    query["api-version"] = oc.api_version
+            elif provider == "mistral" and llm.spec.mistral is not None:
+                mc = llm.spec.mistral
+                if mc.timeout:
+                    timeout = float(mc.timeout)
+                if mc.random_seed is not None:
+                    extra_body["random_seed"] = mc.random_seed
+            elif provider == "vertex":
+                from .googleauth import (
+                    GoogleSAAuth,
+                    ServiceAccountTokenSource,
+                    looks_like_service_account,
+                    vertex_base_url,
+                )
+
+                if not params.base_url and llm.spec.vertex is None:
+                    raise Invalid(
+                        "provider vertex requires spec.vertex "
+                        "(cloudProject + cloudLocation) or parameters.baseURL"
+                    )
+                if looks_like_service_account(api_key):
+                    # native SA-JSON flow (WithCredentialsJSON parity): the
+                    # credential is exchanged for OAuth2 tokens per request
+                    auth = GoogleSAAuth(ServiceAccountTokenSource(api_key))
+                    headers = {}
+                # else: caller supplied a ready access token; bearer as-is
+
             if provider == "vertex" and not params.base_url:
-                raise Invalid("provider vertex requires parameters.baseURL")
-            base_url = params.base_url or DEFAULT_BASE_URLS.get(
-                provider, DEFAULT_BASE_URLS["openai"]
-            )
+                v = llm.spec.vertex
+                base_url = vertex_base_url(v.cloud_project, v.cloud_location)
+            else:
+                base_url = params.base_url or DEFAULT_BASE_URLS.get(
+                    provider, DEFAULT_BASE_URLS["openai"]
+                )
             http = self._pooled_http(
-                (provider, base_url, api_key),
+                (provider, base_url, api_key, tuple(sorted(query.items()))),
                 lambda: httpx.AsyncClient(
                     base_url=base_url,
-                    headers={"Authorization": f"Bearer {api_key}"},
-                    timeout=REQUEST_TIMEOUT,
+                    headers=headers,
+                    params=query or None,
+                    timeout=timeout,
+                    auth=auth,
                 ),
             )
-            return OpenAICompatibleClient(api_key, params, provider=provider, http=http, pooled=True)
+            return OpenAICompatibleClient(
+                api_key, params, provider=provider, http=http, pooled=True,
+                extra_body=extra_body or None,
+            )
         if provider == "anthropic":
             base_url = params.base_url or ANTHROPIC_URL
+            ah = {"x-api-key": api_key, "anthropic-version": "2023-06-01"}
+            beta = (
+                llm.spec.anthropic.anthropic_beta_header
+                if llm.spec.anthropic is not None
+                else ""
+            )
+            if beta:  # llm_types.go:91-94 (e.g. extended max-tokens betas)
+                ah["anthropic-beta"] = beta
             http = self._pooled_http(
-                ("anthropic", base_url, api_key),
+                ("anthropic", base_url, api_key, beta),
                 lambda: httpx.AsyncClient(
-                    base_url=base_url,
-                    headers={"x-api-key": api_key, "anthropic-version": "2023-06-01"},
-                    timeout=30.0,
+                    base_url=base_url, headers=ah, timeout=30.0,
                 ),
             )
             return AnthropicClient(api_key, params, http=http, pooled=True)
@@ -130,6 +185,10 @@ class DefaultLLMClientFactory:
         for http in self._http_pool.values():
             if not http.is_closed:
                 await http.aclose()
+            # the Google SA auth hook owns a token-mint client of its own
+            closer = getattr(http.auth, "aclose", None)
+            if closer is not None:
+                await closer()
         self._http_pool.clear()
 
 

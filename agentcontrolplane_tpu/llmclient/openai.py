@@ -88,9 +88,13 @@ class OpenAICompatibleClient(LLMClient):
         provider: str = "openai",
         http: Optional[httpx.AsyncClient] = None,
         pooled: bool = False,
+        extra_body: Optional[dict[str, Any]] = None,
     ):
         self.params = params
         self.provider = provider
+        # typed provider extras merged into every request payload (e.g.
+        # Mistral's random_seed, llm_types.go:118-122)
+        self.extra_body = extra_body or {}
         self._pooled = pooled  # pooled connections outlive this client object
         base_url = params.base_url or DEFAULT_BASE_URLS.get(provider, DEFAULT_BASE_URLS["openai"])
         self._http = http or httpx.AsyncClient(
@@ -117,6 +121,7 @@ class OpenAICompatibleClient(LLMClient):
             v = getattr(p, field)
             if v is not None:
                 payload[key] = v
+        payload.update(self.extra_body)
         return payload
 
     async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:

@@ -1,0 +1,281 @@
+"""Typed per-provider LLM configs + native Google service-account auth.
+
+Reference surface: the per-provider config blocks
+(acp/api/v1alpha1/llm_types.go:73-141) and the vertex credentials-JSON flow
+(acp/internal/llmclient/langchaingo_client.go:65-70). The token exchange is
+driven against a FAKED token endpoint — no Google, no network egress.
+"""
+
+from __future__ import annotations
+
+import base64
+import json
+
+import pytest
+from aiohttp import web
+
+from agentcontrolplane_tpu.api.meta import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM,
+    AnthropicProviderConfig,
+    BaseConfig,
+    LLMSpec,
+    Message,
+    MistralProviderConfig,
+    OpenAIProviderConfig,
+    VertexProviderConfig,
+)
+from agentcontrolplane_tpu.kernel.errors import Invalid
+from agentcontrolplane_tpu.llmclient import DefaultLLMClientFactory
+from agentcontrolplane_tpu.llmclient.googleauth import (
+    ServiceAccountTokenSource,
+    looks_like_service_account,
+)
+
+from .test_providers import FakeProvider
+
+CHAT_RESPONSE = {
+    "choices": [{"message": {"role": "assistant", "content": "ok"}}]
+}
+ANTHROPIC_RESPONSE = {
+    "content": [{"type": "text", "text": "ok"}],
+    "stop_reason": "end_turn",
+}
+
+
+def make_sa_credential(token_uri: str) -> str:
+    """A real RSA keypair in a service_account JSON document."""
+    from cryptography.hazmat.primitives import serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    pem = key.private_bytes(
+        serialization.Encoding.PEM,
+        serialization.PrivateFormat.PKCS8,
+        serialization.NoEncryption(),
+    ).decode()
+    return json.dumps({
+        "type": "service_account",
+        "client_email": "robot@proj.iam.gserviceaccount.com",
+        "private_key": pem,
+        "token_uri": token_uri,
+    })
+
+
+class FakeTokenEndpoint:
+    """Stands in for oauth2.googleapis.com/token."""
+
+    def __init__(self):
+        self.assertions: list[dict] = []
+        self.minted = 0
+        self.app = web.Application()
+        self.app.router.add_post("/token", self.handle)
+        self.runner = None
+        self.url = None
+
+    async def handle(self, request):
+        form = await request.post()
+        assert form["grant_type"] == "urn:ietf:params:oauth:grant-type:jwt-bearer"
+        header, claims, _sig = form["assertion"].split(".")
+        pad = lambda s: s + "=" * (-len(s) % 4)
+        self.assertions.append({
+            "header": json.loads(base64.urlsafe_b64decode(pad(header))),
+            "claims": json.loads(base64.urlsafe_b64decode(pad(claims))),
+        })
+        self.minted += 1
+        return web.json_response(
+            {"access_token": f"tok-{self.minted}", "expires_in": 3600}
+        )
+
+    async def __aenter__(self):
+        self.runner = web.AppRunner(self.app)
+        await self.runner.setup()
+        site = web.TCPSite(self.runner, "127.0.0.1", 0)
+        await site.start()
+        port = site._server.sockets[0].getsockname()[1]
+        self.url = f"http://127.0.0.1:{port}/token"
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.runner.cleanup()
+
+
+def _llm(provider: str, **spec_kwargs) -> LLM:
+    return LLM(
+        metadata=ObjectMeta(name="llm"),
+        spec=LLMSpec(provider=provider, **spec_kwargs),
+    )
+
+
+# -- service-account token source -------------------------------------------
+
+
+def test_looks_like_service_account():
+    assert looks_like_service_account('{"type": "service_account"}')
+    assert not looks_like_service_account("sk-ant-12345")
+    assert not looks_like_service_account('{"type": "authorized_user"}')
+    assert not looks_like_service_account("{not json")
+
+
+def test_sa_credential_validation():
+    with pytest.raises(Invalid, match="missing fields"):
+        ServiceAccountTokenSource('{"type": "service_account"}')
+    with pytest.raises(Invalid, match="not JSON"):
+        ServiceAccountTokenSource("nope")
+
+
+async def test_token_mint_claims_and_caching():
+    import httpx
+
+    async with FakeTokenEndpoint() as fake:
+        source = ServiceAccountTokenSource(make_sa_credential(fake.url))
+        async with httpx.AsyncClient() as http:
+            tok = await source.token(http)
+            assert tok == "tok-1"
+            assert fake.assertions[0]["header"]["alg"] == "RS256"
+            claims = fake.assertions[0]["claims"]
+            assert claims["iss"] == "robot@proj.iam.gserviceaccount.com"
+            assert claims["aud"] == fake.url
+            assert claims["scope"].endswith("cloud-platform")
+            assert claims["exp"] > claims["iat"]
+
+            # cached until expiry: no second mint
+            assert await source.token(http) == "tok-1"
+            assert fake.minted == 1
+
+            # invalidate (e.g. server-side 401) -> fresh token
+            source.invalidate()
+            assert await source.token(http) == "tok-2"
+
+
+# -- factory wiring ----------------------------------------------------------
+
+
+async def test_vertex_service_account_flow_end_to_end():
+    """LLM(provider=vertex) with an SA-JSON credential: the factory builds a
+    client whose requests carry a token minted from the faked endpoint."""
+    factory = DefaultLLMClientFactory()
+    try:
+        async with FakeTokenEndpoint() as fake, FakeProvider(
+            lambda body: CHAT_RESPONSE
+        ) as provider:
+            llm = _llm(
+                "vertex",
+                parameters=BaseConfig(model="gemini-pro", base_url=provider.url),
+                vertex=VertexProviderConfig(
+                    cloud_project="proj", cloud_location="us-central1"
+                ),
+            )
+            client = await factory.create_client(llm, make_sa_credential(fake.url))
+            msg = await client.send_request(
+                [Message(role="user", content="hi")], []
+            )
+            assert msg.content == "ok"
+            _, headers, _ = provider.requests[0]
+            assert headers["Authorization"] == "Bearer tok-1"
+            assert fake.minted == 1
+    finally:
+        await factory.aclose()
+
+
+async def test_vertex_base_url_derived_from_typed_config():
+    factory = DefaultLLMClientFactory()
+    try:
+        llm = _llm(
+            "vertex",
+            vertex=VertexProviderConfig(
+                cloud_project="proj", cloud_location="europe-west4"
+            ),
+        )
+        client = await factory.create_client(llm, "ya29.raw-access-token")
+        assert str(client._http.base_url).startswith(
+            "https://europe-west4-aiplatform.googleapis.com/v1/projects/proj"
+        )
+    finally:
+        await factory.aclose()
+
+
+async def test_vertex_requires_typed_config_or_base_url():
+    factory = DefaultLLMClientFactory()
+    with pytest.raises(Invalid, match="cloudProject"):
+        await factory.create_client(_llm("vertex"), "key")
+
+
+async def test_openai_organization_header(monkeypatch):
+    factory = DefaultLLMClientFactory()
+    try:
+        async with FakeProvider(lambda body: CHAT_RESPONSE) as provider:
+            llm = _llm(
+                "openai",
+                parameters=BaseConfig(model="gpt-4o", base_url=provider.url),
+                openai=OpenAIProviderConfig(organization="org-abc"),
+            )
+            client = await factory.create_client(llm, "sk-x")
+            await client.send_request([], [])
+            _, headers, _ = provider.requests[0]
+            assert headers["OpenAI-Organization"] == "org-abc"
+            assert headers["Authorization"] == "Bearer sk-x"
+    finally:
+        await factory.aclose()
+
+
+async def test_azure_api_type_key_header_and_version():
+    factory = DefaultLLMClientFactory()
+    try:
+        async with FakeProvider(lambda body: CHAT_RESPONSE) as provider:
+            llm = _llm(
+                "openai",
+                parameters=BaseConfig(model="gpt-4o", base_url=provider.url),
+                openai=OpenAIProviderConfig(
+                    api_type="AZURE", api_version="2023-05-15"
+                ),
+            )
+            client = await factory.create_client(llm, "azure-key")
+            await client.send_request([], [])
+            path, headers, _ = provider.requests[0]
+            assert headers["api-key"] == "azure-key"
+            assert "Authorization" not in headers
+    finally:
+        await factory.aclose()
+
+
+def test_azure_requires_api_version():
+    with pytest.raises(ValueError, match="apiVersion"):
+        OpenAIProviderConfig(api_type="AZURE")
+
+
+async def test_mistral_random_seed_and_timeout():
+    factory = DefaultLLMClientFactory()
+    try:
+        async with FakeProvider(lambda body: CHAT_RESPONSE) as provider:
+            llm = _llm(
+                "mistral",
+                parameters=BaseConfig(model="mistral-large", base_url=provider.url),
+                mistral=MistralProviderConfig(random_seed=42, timeout=7),
+            )
+            client = await factory.create_client(llm, "key")
+            await client.send_request([], [])
+            _, _, body = provider.requests[0]
+            assert body["random_seed"] == 42
+            assert client._http.timeout.read == 7.0
+    finally:
+        await factory.aclose()
+
+
+async def test_anthropic_beta_header():
+    factory = DefaultLLMClientFactory()
+    try:
+        async with FakeProvider(lambda body: ANTHROPIC_RESPONSE) as provider:
+            llm = _llm(
+                "anthropic",
+                parameters=BaseConfig(model="claude-3-5-sonnet", base_url=provider.url),
+                anthropic=AnthropicProviderConfig(
+                    anthropic_beta_header="max-tokens-3-5-sonnet-2024-07-15"
+                ),
+            )
+            client = await factory.create_client(llm, "sk-ant")
+            await client.send_request([], [])
+            _, headers, _ = provider.requests[0]
+            assert headers["anthropic-beta"] == "max-tokens-3-5-sonnet-2024-07-15"
+    finally:
+        await factory.aclose()
