@@ -120,8 +120,9 @@ class TPUProviderConfig(APIModel):
     preset: Optional[str] = None
     tensor_parallelism: int = 0  # 0 = all local devices
     # >1 shards the KV cache's context dim over an 'sp' mesh axis
-    # (context-parallel serving; slot layout only) — long max_context
-    # without growing per-chip HBM
+    # (context-parallel serving; both layouts — the paged pools shard
+    # their within-page dim, keeping prefix-page sharing) — long
+    # max_context without growing per-chip HBM
     context_parallelism: int = 1
     max_sequences: int = 64
     max_context: int = 8192

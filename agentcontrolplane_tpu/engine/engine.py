@@ -217,12 +217,16 @@ class Engine:
             )
         if sp > 1:
             # context parallelism: the slot cache's ctx dim shards over sp
-            # (kv_cache_specs); paged pages have no contiguous ctx dim to
-            # shard, and the ctx length must split evenly across ranks
-            if kv_layout == "paged":
+            # (kv_cache_specs); the paged pools shard their WITHIN-PAGE dim
+            # over sp (every rank holds a 1/sp slice of every page, so page
+            # gathers stay rank-local and prefix-page sharing is preserved
+            # — the attention reductions keep (page, offset) unmerged and
+            # compile to per-shard partials + tiny all-reduces, pinned by
+            # tests/parallel/test_context_parallel_serving.py)
+            if kv_layout == "paged" and self.page_size % sp:
                 raise ValueError(
-                    "context parallelism (mesh 'sp' axis > 1) requires "
-                    "kv_layout='slot'"
+                    f"page_size={self.page_size} must be divisible by the "
+                    f"mesh's sp={sp} for context-parallel paged serving"
                 )
             if self.max_ctx % sp:
                 raise ValueError(
@@ -294,15 +298,21 @@ class Engine:
             # shape-cast the page buffer's [P, H_kv*d] -> [P, H_kv, d] split
             # for other widths (e.g. the tiny CPU-test configs), so those
             # fall back to the exact XLA gather reference.
+            # sp>1 uses the XLA path: the kernel computes a full softmax
+            # internally, but context-parallel ranks hold page SLICES and
+            # must merge flash partials ACROSS ranks — a kernel that emits
+            # (acc, m, l) partials for a psum merge is tracked follow-up.
             self._use_pallas = (
-                jax.default_backend() == "tpu" and config.head_dim % 128 == 0
+                jax.default_backend() == "tpu"
+                and config.head_dim % 128 == 0
+                and sp == 1
             )
             if jax.default_backend() == "tpu" and not self._use_pallas:
                 log.warning(
-                    "paged kv_layout on TPU without the Pallas kernel: "
-                    "head_dim %d is not a multiple of 128; decode uses the "
-                    "XLA gather reference (materializes the gathered context "
-                    "every step)", config.head_dim,
+                    "paged kv_layout on TPU without the Pallas kernel "
+                    "(head_dim %d %% 128, sp=%d): decode uses the XLA gather "
+                    "reference (materializes the gathered context every "
+                    "step)", config.head_dim, sp,
                 )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
@@ -548,9 +558,18 @@ class Engine:
             from ..models.llama import init_paged_cache
             from ..ops.paged import PageAllocator
 
+            sp_axis = (
+                "sp"
+                if "sp" in self.mesh.axis_names and dict(self.mesh.shape)["sp"] > 1
+                else None
+            )
+            # [L, num_pages, page_size, H_kv, d]: heads over tp; within-page
+            # over sp (context-parallel paged serving — page ids stay
+            # rank-local, each rank holds a slice of every page)
+            page_spec = P(None, None, sp_axis, "tp", None)
             page_shardings = {
-                "k": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
-                "v": NamedSharding(self.mesh, P(None, None, None, "tp", None)),
+                "k": NamedSharding(self.mesh, page_spec),
+                "v": NamedSharding(self.mesh, page_spec),
             }
             self.cache = jax.jit(
                 lambda: init_paged_cache(self.config, self.num_pages, self.page_size),

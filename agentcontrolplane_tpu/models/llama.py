@@ -550,9 +550,18 @@ def prefill_paged_continue(
     P = pages["k"].shape[2]
     # keys = [gathered prefix pages (positions < start) ++ own suffix]; the
     # suffix pages referenced by the block table are not yet written, so
-    # their gathered rows are stale — masked via key position -1
-    row_pos = jnp.arange(max_pages * P)[None, :]
-    cache_pos = jnp.where(row_pos < starts[:, None], row_pos, -1)  # [B, MP*P]
+    # their gathered rows are stale — masked via key position -1.
+    # OFFSET-MAJOR row order: gathered pages are transposed to [P, M]
+    # before the merge so the within-page axis — which carries the mesh's
+    # 'sp' axis under context-parallel serving — stays OUTERMOST. Merging
+    # with the sharded axis inner is not GSPMD-representable and would
+    # all-gather the page pool; outermost, the merged ctx dim stays
+    # contiguously sp-sharded (same shape as the slot path's sharded C).
+    r_idx = jnp.arange(P * max_pages)
+    row_pos = (r_idx % max_pages) * P + r_idx // max_pages  # abs ctx position
+    cache_pos = jnp.where(
+        row_pos[None, :] < starts[:, None], row_pos[None, :], -1
+    )  # [B, P*M]
     key_pos = jnp.concatenate([cache_pos, positions], axis=1)
 
     def body(carry, scanned):
@@ -560,11 +569,11 @@ def prefill_paged_continue(
         layer, k_pages_l, v_pages_l = scanned  # read-only
 
         def attn(q, k, v):
-            k_rows = k_pages_l[block_tables].reshape(
-                B, max_pages * P, *k_pages_l.shape[2:]
+            k_rows = jnp.swapaxes(k_pages_l[block_tables], 1, 2).reshape(
+                B, P * max_pages, *k_pages_l.shape[2:]
             )
-            v_rows = v_pages_l[block_tables].reshape(
-                B, max_pages * P, *v_pages_l.shape[2:]
+            v_rows = jnp.swapaxes(v_pages_l[block_tables], 1, 2).reshape(
+                B, P * max_pages, *v_pages_l.shape[2:]
             )
             k_full = jnp.concatenate([k_rows, k.astype(k_rows.dtype)], axis=1)
             v_full = jnp.concatenate([v_rows, v.astype(v_rows.dtype)], axis=1)

@@ -72,21 +72,32 @@ def paged_decode_attention_reference(
 ) -> jax.Array:
     """Exact paged attention by materializing each slot's pages (gather).
     O(S * max_pages * P) HBM traffic + a gathered copy — the thing the
-    Pallas kernel avoids."""
+    Pallas kernel avoids.
+
+    The (page, offset) axes stay UNMERGED through the whole reduction:
+    under context-parallel serving the pools' within-page dim carries the
+    mesh's 'sp' axis, and a merge-reshape of (replicated, sharded) axes is
+    not GSPMD-representable — it would all-gather the cache. Unmerged, the
+    softmax reductions compile to per-shard partials + tiny all-reduces,
+    the same pattern as the slot layout's ctx-sharded cache."""
     S, H, d = q.shape
     num_pages, P, H_kv, _ = k_pages.shape
     max_pages = block_tables.shape[1]
-    k = k_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
-    v = v_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
-    n_rep = H // H_kv
-    k = repeat_kv(k, n_rep)
-    v = repeat_kv(v, n_rep)
+    k = k_pages[block_tables]  # [S, M, P, H_kv, d]
+    v = v_pages[block_tables]
+    r = H // H_kv
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) * scale
-    mask = jnp.arange(max_pages * P)[None, None, :] < seq_lens[:, None, None]
+    q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
+    logits = jnp.einsum("skrd,smpkd->smpkr", q4, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages)[:, None] * P + jnp.arange(P)[None, :]  # [M, P]
+    mask = pos[None, :, :, None, None] < seq_lens[:, None, None, None, None]
     logits = jnp.where(mask, logits, NEG_INF)
-    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
-    return jnp.einsum("shc,schd->shd", probs, v)
+    m = jnp.max(logits, axis=(1, 2))  # [S, H_kv, r]
+    p = jnp.exp(logits - m[:, None, None])
+    denom = jnp.sum(p, axis=(1, 2))
+    out = jnp.einsum("smpkr,smpkd->skrd", p, v.astype(jnp.float32))
+    out = out / jnp.maximum(denom, 1e-30)[..., None]
+    return out.reshape(S, H, d).astype(q.dtype)
 
 
 def paged_decode_attention_reference_cache_plus_new(
@@ -101,29 +112,30 @@ def paged_decode_attention_reference_cache_plus_new(
     """Exact reference for the read-only-pages + self-term decode form (the
     hot-loop shape: pages stay a read-only operand, the new token attends
     via an explicit term, writes happen once per step outside the layer
-    scan — see models/llama.py decode_step_paged)."""
+    scan — see models/llama.py decode_step_paged).
+
+    (page, offset) axes stay unmerged — see
+    :func:`paged_decode_attention_reference` for why (sp sharding)."""
     S, H, d = q.shape
     num_pages, P, H_kv, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     r = H // H_kv
-    k = k_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
-    v = v_pages[block_tables].reshape(S, max_pages * P, H_kv, d)
+    k = k_pages[block_tables]  # [S, M, P, H_kv, d]
+    v = v_pages[block_tables]
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
-    logits = jnp.einsum("skrd,sckd->sckr", q4, k.astype(jnp.float32)) * scale
-    mask = (
-        jnp.arange(max_pages * P)[None, :, None, None]
-        < seq_lens[:, None, None, None]
-    )
+    logits = jnp.einsum("skrd,smpkd->smpkr", q4, k.astype(jnp.float32)) * scale
+    pos = jnp.arange(max_pages)[:, None] * P + jnp.arange(P)[None, :]  # [M, P]
+    mask = pos[None, :, :, None, None] < seq_lens[:, None, None, None, None]
     logits = jnp.where(mask, logits, NEG_INF)
     self_logit = (
         jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale
     )  # [S, H_kv, r]
-    m = jnp.maximum(jnp.max(logits, axis=1), self_logit)
-    p = jnp.exp(logits - m[:, None])
+    m = jnp.maximum(jnp.max(logits, axis=(1, 2)), self_logit)
+    p = jnp.exp(logits - m[:, None, None])
     p_self = jnp.exp(self_logit - m)
-    denom = jnp.sum(p, axis=1) + p_self
-    out = jnp.einsum("sckr,sckd->skrd", p, v.astype(jnp.float32))
+    denom = jnp.sum(p, axis=(1, 2)) + p_self
+    out = jnp.einsum("smpkr,smpkd->skrd", p, v.astype(jnp.float32))
     out = out + p_self[..., None] * v_new.astype(jnp.float32)[:, :, None, :]
     out = out / jnp.maximum(denom, 1e-30)[..., None]
     return out.reshape(S, H, d).astype(q.dtype)
