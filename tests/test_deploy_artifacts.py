@@ -27,17 +27,37 @@ def test_all_deploy_yaml_parses():
         assert docs, p
 
 
+def _deployment_images(path: pathlib.Path) -> list[str]:
+    return [
+        c["image"]
+        for d in yaml.safe_load_all(path.read_text())
+        if d and d.get("kind") == "Deployment"
+        for c in d["spec"]["template"]["spec"]["containers"]
+    ]
+
+
 def test_release_bundles_exist_and_pin_the_image():
-    latest = yaml.safe_load_all((DEPLOY / "release" / "latest.yaml").read_text())
-    versioned = yaml.safe_load_all((DEPLOY / "release" / "v0.3.0.yaml").read_text())
-    for docs, tag in ((latest, ":latest"), (versioned, ":v0.3.0")):
-        images = [
-            c["image"]
-            for d in docs
-            if d and d.get("kind") == "Deployment"
-            for c in d["spec"]["template"]["spec"]["containers"]
-        ]
-        assert images and all(tag in i for i in images)
+    """EVERY versioned bundle pins its own tag (the reference ships one
+    manifest per release, acp/config/release/v*.yaml)."""
+    versioned = sorted((DEPLOY / "release").glob("v*.yaml"))
+    assert len(versioned) >= 2  # history accumulates; releases are not rewritten
+    for path in versioned:
+        tag = ":" + path.stem
+        images = _deployment_images(path)
+        assert images and all(tag in i for i in images), path
+    images = _deployment_images(DEPLOY / "release" / "latest.yaml")
+    assert images and all(":latest" in i for i in images)
+
+
+def test_current_version_has_a_release_bundle_and_latest_mirrors_it():
+    """Lockstep: __version__ must have deploy/release/v<version>.yaml, and
+    latest.yaml must be that bundle with only the image tag changed."""
+    from agentcontrolplane_tpu import __version__
+
+    current = DEPLOY / "release" / f"v{__version__}.yaml"
+    assert current.exists(), f"no release bundle for __version__={__version__}"
+    expected_latest = current.read_text().replace(f"v{__version__}", "latest")
+    assert (DEPLOY / "release" / "latest.yaml").read_text() == expected_latest
 
 
 def _cli_flags() -> set[str]:
@@ -57,14 +77,17 @@ def test_dockerfile_cmd_flags_exist_in_cli():
 
 def test_release_manifest_args_exist_in_cli():
     flags = _cli_flags()
-    for doc in yaml.safe_load_all((DEPLOY / "release" / "latest.yaml").read_text()):
-        if not doc or doc.get("kind") != "Deployment":
-            continue
-        for c in doc["spec"]["template"]["spec"]["containers"]:
-            for arg in c.get("args", []):
-                if arg.startswith("--"):
-                    flag = arg.split("=", 1)[0]
-                    assert flag in flags, f"release manifest uses unknown flag {flag}"
+    for path in (DEPLOY / "release").glob("*.yaml"):
+        for doc in yaml.safe_load_all(path.read_text()):
+            if not doc or doc.get("kind") != "Deployment":
+                continue
+            for c in doc["spec"]["template"]["spec"]["containers"]:
+                for arg in c.get("args", []):
+                    if arg.startswith("--"):
+                        flag = arg.split("=", 1)[0]
+                        assert flag in flags, (
+                            f"{path.name} uses unknown flag {flag}"
+                        )
 
 
 def _emitted_metric_names() -> set[str]:
