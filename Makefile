@@ -3,7 +3,7 @@
 
 PY ?= python
 
-.PHONY: test test-unit test-e2e test-stress bench run lint dryrun ci \
+.PHONY: test test-unit test-e2e test-stress bench run run-multi lint dryrun ci \
 	docker-build docker-run observability-up observability-down
 
 IMG ?= acp-tpu:dev
@@ -41,6 +41,12 @@ dryrun:
 
 run:
 	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db
+
+run-multi:  ## two-replica dev control plane: owner serves the store, follower joins
+	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db \
+	  --serve-store unix:///tmp/acp-store.sock --identity owner & \
+	sleep 2 && $(PY) -m agentcontrolplane_tpu.cli run \
+	  --store unix:///tmp/acp-store.sock --identity follower --port 8083
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \
