@@ -43,10 +43,11 @@ run:
 	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db
 
 run-multi:  ## two-replica dev control plane: owner serves the store, follower joins
-	$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db \
+	@sh -c '$(PY) -m agentcontrolplane_tpu.cli run --db acp-state.db \
 	  --serve-store unix:///tmp/acp-store.sock --identity owner & \
-	sleep 2 && $(PY) -m agentcontrolplane_tpu.cli run \
-	  --store unix:///tmp/acp-store.sock --identity follower --port 8083
+	  owner=$$!; trap "kill $$owner 2>/dev/null" EXIT INT TERM; \
+	  sleep 2 && $(PY) -m agentcontrolplane_tpu.cli run \
+	  --store unix:///tmp/acp-store.sock --identity follower --port 8083'
 
 lint:
 	@if command -v ruff >/dev/null 2>&1; then \

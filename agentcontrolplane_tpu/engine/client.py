@@ -121,32 +121,33 @@ class TPUEngineClient(LLMClient):
     async def _await_result(self, future):
         """Two-phase wait: queue_timeout_s bounds submit->slot-admission,
         request_timeout_s bounds admission->completion. Raises
-        asyncio.TimeoutError (message says which phase expired)."""
+        asyncio.TimeoutError (message says which phase expired).
+
+        The admission signal is a concurrent Future bridged with
+        wrap_future — callback-based, so a queued request parks NO executor
+        thread (64 queued requests would otherwise exhaust the default
+        ThreadPoolExecutor and stall every other to_thread call)."""
         wrapped = asyncio.wrap_future(future)
         admitted = getattr(future, "admitted", None)
-        if admitted is not None and not admitted.is_set():
-            admit_wait = asyncio.ensure_future(
-                asyncio.to_thread(admitted.wait, self.queue_timeout_s)
-            )
+        if admitted is not None and not admitted.done():
+            admit_wait = asyncio.wrap_future(admitted)
             try:
                 # completion also ends the queue phase (fast failure paths
-                # complete the future without ever setting admitted)
+                # complete the future without ever resolving admission)
                 done, _ = await asyncio.wait(
-                    {wrapped, admit_wait}, return_when=asyncio.FIRST_COMPLETED
+                    {wrapped, admit_wait},
+                    timeout=self.queue_timeout_s,
+                    return_when=asyncio.FIRST_COMPLETED,
                 )
                 if wrapped in done:
                     return wrapped.result()
-                if not admit_wait.result():
+                if admit_wait not in done:
                     raise asyncio.TimeoutError(
                         f"TPU engine queue wait exceeded {self.queue_timeout_s:.0f}s "
                         "(engine wedged or oversubscribed)"
                     )
             finally:
                 if not admit_wait.done():
-                    # the event-wait thread parks for up to queue_timeout_s;
-                    # signal it instead of leaking a parked thread (the
-                    # engine only ever sets this event, it never reads it)
-                    admitted.set()
                     admit_wait.cancel()
         try:
             return await asyncio.wait_for(wrapped, timeout=self.request_timeout_s)

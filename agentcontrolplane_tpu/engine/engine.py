@@ -35,7 +35,7 @@ import queue
 import threading
 import time
 import uuid
-from concurrent.futures import Future
+from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Optional, Sequence
@@ -106,10 +106,14 @@ class _Request:
     # neither hit nor usefully seed the prefix cache
     truncated: bool = False
     enqueued: float = field(default_factory=time.monotonic)
-    # set when the request takes a slot (prefill starts). Clients key their
-    # generation timeout off this, so queue wait under saturation doesn't
-    # eat the per-request budget (mirrored onto future.admitted by submit).
-    admitted: threading.Event = field(default_factory=threading.Event)
+    # completed (True) when the request takes a slot (prefill starts).
+    # Clients key their generation timeout off this, so queue wait under
+    # saturation doesn't eat the per-request budget (mirrored onto
+    # future.admitted by submit). A concurrent Future rather than an Event:
+    # asyncio callers bridge it with wrap_future (callback-based) instead
+    # of parking a default-executor thread per queued request — 64 queued
+    # requests would otherwise exhaust the shared executor.
+    admitted: Future = field(default_factory=Future)
 
     def emit(self, tokens: list[int]) -> None:
         if self.on_tokens is not None and tokens:
@@ -1014,7 +1018,10 @@ class Engine:
                 break  # head request can't fit (KV pages); FIFO, wait
             admitted = True
             for item in group:
-                item[0].admitted.set()  # starts the client's generation clock
+                # starts the client's generation clock; a caller that gave
+                # up (timeout/cancel) may have cancelled the future already
+                with contextlib.suppress(InvalidStateError):
+                    item[0].admitted.set_result(True)
             # per item: resolve the prefix-cache start (match + page
             # assembly already happened in _collect_group), then spill any
             # overlong remainder through intermediate continuation chunks

@@ -120,9 +120,20 @@ class _Conn:
 
     def _read_loop(self) -> None:
         try:
-            for line in self.sock.makefile("rb"):
-                if len(line) > _MAX_FRAME:
-                    raise Invalid("frame too large")
+            f = self.sock.makefile("rb")
+            while True:
+                # bounded readline: the size cap must hold BEFORE the frame
+                # is buffered (a plain line-iterator would materialize an
+                # arbitrarily large frame first, making the cap cosmetic)
+                line = f.readline(_MAX_FRAME + 1)
+                if not line:
+                    break
+                if len(line) > _MAX_FRAME or not line.endswith(b"\n"):
+                    log.warning(
+                        "served-store frame exceeds %d bytes; dropping connection",
+                        _MAX_FRAME,
+                    )
+                    break
                 self._handle(json.loads(line))
         except (OSError, ValueError):
             pass
@@ -181,7 +192,14 @@ class _Conn:
         raise Invalid(f"unknown op {op!r}")
 
     def _start_watch(self, a: dict[str, Any]) -> dict[str, Any]:
-        wid = self.server._next_wid()
+        # the CLIENT assigns the wid (unique per connection) and registers
+        # its handler BEFORE sending the request — a server-assigned id
+        # would leave a window where events relayed between subscribe and
+        # the reply reaching the client are dropped as unknown-wid (a
+        # DELETED lost there is never recovered; reconcilers list only on
+        # watch start). Server-assigned ids remain as a fallback for
+        # hand-rolled clients.
+        wid = int(a["wid"]) if "wid" in a else self.server._next_wid()
         kinds = frozenset(a["kinds"])
         namespace = a.get("namespace")
 
@@ -373,6 +391,7 @@ class RemoteStore:
         self._pending: dict[int, dict[str, Any]] = {}
         self._pending_lock = threading.Lock()
         self._rid = 0
+        self._wid = 0  # client-assigned watch ids (see watch())
         self._watches: dict[int, _RemoteWatch] = {}
         self._closed = threading.Event()
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
@@ -382,8 +401,10 @@ class RemoteStore:
 
     def _read_loop(self) -> None:
         try:
-            for line in self._sock.makefile("rb"):
-                if len(line) > _MAX_FRAME:
+            f = self._sock.makefile("rb")
+            while True:
+                line = f.readline(_MAX_FRAME + 1)  # bounded (see _Conn)
+                if not line or len(line) > _MAX_FRAME or not line.endswith(b"\n"):
                     break
                 msg = json.loads(line)
                 if "watch" in msg:
@@ -513,10 +534,19 @@ class RemoteStore:
     ) -> _RemoteWatch:
         if isinstance(kinds, str):
             kinds = [kinds]
-        payload = self._call("watch", kinds=sorted(kinds), namespace=namespace)
-        wid = int(payload["wid"])
+        # register BEFORE the RPC: the server subscribes before replying,
+        # so an event can be in flight ahead of the reply frame — the
+        # reader thread must already know this wid or the event is lost
+        with self._pending_lock:
+            self._wid += 1
+            wid = self._wid
         w = _RemoteWatch(self, wid)
         self._watches[wid] = w
+        try:
+            self._call("watch", kinds=sorted(kinds), namespace=namespace, wid=wid)
+        except BaseException:
+            self._watches.pop(wid, None)
+            raise
         return w
 
     def _stop_watch(self, w: _RemoteWatch) -> None:

@@ -125,8 +125,17 @@ class DefaultLLMClientFactory:
                 base_url = params.base_url or DEFAULT_BASE_URLS.get(
                     provider, DEFAULT_BASE_URLS["openai"]
                 )
+            # the key carries EVERY config the client bakes in (headers,
+            # query, timeout): two LLM CRs sharing (provider, url, key) but
+            # differing in e.g. spec.openai.organization or spec.mistral
+            # timeout must not silently reuse each other's connection
             http = self._pooled_http(
-                (provider, base_url, api_key, tuple(sorted(query.items()))),
+                (
+                    provider, base_url, api_key,
+                    tuple(sorted(headers.items())),
+                    tuple(sorted(query.items())),
+                    timeout,
+                ),
                 lambda: httpx.AsyncClient(
                     base_url=base_url,
                     headers=headers,

@@ -32,9 +32,11 @@ def make_future(admitted: bool | None = False) -> Future:
     """admitted=None -> legacy future without the attribute."""
     fut: Future = Future()
     if admitted is not None:
-        fut.admitted = threading.Event()  # type: ignore[attr-defined]
+        # concurrent Future, not an Event: the client bridges it with
+        # wrap_future so queued requests park no executor threads
+        fut.admitted = Future()  # type: ignore[attr-defined]
         if admitted:
-            fut.admitted.set()  # type: ignore[attr-defined]
+            fut.admitted.set_result(True)  # type: ignore[attr-defined]
     return fut
 
 
@@ -47,7 +49,7 @@ async def test_queue_wait_does_not_consume_generation_budget():
     def engine_side():
         # queued for longer than request_timeout_s...
         threading.Event().wait(0.6)
-        fut.admitted.set()
+        fut.admitted.set_result(True)
         threading.Event().wait(0.2)  # then generates well inside the budget
         fut.set_result("generated")
 
