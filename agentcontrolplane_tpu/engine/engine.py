@@ -302,21 +302,19 @@ class Engine:
             # shape-cast the page buffer's [P, H_kv*d] -> [P, H_kv, d] split
             # for other widths (e.g. the tiny CPU-test configs), so those
             # fall back to the exact XLA gather reference.
-            # sp>1 uses the XLA path: the kernel computes a full softmax
-            # internally, but context-parallel ranks hold page SLICES and
-            # must merge flash partials ACROSS ranks — a kernel that emits
-            # (acc, m, l) partials for a psum merge is tracked follow-up.
+            # sp>1 composes: each context-parallel rank runs the kernel
+            # over its page slices (pos_base masking) and the unnormalized
+            # (acc, m, l) states merge across ranks with one pmax + two
+            # [S, H]-sized psums (paged_attention.py *_sp_sharded).
             self._use_pallas = (
-                jax.default_backend() == "tpu"
-                and config.head_dim % 128 == 0
-                and sp == 1
+                jax.default_backend() == "tpu" and config.head_dim % 128 == 0
             )
             if jax.default_backend() == "tpu" and not self._use_pallas:
                 log.warning(
-                    "paged kv_layout on TPU without the Pallas kernel "
-                    "(head_dim %d %% 128, sp=%d): decode uses the XLA gather "
-                    "reference (materializes the gathered context every "
-                    "step)", config.head_dim, sp,
+                    "paged kv_layout on TPU without the Pallas kernel: "
+                    "head_dim %d is not a multiple of 128; decode uses the "
+                    "XLA gather reference (materializes the gathered context "
+                    "every step)", config.head_dim,
                 )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 

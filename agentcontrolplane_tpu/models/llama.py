@@ -626,16 +626,20 @@ def decode_step_paged(
     S = tokens.shape[0]
     positions = seq_lens[:, None]
     x = _embed(params, tokens[:, None], c)
-    tp_size = 1
-    if mesh is not None and "tp" in mesh.axis_names:
-        tp_size = dict(zip(mesh.axis_names, mesh.devices.shape))["tp"]
+    tp_size = sp_size = 1
+    if mesh is not None:
+        axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+        tp_size = axes.get("tp", 1)
+        sp_size = axes.get("sp", 1)
 
     def body(carry, scanned):
         x = carry
         layer, k_pages_l, v_pages_l = scanned  # read-only
 
         def attn(q, k, v):
-            if use_pallas and tp_size > 1:
+            if use_pallas and (tp_size > 1 or sp_size > 1):
+                # the sharded wrapper routes sp>1 meshes through the
+                # cross-rank (acc, m, l) flash merge
                 from ..ops.pallas.paged_attention import (
                     paged_decode_attention_cache_plus_new_sharded,
                 )

@@ -47,31 +47,38 @@ def _kernel(
     # scalar prefetch
     block_tables_ref,  # [S, max_pages] int32 (SMEM)
     seq_lens_ref,  # [S] int32 (SMEM)
+    pos_base_ref,  # [1] int32 (SMEM) — this rank's within-page offset
     # inputs
     q_ref,  # [1, H, d] (VMEM) — this program's slot
-    k_pages_ref,  # [num_pages, P, H_kv * d] (HBM/ANY)
-    v_pages_ref,  # [num_pages, P, H_kv * d]
+    k_pages_ref,  # [num_pages, P_local, H_kv * d] (HBM/ANY)
+    v_pages_ref,  # [num_pages, P_local, H_kv * d]
     # outputs
     acc_ref,  # [1, H, d] f32 — unnormalized weighted V sum
     m_ref,  # [1, 1, H] f32 — running max (unit middle dim: TPU block shapes
     l_ref,  # [1, 1, H] f32 — need the trailing dims to tile or match)
     # scratch
-    k_buf,  # [NBUF, P, H_kv * d] (VMEM)
-    v_buf,  # [NBUF, P, H_kv * d]
+    k_buf,  # [NBUF, P_local, H_kv * d] (VMEM)
+    v_buf,  # [NBUF, P_local, H_kv * d]
     sems,  # DMA sems [NBUF, 2]
     *,
-    page_size: int,
+    page_size: int,  # GLOBAL page size (pages hold this many tokens)
     n_kv_heads: int,
     head_dim: int,
     max_pages: int,
 ):
+    # Under context-parallel serving each rank holds a [P_local = P/sp]
+    # slice of every page (pos_base = rank * P_local); the walk length and
+    # token positions are computed with the GLOBAL page size so masking is
+    # exact, while DMAs and compute touch only the local slice. sp=1 runs
+    # with pos_base=0 and P_local == page_size (the original behavior).
     s = pl.program_id(0)
     seq_len = seq_lens_ref[s]
     n_pages = jax.lax.div(seq_len + page_size - 1, page_size)
     H = q_ref.shape[1]
     n_rep = H // n_kv_heads
     d = head_dim
-    P = page_size
+    P = k_pages_ref.shape[1]  # local slice length
+    pos_base = pos_base_ref[0]
     NBUF = k_buf.shape[0]
 
     q = q_ref[0].astype(jnp.float32)  # [H, d]
@@ -117,7 +124,10 @@ def _kernel(
         logits = (
             jnp.sum(qg[None] * k[:, :, None, :], axis=-1).reshape(P, H) * scale
         )  # [P, H]
-        pos = j * P + jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+        pos = (
+            j * page_size + pos_base
+            + jax.lax.broadcasted_iota(jnp.int32, (P, 1), 0)
+        )
         logits = jnp.where(pos < seq_len, logits, NEG_INF)
 
         m_blk = jnp.max(logits, axis=0, keepdims=True)  # [1,H]
@@ -141,26 +151,30 @@ def _kernel(
 
 def _paged_state(
     q: jax.Array,  # [S, H, d]
-    k_pages: jax.Array,  # [num_pages, P, H_kv, d]
+    k_pages: jax.Array,  # [num_pages, P_local, H_kv, d]
     v_pages: jax.Array,
     block_tables: jax.Array,  # [S, max_pages] int32
     seq_lens: jax.Array,  # [S] int32
     interpret: bool = False,
+    pos_base: jax.Array | None = None,  # [1] int32 — sp rank's page offset
+    global_page_size: int | None = None,  # tokens per page (sp>1: > P_local)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
     """Run the kernel -> unnormalized (acc [S,H,d] f32, m [S,H], l [S,H])."""
     S, H, d = q.shape
     num_pages, P, H_kv, _ = k_pages.shape
     max_pages = block_tables.shape[1]
+    if pos_base is None:
+        pos_base = jnp.zeros((1,), dtype=jnp.int32)
 
     kernel = functools.partial(
         _kernel,
-        page_size=P,
+        page_size=global_page_size or P,
         n_kv_heads=H_kv,
         head_dim=d,
         max_pages=max_pages,
     )
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=2,
+        num_scalar_prefetch=3,
         grid=(S,),
         in_specs=[
             pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
@@ -190,6 +204,7 @@ def _paged_state(
     )(
         block_tables,
         seq_lens,
+        pos_base.astype(jnp.int32),
         q,
         k_pages.reshape(num_pages, P, H_kv * d),
         v_pages.reshape(num_pages, P, H_kv * d),
@@ -211,22 +226,13 @@ def paged_decode_attention(
     return out.astype(q.dtype)
 
 
-def paged_decode_attention_cache_plus_new(
-    q: jax.Array,  # [S, H, d]
-    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — WITHOUT the new token
-    v_pages: jax.Array,
-    block_tables: jax.Array,
-    seq_lens: jax.Array,  # [S] — tokens valid in the PAGES (excl. new)
-    k_new: jax.Array,  # [S, H_kv, d]
-    v_new: jax.Array,
-    interpret: bool = False,
-) -> jax.Array:
-    """Kernel over the read-only pages + the new token's self term, merged
-    outside the kernel (one more online-softmax fold, fused elementwise)."""
+def _fold_self_term(q, k_new, v_new, acc, m, l) -> jax.Array:
+    """One more online-softmax fold: merge the not-yet-written new token's
+    self-attention term into the kernel's unnormalized (acc, m, l) state and
+    normalize. Fused elementwise by XLA."""
     S, H, d = q.shape
-    H_kv = k_pages.shape[2]
+    H_kv = k_new.shape[1]
     r = H // H_kv
-    acc, m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
     self_logit = (
@@ -245,6 +251,22 @@ def paged_decode_attention_cache_plus_new(
         l2, 1e-30
     )[..., None]
     return out.astype(q.dtype)
+
+
+def paged_decode_attention_cache_plus_new(
+    q: jax.Array,  # [S, H, d]
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — WITHOUT the new token
+    v_pages: jax.Array,
+    block_tables: jax.Array,
+    seq_lens: jax.Array,  # [S] — tokens valid in the PAGES (excl. new)
+    k_new: jax.Array,  # [S, H_kv, d]
+    v_new: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Kernel over the read-only pages + the new token's self term, merged
+    outside the kernel."""
+    acc, m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
+    return _fold_self_term(q, k_new, v_new, acc, m, l)
 
 
 def _shard_wrap(fn, mesh, interpret, extra_sharded=()):
@@ -279,6 +301,57 @@ def paged_decode_attention_sharded(
     )
 
 
+def paged_decode_attention_cache_plus_new_sp_sharded(
+    mesh,
+    q: jax.Array,  # [S, H, d] — heads over 'tp', replicated over 'sp'
+    k_pages: jax.Array,  # [num_pages, P, H_kv, d] — P over 'sp', heads 'tp'
+    v_pages: jax.Array,
+    block_tables: jax.Array,  # replicated
+    seq_lens: jax.Array,  # replicated
+    k_new: jax.Array,  # [S, H_kv, d] — heads over 'tp', replicated over 'sp'
+    v_new: jax.Array,
+    interpret: bool = False,
+) -> jax.Array:
+    """Context-parallel kernel wrapper: each sp rank holds a 1/sp slice of
+    every page and runs the kernel over it (pos_base = rank * P_local, so
+    masking stays exact against global token positions); the unnormalized
+    (acc, m, l) states then merge across the sp axis with one pmax + two
+    psums of [S, H]-sized values — the online-softmax merge, never a
+    gathered context. The self term folds once after the merge (replicated
+    over sp). Composes with tp (heads stay head-parallel, no collectives
+    on that axis)."""
+    from jax.sharding import PartitionSpec as P
+
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    sp = axes.get("sp", 1)
+    P_global = k_pages.shape[1]
+    P_local = P_global // sp
+
+    def body(q, kp, vp, bt, sl, kn, vn):
+        pos_base = (jax.lax.axis_index("sp") * P_local).reshape(1)
+        acc, m, l = _paged_state(
+            q, kp, vp, bt, sl, interpret,
+            pos_base=pos_base, global_page_size=P_global,
+        )
+        m_g = jax.lax.pmax(m, "sp")
+        corr = jnp.exp(m - m_g)
+        l_g = jax.lax.psum(l * corr, "sp")
+        acc_g = jax.lax.psum(acc * corr[..., None], "sp")
+        return _fold_self_term(q, kn, vn, acc_g, m_g, l_g)
+
+    q_spec = P(None, "tp", None)
+    pages_spec = P(None, "sp", "tp", None)
+    new_spec = P(None, "tp", None)
+    return jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(q_spec, pages_spec, pages_spec, P(None, None), P(None),
+                  new_spec, new_spec),
+        out_specs=q_spec,
+        check_vma=False,
+    )(q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new)
+
+
 def paged_decode_attention_cache_plus_new_sharded(
     mesh,
     q: jax.Array,
@@ -292,6 +365,12 @@ def paged_decode_attention_cache_plus_new_sharded(
 ) -> jax.Array:
     from jax.sharding import PartitionSpec as P
 
+    axes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    if axes.get("sp", 1) > 1:
+        return paged_decode_attention_cache_plus_new_sp_sharded(
+            mesh, q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new,
+            interpret,
+        )
     new_spec = P(None, "tp", None)
     return _shard_wrap(
         paged_decode_attention_cache_plus_new,

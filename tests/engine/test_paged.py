@@ -210,3 +210,38 @@ def test_pallas_kernel_sharded_tp2_interpret():
         mesh, q, k_pages, v_pages, tables, seq_lens, interpret=True
     )
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_pallas_cache_plus_new_sp_sharded_interpret():
+    """Context-parallel kernel wrapper (sp=4 x tp=2): each rank runs the
+    kernel over its within-page slice and the unnormalized (acc, m, l)
+    states merge across sp with pmax + psum — result == exact reference."""
+    from agentcontrolplane_tpu.ops.paged import (
+        paged_decode_attention_reference_cache_plus_new,
+    )
+    from agentcontrolplane_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention_cache_plus_new_sharded,
+    )
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    q, k_pages, v_pages, tables, seq_lens, _ = _setup(
+        seed=9, S=3, H=8, Hkv=2, d=16, P=8, max_pages=4, num_pages=16
+    )
+    rng = np.random.default_rng(19)
+    S, (Hkv, d) = q.shape[0], k_pages.shape[2:]
+    k_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    v_new = jnp.asarray(rng.normal(size=(S, Hkv, d)), dtype=jnp.float32)
+    ref = paged_decode_attention_reference_cache_plus_new(
+        q, k_pages, v_pages, tables, seq_lens, k_new, v_new
+    )
+    for axes in ({"sp": 4, "tp": 2}, {"sp": 2, "tp": 1}):
+        n = axes["sp"] * axes["tp"]
+        mesh = make_mesh(axes, devices=jax.devices()[:n])
+        out = paged_decode_attention_cache_plus_new_sharded(
+            mesh, q, k_pages, v_pages, tables, seq_lens, k_new, v_new,
+            interpret=True,
+        )
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5,
+            err_msg=str(axes),
+        )
