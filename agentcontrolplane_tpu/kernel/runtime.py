@@ -136,6 +136,7 @@ class Manager:
         self._watches = []
         self.elector = LeaderElector(store, self.identity) if leader_election else None
         self._started = False
+        self._stopping = False
 
     def add_controller(
         self,
@@ -158,14 +159,45 @@ class Manager:
         self._runnables.append((fn, leader_gated))
 
     async def _watch_loop(self, ctl: _Controller) -> None:
-        kinds = {ctl.kind, *ctl.mappers.keys()}
-        watch = self.store.watch(kinds, namespace=None)
-        self._watches.append(watch)
-        # initial list (cache sync)
-        for obj in self.store.list(ctl.kind, namespace=None):
-            ctl.queue.add(obj.key)
-        async for ev in watch:
-            self._dispatch(ctl, ev)
+        """Watch + dispatch, with the apiserver resync contract: if the
+        watch ENDS while the manager is still running (a served store's
+        owner restarted and the RemoteStore connection died), re-list and
+        re-watch with backoff — a follower replica must come back on its
+        own rather than go deaf. In-process Store watches only end via
+        stop(), which sets _stopping first, so this never spins locally."""
+        backoff = 0.2
+        while not self._stopping:
+            kinds = {ctl.kind, *ctl.mappers.keys()}
+            try:
+                watch = self.store.watch(kinds, namespace=None)
+            except Exception:
+                log.warning(
+                    "%s: store watch unavailable; retrying in %.1fs",
+                    ctl.name, backoff,
+                )
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            self._watches.append(watch)
+            try:
+                # (re-)list: the cache-sync on first iteration, the resync
+                # covering events lost in the gap on later ones
+                for obj in self.store.list(ctl.kind, namespace=None):
+                    ctl.queue.add(obj.key)
+            except Exception:
+                watch.stop()
+                self._watches.remove(watch)
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
+            backoff = 0.2
+            async for ev in watch:
+                self._dispatch(ctl, ev)
+            if watch in self._watches:
+                self._watches.remove(watch)
+            if not self._stopping:
+                log.warning("%s: watch ended; resyncing", ctl.name)
+                await asyncio.sleep(backoff)
 
     def _dispatch(self, ctl: _Controller, ev: WatchEvent) -> None:
         obj = ev.object
@@ -243,6 +275,7 @@ class Manager:
         if self._started:
             return
         self._started = True
+        self._stopping = False
         if self.elector:
             self.elector.start()
         for ctl in self._controllers:
@@ -260,6 +293,7 @@ class Manager:
         await asyncio.sleep(0)
 
     async def stop(self) -> None:
+        self._stopping = True  # watch loops must not resync a stopping manager
         for ctl in self._controllers:
             ctl.queue.shutdown()
         for w in self._watches:

@@ -37,6 +37,7 @@ import os
 import queue
 import socket
 import threading
+import time
 from typing import Any, Callable, Iterable, Optional
 
 from ..api.meta import Resource
@@ -370,38 +371,87 @@ class RemoteStore:
     Drop-in for :class:`~.store.Store` everywhere the control plane consumes
     one (Operator(store=RemoteStore(addr))). Synchronous ops block on the
     RPC round-trip; watches stream asynchronously into the caller's loop.
-    A dead server surfaces as ``ConnectionError`` from any op — replicas
-    treat the store like controllers treat the apiserver (crash, restart,
-    resync)."""
+    A store-owner restart is survived: RPC ops lazily reconnect (see
+    ``_call`` for the at-most-once rules), while live watches END (sentinel)
+    — consumers re-list + re-watch, exactly the apiserver watch contract
+    (Manager._watch_loop does this automatically)."""
 
-    def __init__(self, address: str, timeout: float = 30.0):
+    def __init__(
+        self,
+        address: str,
+        timeout: float = 30.0,
+        reconnect_attempts: int = 5,
+        reconnect_backoff: float = 0.2,
+    ):
         self.address = address
         self._timeout = timeout
-        family, target = _parse_address(address)
-        if family == "unix":
-            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.connect(target)
-        else:
-            sock = socket.create_connection(target, timeout=timeout)
-            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(None)  # reader thread blocks; per-op timeout below
-        self._sock = sock
-        self._wfile = sock.makefile("wb")
+        self._reconnect_attempts = reconnect_attempts
+        self._reconnect_backoff = reconnect_backoff
         self._send_lock = threading.Lock()
+        self._conn_lock = threading.Lock()
         self._pending: dict[int, dict[str, Any]] = {}
         self._pending_lock = threading.Lock()
         self._rid = 0
         self._wid = 0  # client-assigned watch ids (see watch())
         self._watches: dict[int, _RemoteWatch] = {}
-        self._closed = threading.Event()
-        self._reader = threading.Thread(target=self._read_loop, daemon=True)
-        self._reader.start()
+        self._user_closed = False
+        self._connect()
 
     # -- plumbing --------------------------------------------------------
 
-    def _read_loop(self) -> None:
+    def _connect(self) -> None:
+        """(Re)establish the socket + reader. Caller holds _conn_lock (or is
+        __init__). The per-connection _closed event is swapped atomically so
+        an old reader's death can never mark the NEW connection closed."""
+        family, target = _parse_address(self.address)
+        if family == "unix":
+            sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            sock.connect(target)
+        else:
+            sock = socket.create_connection(target, timeout=self._timeout)
+            sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.settimeout(None)  # reader thread blocks; per-op timeout below
+        self._sock = sock
+        self._wfile = sock.makefile("wb")
+        self._closed = threading.Event()
+        self._reader = threading.Thread(
+            target=self._read_loop, args=(sock, self._closed), daemon=True
+        )
+        self._reader.start()
+
+    def _reconnect(self) -> None:
+        """Lazy reconnect after the server went away (owner-pod restart):
+        replicas treat the store like controllers treat the apiserver.
+        Watches from the old connection are already ended (their consumers
+        re-list + re-watch — Manager._watch_loop does exactly that); only
+        the RPC channel is revived here."""
+        with self._conn_lock:
+            if self._user_closed:
+                raise ConnectionError(
+                    f"store connection to {self.address} is closed"
+                )
+            if not self._closed.is_set():
+                return  # another caller already reconnected
+            # stale watch handles can never receive again; drop them (their
+            # sentinels were delivered by the dead reader)
+            self._watches.clear()
+            last: Exception | None = None
+            for attempt in range(self._reconnect_attempts):
+                try:
+                    self._connect()
+                    log.info("served-store reconnected to %s", self.address)
+                    return
+                except OSError as e:
+                    last = e
+                    time.sleep(self._reconnect_backoff * (2 ** attempt))
+            raise ConnectionError(
+                f"store at {self.address} unreachable after "
+                f"{self._reconnect_attempts} attempts: {last}"
+            )
+
+    def _read_loop(self, sock: socket.socket, closed: threading.Event) -> None:
         try:
-            f = self._sock.makefile("rb")
+            f = sock.makefile("rb")
             while True:
                 line = f.readline(_MAX_FRAME + 1)  # bounded (see _Conn)
                 if not line or len(line) > _MAX_FRAME or not line.endswith(b"\n"):
@@ -419,7 +469,7 @@ class RemoteStore:
         except (OSError, ValueError):
             pass
         finally:
-            self._closed.set()
+            closed.set()
             # unblock every caller and end every watch
             with self._pending_lock:
                 slots = list(self._pending.values())
@@ -440,30 +490,50 @@ class RemoteStore:
         w._deliver(ev)
 
     def _call(self, op: str, **args: Any) -> Any:
-        if self._closed.is_set():
-            raise ConnectionError(f"store connection to {self.address} is closed")
-        with self._pending_lock:
-            self._rid += 1
-            rid = self._rid
-            slot: dict[str, Any] = {"event": threading.Event(), "reply": None}
-            self._pending[rid] = slot
-        try:
-            frame = json.dumps({"id": rid, "op": op, "args": args}).encode() + b"\n"
-            with self._send_lock:
-                self._wfile.write(frame)
-                self._wfile.flush()
-            if not slot["event"].wait(self._timeout):
-                raise TimeoutError(f"store op {op!r} timed out after {self._timeout}s")
-            reply = slot["reply"]
-        finally:
+        # At-most-once with lazy reconnect: a dead connection is revived
+        # BEFORE sending, and a send that fails outright is retried once on
+        # a fresh connection (the op never reached the server). A reply
+        # lost MID-FLIGHT is NOT retried — the server may have executed the
+        # mutation, and a blind replay would turn e.g. create into a bogus
+        # AlreadyExists; the caller (level-triggered reconcilers) owns
+        # semantic recovery, and the next _call reconnects.
+        for attempt in (0, 1):
+            if self._closed.is_set():
+                self._reconnect()  # raises ConnectionError when hopeless
             with self._pending_lock:
-                self._pending.pop(rid, None)
-        if reply is None:
-            raise ConnectionError(f"store connection to {self.address} lost mid-{op}")
-        if "err" in reply:
-            exc = _ERRORS.get(reply["err"], RuntimeError)
-            raise exc(reply.get("msg", reply["err"]))
-        return reply.get("ok")
+                self._rid += 1
+                rid = self._rid
+                slot: dict[str, Any] = {"event": threading.Event(), "reply": None}
+                self._pending[rid] = slot
+            try:
+                frame = json.dumps({"id": rid, "op": op, "args": args}).encode() + b"\n"
+                try:
+                    with self._send_lock:
+                        self._wfile.write(frame)
+                        self._wfile.flush()
+                except OSError:
+                    self._closed.set()  # conn died at write; op NOT sent
+                    if attempt == 0 and not self._user_closed:
+                        continue
+                    raise ConnectionError(
+                        f"store connection to {self.address} is closed"
+                    )
+                if not slot["event"].wait(self._timeout):
+                    raise TimeoutError(
+                        f"store op {op!r} timed out after {self._timeout}s"
+                    )
+                reply = slot["reply"]
+            finally:
+                with self._pending_lock:
+                    self._pending.pop(rid, None)
+            if reply is None:
+                raise ConnectionError(
+                    f"store connection to {self.address} lost mid-{op}"
+                )
+            if "err" in reply:
+                exc = _ERRORS.get(reply["err"], RuntimeError)
+                raise exc(reply.get("msg", reply["err"]))
+            return reply.get("ok")
 
     # -- Store API -------------------------------------------------------
 
@@ -561,6 +631,7 @@ class RemoteStore:
         return self._call("ping") == "pong"
 
     def close(self) -> None:
+        self._user_closed = True
         self._closed.set()
         try:
             self._sock.shutdown(socket.SHUT_RDWR)
