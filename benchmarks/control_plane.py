@@ -5,10 +5,13 @@ kernel doesn't become the bottleneck the reference offloads to etcd).
 Runs entirely on CPU with a mock LLM: the measured path is watch -> workqueue
 -> reconciler -> CAS status write -> sqlite WAL commit.
 
-    python benchmarks/control_plane.py [--tasks 64] [--sync NORMAL|FULL]
+    python benchmarks/control_plane.py [--tasks 64] [--sync NORMAL|FULL] [--served]
 
 ``--sync FULL`` restores per-commit fsync (etcd-style durability) for an A/B
-against the default WAL+NORMAL group-commit behavior.
+against the default WAL+NORMAL group-commit behavior. ``--served`` runs the
+operator over a RemoteStore (unix socket to a StoreServer owning the sqlite
+file) — the multi-replica follower topology — so the socket hop's cost is
+measurable against the in-process baseline.
 """
 
 from __future__ import annotations
@@ -45,11 +48,19 @@ class CountingBackend(SqliteBackend):
         super().put(doc, rv)
 
 
-async def run(n_tasks: int, sync: str) -> dict:
+async def run(n_tasks: int, sync: str, served: bool = False) -> dict:
     tmp = tempfile.mkdtemp(prefix="acp-cpbench-")
     backend = CountingBackend(os.path.join(tmp, "state.db"))
     backend._conn.execute(f"PRAGMA synchronous={sync}")
-    store = Store(backend)
+    local = Store(backend)
+    server = None
+    if served:
+        from agentcontrolplane_tpu.kernel import StoreServer, RemoteStore
+
+        server = StoreServer(local, f"unix://{tmp}/store.sock").start()
+        store = RemoteStore(server.address)
+    else:
+        store = local
 
     # every request gets a one-turn answer (MockLLMClient falls back to its
     # default when the script is empty)
@@ -78,7 +89,10 @@ async def run(n_tasks: int, sync: str) -> dict:
     elapsed = time.monotonic() - t0
     writes = backend.puts - puts0
     await op.stop()
+    if server is not None:
+        server.stop()
     return {
+        "store": "served" if served else "in-process",
         "sync": sync,
         "tasks": n_tasks,
         "elapsed_s": round(elapsed, 3),
@@ -92,8 +106,9 @@ def main() -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--tasks", type=int, default=64)
     ap.add_argument("--sync", choices=["NORMAL", "FULL"], default="NORMAL")
+    ap.add_argument("--served", action="store_true")
     args = ap.parse_args()
-    print(json.dumps(asyncio.run(run(args.tasks, args.sync))), flush=True)
+    print(json.dumps(asyncio.run(run(args.tasks, args.sync, args.served))), flush=True)
 
 
 if __name__ == "__main__":
