@@ -124,6 +124,9 @@ class TPUProviderConfig(APIModel):
     # their within-page dim, keeping prefix-page sharing) — long
     # max_context without growing per-chip HBM
     context_parallelism: int = 1
+    # >1 shards MoE expert stacks over an 'ep' mesh axis (expert
+    # parallelism; Mixtral-family configs with n_experts > 0)
+    expert_parallelism: int = 1
     max_sequences: int = 64
     max_context: int = 8192
     page_size: int = 16

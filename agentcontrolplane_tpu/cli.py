@@ -57,10 +57,10 @@ def cmd_run(args) -> int:
             kv_layout=args.tpu_kv_layout,
             quantize=args.tpu_quantize,
         )
-        if args.tpu_tp or args.tpu_sp > 1:
+        if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:
             from .parallel.mesh import serving_mesh
 
-            kw["mesh"] = serving_mesh(args.tpu_tp, args.tpu_sp)
+            kw["mesh"] = serving_mesh(args.tpu_tp, args.tpu_sp, args.tpu_ep)
         if args.tpu_checkpoint:
             from .engine.weights import load_safetensors_dir
 
@@ -554,8 +554,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     run.add_argument(
         "--tpu-sp", type=int, default=1,
-        help="context parallelism: shard the KV cache's ctx dim over an "
-        "'sp' mesh axis (slot layout; --tpu-ctx must divide evenly)",
+        help="context parallelism: shard the KV cache's ctx dim (slot) or "
+        "within-page dim (paged) over an 'sp' mesh axis",
+    )
+    run.add_argument(
+        "--tpu-ep", type=int, default=1,
+        help="expert parallelism: shard MoE expert stacks over an 'ep' "
+        "mesh axis (Mixtral-family presets/checkpoints)",
     )
     run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
     run.add_argument("--tpu-quantize", choices=["int8"], default=None)

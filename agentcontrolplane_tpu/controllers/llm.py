@@ -97,6 +97,12 @@ class LLMReconciler:
                         f"engine mesh sp={shape.get('sp', 1)} != spec "
                         f"contextParallelism={want_sp} (set acp-tpu run --tpu-sp)"
                     )
+                want_ep = llm.spec.tpu.expert_parallelism
+                if want_ep > 1 and shape.get("ep", 1) != want_ep:
+                    raise Invalid(
+                        f"engine mesh ep={shape.get('ep', 1)} != spec "
+                        f"expertParallelism={want_ep} (set acp-tpu run --tpu-ep)"
+                    )
         return ""
 
     async def _probe(self, llm: LLM, api_key: str) -> None:

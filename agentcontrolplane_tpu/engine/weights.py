@@ -50,6 +50,9 @@ def config_from_hf(config_path: str) -> LlamaConfig:
     is_gemma = hf.get("model_type") == "gemma"
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     return LlamaConfig(
+        # Mixtral: routed experts replace the dense FFN
+        n_experts=int(hf.get("num_local_experts", 0) or 0),
+        experts_per_token=int(hf.get("num_experts_per_tok", 2) or 2),
         vocab_size=hf["vocab_size"],
         dim=hf["hidden_size"],
         n_layers=hf["num_hidden_layers"],
@@ -112,12 +115,28 @@ def params_from_state_dict(
     layer_map = dict(_LAYER_MAP)
     if c.qkv_bias:
         layer_map.update(_BIAS_MAP)
+    if c.n_experts > 0:
+        # Mixtral: the dense MLP keys are replaced by per-expert stacks
+        # (HF names the expert projections literally w1/w2/w3) + the router
+        for key in ("w1", "w2", "w3"):
+            layer_map.pop(key)
+        layer_map["router"] = "model.layers.{i}.block_sparse_moe.gate.weight"
+        layer_map.update({
+            key: "model.layers.{i}.block_sparse_moe.experts.{e}." + key + ".weight"
+            for key in ("w1", "w2", "w3")
+        })
     for key, pattern in layer_map.items():
         mats = []
         for i in range(c.n_layers):
-            m = get(pattern.format(i=i))
-            if key in _TRANSPOSED:
-                m = m.T  # HF stores [out, in]; we compute x @ W as [in, out]
+            if "{e}" in pattern:
+                # [E, in, out] expert stack for this layer
+                m = np.stack([
+                    get(pattern.format(i=i, e=e)).T for e in range(c.n_experts)
+                ])
+            else:
+                m = get(pattern.format(i=i))
+                if key in _TRANSPOSED or key == "router":
+                    m = m.T  # HF stores [out, in]; we compute x @ W
             mats.append(m)
         stacked = np.stack(mats)
         if lora is not None and key in lora[0]["layers"]:

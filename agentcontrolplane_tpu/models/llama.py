@@ -58,6 +58,16 @@ class LlamaConfig:
     norm_plus_one: bool = False  # gemma RMSNorm multiplies by (1 + weight)
     embed_scale: bool = False  # gemma scales embeddings by sqrt(dim)
     head_dim_override: Optional[int] = None  # gemma: head_dim != dim/n_heads
+    # Mixture-of-Experts (Mixtral architecture): n_experts > 0 replaces the
+    # dense FFN with top-k routed SwiGLU experts (ops/moe.py). The expert
+    # axis shards over the mesh's 'ep' axis (expert parallelism).
+    n_experts: int = 0
+    experts_per_token: int = 2
+    # GShard capacity factor: each expert accepts at most
+    # ceil(factor * tokens * k / E) tokens per dispatch; overflow falls back
+    # to the residual stream. 2.0 keeps drops negligible at serving batch
+    # sizes; tests use no-drop capacities.
+    expert_capacity_factor: float = 2.0
     dtype: Any = jnp.bfloat16
 
     @property
@@ -161,6 +171,34 @@ PRESETS: dict[str, LlamaConfig] = {
         embed_scale=True,
         head_dim_override=256,
     ),
+    # mistralai/Mixtral-8x7B(-Instruct): Mistral block + 8 top-2 experts
+    "mixtral-8x7b": LlamaConfig(
+        vocab_size=32000,
+        dim=4096,
+        n_layers=32,
+        n_heads=32,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        rope_theta=1000000.0,
+        max_seq_len=32768,
+        n_experts=8,
+        experts_per_token=2,
+    ),
+    # tiny MoE for CPU tests (4 experts, top-2)
+    "moe-tiny": LlamaConfig(
+        vocab_size=256,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        n_experts=4,
+        experts_per_token=2,
+        expert_capacity_factor=8.0,  # no drops: results batch-independent
+        dtype=jnp.float32,
+    ),
     # tiny config for CPU tests (matches an HF config in tests)
     "tiny": LlamaConfig(
         vocab_size=256,
@@ -199,6 +237,19 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         ).astype(c.dtype)
 
     scale = d**-0.5
+    if c.n_experts > 0:
+        ffn = {
+            "router": stacked((d, c.n_experts), scale),
+            "w1": stacked((c.n_experts, d, c.ffn_dim), scale),
+            "w3": stacked((c.n_experts, d, c.ffn_dim), scale),
+            "w2": stacked((c.n_experts, c.ffn_dim, d), c.ffn_dim**-0.5),
+        }
+    else:
+        ffn = {
+            "w1": stacked((d, c.ffn_dim), scale),  # gate_proj
+            "w3": stacked((d, c.ffn_dim), scale),  # up_proj
+            "w2": stacked((c.ffn_dim, d), c.ffn_dim**-0.5),  # down_proj
+        }
     params = {
         "embed": (jax.random.normal(k_embed, (c.vocab_size, d)) * scale).astype(c.dtype),
         "layers": {
@@ -208,9 +259,7 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
             "wk": stacked((d, c.n_kv_heads * hd), scale),
             "wv": stacked((d, c.n_kv_heads * hd), scale),
             "wo": stacked((c.n_heads * hd, d), scale),
-            "w1": stacked((d, c.ffn_dim), scale),  # gate_proj
-            "w3": stacked((d, c.ffn_dim), scale),  # up_proj
-            "w2": stacked((c.ffn_dim, d), c.ffn_dim**-0.5),  # down_proj
+            **ffn,
         },
         "norm": jnp.ones((d,), dtype=c.dtype),
     }
@@ -273,7 +322,23 @@ def _attn_mlp(
     attn = attn_fn(q, k, v)
     x = x + mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
     h = rms_norm(x, norm_w(layer["ln2"]), c.norm_eps)
-    x = x + mm(act(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
+    if c.n_experts > 0:
+        from ..ops.moe import expert_capacity, moe_ffn
+
+        cap = expert_capacity(
+            B * T, c.n_experts, c.experts_per_token, c.expert_capacity_factor
+        )
+        y = moe_ffn(
+            h.reshape(B * T, D),
+            layer["router"],
+            layer["w1"], layer["w3"], layer["w2"],
+            experts_per_token=c.experts_per_token,
+            capacity=cap,
+            act=act,
+        )
+        x = x + y.reshape(B, T, D)
+    else:
+        x = x + mm(act(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
     return x, k, v
 
 

@@ -1,0 +1,151 @@
+"""Mixture-of-Experts FFN — GShard-style dispatch/combine, expert-parallel.
+
+No reference analogue (the reference runs no models); this is the MoE leg
+of the ``provider: tpu`` data plane, Mixtral-architecture (per-layer top-k
+routed SwiGLU experts replacing the dense FFN).
+
+TPU-first formulation: routing is expressed as two einsums against one-hot
+dispatch/combine tensors (the Switch/GShard pattern) rather than
+gather/scatter —
+
+    dispatch [N, E, C] one-hot   x  tokens [N, D]   -> expert batches [E, C, D]
+    expert FFN over the leading E axis (one big batched matmul per proj)
+    combine  [N, E, C] weighted  x  outputs [E, C, D] -> tokens [N, D]
+
+Everything is static-shaped (capacity C bounds each expert's batch), MXU
+batched, and shards naturally: the expert axis E carries the mesh's 'ep'
+axis (each rank holds E/ep experts and computes their batches), the FFN
+hidden dim still carries 'tp' within each expert, and the combine einsum's
+contraction over E becomes a psum under GSPMD — no hand-written
+collectives, same design as the rest of the stack.
+
+Capacity semantics (standard GShard): each expert accepts at most
+``C = ceil(capacity_factor * N * k / E)`` tokens; a token that overflows
+every chosen expert's capacity contributes nothing from those experts (its
+combine weights are zero there) and the residual connection carries it —
+the usual "token dropping" behavior. Tests use a capacity factor high
+enough that nothing drops, making results batch-composition-independent.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _maybe_dequant(w, dtype):
+    from .quant import QuantizedTensor, dequantize
+
+    if isinstance(w, QuantizedTensor):
+        return dequantize(w, dtype)  # XLA fuses into the einsum operand load
+    return w
+
+
+def route_topk(
+    logits: jax.Array,  # [N, E] f32
+    k: int,
+) -> tuple[jax.Array, jax.Array]:
+    """Top-k expert choice per token -> (indices [N, k], weights [N, k]).
+    Weights are the softmax over the SELECTED logits (Mixtral renormalizes
+    over the top-k, not over all experts)."""
+    top_logits, top_idx = jax.lax.top_k(logits, k)
+    weights = jax.nn.softmax(top_logits, axis=-1)
+    return top_idx, weights
+
+
+def moe_ffn(
+    x: jax.Array,  # [N, D] tokens (flattened batch)
+    router_w: jax.Array,  # [D, E]
+    w1: jax.Array,  # [E, D, F] gate_proj per expert
+    w3: jax.Array,  # [E, D, F] up_proj
+    w2: jax.Array,  # [E, F, D] down_proj
+    experts_per_token: int,
+    capacity: int,
+    act=jax.nn.silu,
+) -> jax.Array:
+    """Routed FFN over flattened tokens; returns [N, D] in x.dtype."""
+    N, D = x.shape
+    E = router_w.shape[-1]
+    k = experts_per_token
+    C = capacity
+
+    logits = (x.astype(jnp.float32) @ _maybe_dequant(router_w, jnp.float32))
+    top_idx, top_w = route_topk(logits, k)  # [N, k], [N, k] f32
+
+    # position of each (token, choice) within its expert's capacity batch:
+    # flatten choices in (choice-major, token) order so lower-k choices win
+    # slots first, then cumsum one-hots per expert. [k, N] -> [k*N, E]
+    choice_onehot = jax.nn.one_hot(top_idx.T.reshape(-1), E, dtype=jnp.int32)
+    pos_in_expert = jnp.cumsum(choice_onehot, axis=0) * choice_onehot - 1  # [k*N, E]
+    pos = jnp.max(pos_in_expert, axis=-1)  # [k*N] (-1 only if onehot row is 0: never)
+    fits = pos < C
+
+    # dispatch/combine tensors [N, E, C]; overflowed choices vanish (zero
+    # rows) and the residual connection carries the token
+    kN_expert = top_idx.T.reshape(-1)  # [k*N]
+    token_of = jnp.tile(jnp.arange(N), k)  # [k*N]
+    weight_of = top_w.T.reshape(-1)  # [k*N] f32
+
+    dispatch = jnp.zeros((N, E, C), dtype=x.dtype)
+    clamped_pos = jnp.clip(pos, 0, C - 1)
+    dispatch = dispatch.at[token_of, kN_expert, clamped_pos].add(
+        fits.astype(x.dtype)
+    )
+    combine = jnp.zeros((N, E, C), dtype=jnp.float32)
+    combine = combine.at[token_of, kN_expert, clamped_pos].add(
+        jnp.where(fits, weight_of, 0.0)
+    )
+
+    # expert batches -> batched SwiGLU over the (ep-shardable) E axis
+    xe = jnp.einsum("nec,nd->ecd", dispatch, x)  # [E, C, D]
+    w1d = _maybe_dequant(w1, x.dtype)
+    w3d = _maybe_dequant(w3, x.dtype)
+    w2d = _maybe_dequant(w2, x.dtype)
+    h = act(jnp.einsum("ecd,edf->ecf", xe, w1d)) * jnp.einsum(
+        "ecd,edf->ecf", xe, w3d
+    )
+    ye = jnp.einsum("ecf,efd->ecd", h, w2d)  # [E, C, D]
+
+    # combine: contraction over (E, C) — under an 'ep' sharding this is the
+    # cross-expert psum GSPMD inserts
+    y = jnp.einsum("nec,ecd->nd", combine.astype(ye.dtype), ye)
+    return y.astype(x.dtype)
+
+
+def moe_ffn_reference(
+    x: jax.Array,  # [N, D]
+    router_w: jax.Array,
+    w1: jax.Array,
+    w3: jax.Array,
+    w2: jax.Array,
+    experts_per_token: int,
+    act=jax.nn.silu,
+) -> jax.Array:
+    """Exact per-token reference (no capacity, no dispatch tensors) — the
+    semantics ``moe_ffn`` must match whenever capacity doesn't bind."""
+    N, D = x.shape
+    logits = x.astype(jnp.float32) @ _maybe_dequant(router_w, jnp.float32)
+    top_idx, top_w = route_topk(logits, experts_per_token)
+    w1d = _maybe_dequant(w1, x.dtype)
+    w3d = _maybe_dequant(w3, x.dtype)
+    w2d = _maybe_dequant(w2, x.dtype)
+
+    def token(xi, idxs, ws):
+        out = jnp.zeros((D,), dtype=jnp.float32)
+        for j in range(experts_per_token):
+            e = idxs[j]
+            h = act(xi @ w1d[e]) * (xi @ w3d[e])
+            out = out + ws[j] * (h @ w2d[e]).astype(jnp.float32)
+        return out
+
+    y = jax.vmap(token)(x, top_idx, top_w)
+    return y.astype(x.dtype)
+
+
+def expert_capacity(
+    n_tokens: int, n_experts: int, experts_per_token: int, factor: float
+) -> int:
+    """GShard capacity rule, floored at 1 and at k (a single token must
+    always fit all of its own choices when N is tiny)."""
+    c = int(-(-factor * n_tokens * experts_per_token // n_experts))
+    return max(1, experts_per_token, c)

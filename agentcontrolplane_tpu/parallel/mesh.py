@@ -43,8 +43,13 @@ def make_mesh(
     return Mesh(grid, tuple(names))
 
 
-def serving_mesh(tensor_parallelism: int = 0, context_parallelism: int = 1) -> Mesh:
-    """Serving mesh: tp (heads/hidden) x optional sp (context parallelism).
+def serving_mesh(
+    tensor_parallelism: int = 0,
+    context_parallelism: int = 1,
+    expert_parallelism: int = 1,
+) -> Mesh:
+    """Serving mesh: tp (heads/hidden) x optional sp (context parallelism)
+    x optional ep (expert parallelism, MoE configs).
 
     With ``context_parallelism > 1`` the KV cache's ctx dimension shards
     over 'sp' (see :func:`kv_cache_specs`): each rank holds 1/sp of every
@@ -52,21 +57,32 @@ def serving_mesh(tensor_parallelism: int = 0, context_parallelism: int = 1) -> M
     flash partials merged by small all-reduces — XLA GSPMD emits that
     pattern from the sharding alone (no all-gather of the cache; pinned by
     tests/parallel/test_context_parallel_serving.py). This is how a long
-    max_ctx scales across chips without growing per-chip HBM."""
+    max_ctx scales across chips without growing per-chip HBM.
+
+    With ``expert_parallelism > 1`` (Mixtral-family, n_experts > 0) the
+    expert stacks shard over 'ep' (param_specs) — each rank holds E/ep
+    experts and computes their dispatch batches; the combine einsum's
+    contraction is the cross-expert psum."""
     sp = max(1, context_parallelism)
+    ep = max(1, expert_parallelism)
     n = len(jax.devices())
-    if n % sp:
+    if n % (sp * ep):
         raise ValueError(
-            f"context_parallelism={sp} must divide the device count ({n})"
+            f"context_parallelism={sp} x expert_parallelism={ep} must "
+            f"divide the device count ({n})"
         )
-    tp = tensor_parallelism or n // sp
+    tp = tensor_parallelism or n // (sp * ep)
     if tp < 1:
         raise ValueError(
-            f"no devices left for tp: {n} device(s) / sp={sp}"
+            f"no devices left for tp: {n} device(s) / sp={sp} / ep={ep}"
         )
+    axes: dict[str, int] = {}
+    if ep > 1:
+        axes["ep"] = ep
     if sp > 1:
-        return make_mesh({"sp": sp, "tp": tp})
-    return make_mesh({"tp": tp})
+        axes["sp"] = sp
+    axes["tp"] = tp
+    return make_mesh(axes)
 
 
 # ---------------------------------------------------------------------------
@@ -78,7 +94,23 @@ def param_specs(config: LlamaConfig) -> dict:
     """PartitionSpecs for the params pytree (megatron-style TP):
     attention qkv and ffn in-projections column-parallel, out-projections
     row-parallel; embeddings sharded on vocab. Layer-stacked leaves carry a
-    leading (unsharded) layer axis."""
+    leading (unsharded) layer axis. MoE configs (n_experts > 0) shard the
+    expert axis over 'ep' (expert parallelism) with TP inside each expert;
+    the router stays replicated (every rank routes every token — the
+    dispatch einsum's contraction over experts is the ep collective)."""
+    if config.n_experts > 0:
+        ffn = {
+            "router": P(None, None, None),
+            "w1": P(None, "ep", None, "tp"),
+            "w3": P(None, "ep", None, "tp"),
+            "w2": P(None, "ep", "tp", None),
+        }
+    else:
+        ffn = {
+            "w1": P(None, None, "tp"),
+            "w3": P(None, None, "tp"),
+            "w2": P(None, "tp", None),
+        }
     return {
         "embed": P("tp", None),  # vocab-sharded
         "norm": P(None),
@@ -92,12 +124,19 @@ def param_specs(config: LlamaConfig) -> dict:
             "wk": P(None, None, "tp"),
             "wv": P(None, None, "tp"),
             "wo": P(None, "tp", None),
-            "w1": P(None, None, "tp"),
-            "w3": P(None, None, "tp"),
-            "w2": P(None, "tp", None),
+            **ffn,
         },
         "lm_head": P(None, "tp"),  # vocab-sharded output
     }
+
+
+def _prune_spec_axes(spec: P, axis_names) -> P:
+    """Drop mesh axes the spec references but the mesh lacks (e.g. 'ep'
+    specs on a tp-only mesh) — the leaf is simply unsharded on that dim."""
+    return P(*[
+        a if (a is None or a in axis_names) else None
+        for a in spec
+    ])
 
 
 def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
@@ -110,7 +149,7 @@ def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
     if isinstance(layers_like, dict):
         specs["layers"] = {k: v for k, v in specs["layers"].items() if k in layers_like}
     return jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, spec),
+        lambda spec: NamedSharding(mesh, _prune_spec_axes(spec, mesh.axis_names)),
         specs,
         is_leaf=lambda x: isinstance(x, P),
     )

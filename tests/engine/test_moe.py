@@ -1,0 +1,306 @@
+"""Mixture-of-Experts FFN (ops/moe.py) + the Mixtral-architecture family.
+
+The GShard dispatch/combine formulation must match the exact per-token
+reference whenever capacity doesn't bind; expert parallelism ('ep' mesh
+axis) must be numerically transparent and must not all-gather the expert
+weights.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentcontrolplane_tpu.models.llama import PRESETS, forward, init_params
+from agentcontrolplane_tpu.ops.moe import (
+    expert_capacity,
+    moe_ffn,
+    moe_ffn_reference,
+    route_topk,
+)
+from agentcontrolplane_tpu.parallel.mesh import make_mesh, param_shardings
+
+MOE = PRESETS["moe-tiny"]
+
+
+def _weights(seed=0, E=4, D=64, F=128):
+    rng = np.random.default_rng(seed)
+    mk = lambda *shape: jnp.asarray(
+        rng.normal(size=shape) * 0.05, dtype=jnp.float32
+    )
+    return mk(D, E), mk(E, D, F), mk(E, D, F), mk(E, F, D)
+
+
+def test_route_topk_renormalizes_over_selection():
+    logits = jnp.asarray([[1.0, 3.0, 2.0, -1.0]])
+    idx, w = route_topk(logits, 2)
+    assert sorted(np.asarray(idx[0]).tolist()) == [1, 2]
+    np.testing.assert_allclose(float(jnp.sum(w)), 1.0, rtol=1e-6)
+    # softmax over the selected two logits only
+    expect = np.exp([3.0, 2.0]) / np.exp([3.0, 2.0]).sum()
+    np.testing.assert_allclose(np.sort(np.asarray(w[0]))[::-1], expect, rtol=1e-6)
+
+
+def test_moe_ffn_matches_per_token_reference():
+    router, w1, w3, w2 = _weights()
+    rng = np.random.default_rng(1)
+    x = jnp.asarray(rng.normal(size=(13, 64)), dtype=jnp.float32)
+    cap = expert_capacity(13, 4, 2, 8.0)  # generous: nothing drops
+    out = moe_ffn(x, router, w1, w3, w2, experts_per_token=2, capacity=cap)
+    ref = moe_ffn_reference(x, router, w1, w3, w2, experts_per_token=2)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
+
+
+def test_moe_capacity_overflow_drops_to_residual():
+    """With capacity 1 per expert, overflowed (token, expert) choices must
+    contribute ZERO (the residual carries the token) — never alias another
+    expert's slot."""
+    router, w1, w3, w2 = _weights(seed=2)
+    rng = np.random.default_rng(3)
+    x = jnp.asarray(rng.normal(size=(9, 64)), dtype=jnp.float32)
+    out = moe_ffn(x, router, w1, w3, w2, experts_per_token=2, capacity=2)
+    # bounded: every output row is a convex-ish combination of expert FFNs
+    # of x rows; a scatter aliasing bug produces garbage magnitudes
+    assert np.isfinite(np.asarray(out)).all()
+    full = moe_ffn(
+        x, router, w1, w3, w2, experts_per_token=2,
+        capacity=expert_capacity(9, 4, 2, 8.0),
+    )
+    # capacity-2 keeps the first-fitting choices; rows whose choices ALL fit
+    # match the uncapped result exactly — verify at least one row does and
+    # none exceed the uncapped magnitude wildly
+    matches = np.isclose(np.asarray(out), np.asarray(full), rtol=1e-5, atol=1e-5)
+    assert matches.all(axis=1).any()
+
+
+def test_forward_moe_tiny_finite_and_deterministic():
+    params = init_params(MOE, jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(0).integers(1, MOE.vocab_size, size=(2, 16)),
+        dtype=jnp.int32,
+    )
+    logits = forward(params, tokens, MOE)
+    assert logits.shape == (2, 16, MOE.vocab_size)
+    assert np.isfinite(np.asarray(logits)).all()
+    logits2 = forward(params, tokens, MOE)
+    np.testing.assert_array_equal(np.asarray(logits), np.asarray(logits2))
+
+
+def test_forward_moe_batch_independent_with_slack_capacity():
+    """moe-tiny's capacity factor leaves no drops, so a row's logits must
+    not depend on what else is in the batch (serving correctness: solo ==
+    batched)."""
+    params = init_params(MOE, jax.random.key(0))
+    rng = np.random.default_rng(1)
+    a = jnp.asarray(rng.integers(1, MOE.vocab_size, size=(1, 12)), dtype=jnp.int32)
+    b = jnp.asarray(rng.integers(1, MOE.vocab_size, size=(1, 12)), dtype=jnp.int32)
+    solo = forward(params, a, MOE)
+    batched = forward(params, jnp.concatenate([a, b]), MOE)
+    np.testing.assert_allclose(
+        np.asarray(solo[0]), np.asarray(batched[0]), rtol=2e-4, atol=2e-4
+    )
+
+
+def test_expert_parallel_forward_matches_replicated_no_weight_allgather():
+    """ep2 x tp2: expert-sharded forward == replicated forward, and the
+    compiled HLO contains no expert-weight-sized all-gather (each rank
+    computes only its own experts' batches)."""
+    import re
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    params = init_params(MOE, jax.random.key(0))
+    tokens = jnp.asarray(
+        np.random.default_rng(2).integers(1, MOE.vocab_size, size=(2, 16)),
+        dtype=jnp.int32,
+    )
+    ref = jax.jit(lambda p, t: forward(p, t, MOE))(params, tokens)
+
+    mesh = make_mesh({"ep": 2, "tp": 2}, devices=jax.devices()[:4])
+    p_sh = param_shardings(mesh, MOE, params)
+    rep = NamedSharding(mesh, P())
+    fn = jax.jit(
+        lambda p, t: forward(p, t, MOE),
+        in_shardings=(p_sh, rep),
+        out_shardings=rep,
+    )
+    params_ep = jax.device_put(params, p_sh)
+    compiled = fn.lower(params_ep, tokens).compile()
+    out = fn(params_ep, tokens)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-3, atol=2e-3)
+
+    # an expert stack is [L, E, D, F]; one layer's experts = E*D*F elements.
+    # Anything that size being all-gathered means GSPMD replicated the
+    # expert weights instead of dispatching tokens to them.
+    expert_elems = MOE.n_experts * MOE.dim * MOE.ffn_dim
+    for line in compiled.as_text().splitlines():
+        if "all-gather" not in line:
+            continue
+        dims = re.search(r"\[([0-9,]+)\]", line)
+        assert dims is not None, line
+        elems = int(np.prod([int(x) for x in dims.group(1).split(",")]))
+        assert elems < expert_elems // 2, f"expert-sized all-gather: {line.strip()[:160]}"
+
+
+def test_moe_serves_through_the_engine():
+    """The MoE family drops into the serving engine unchanged (the MLP swap
+    lives inside _attn_mlp): greedy generation, both KV layouts, identical
+    tokens."""
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg = dataclasses.replace(MOE, vocab_size=512)
+    outs = {}
+    for layout in ("slot", "paged"):
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+            max_slots=2,
+            max_ctx=64,
+            prefill_buckets=(32, 64),
+            decode_block_size=4,
+            kv_layout=layout,
+            page_size=8,
+            seed=0,
+        )
+        eng.start()
+        try:
+            outs[layout] = eng.generate(
+                "hello moe", SamplingParams(temperature=0.0, max_tokens=8)
+            ).tokens
+        finally:
+            eng.stop()
+    assert outs["slot"] == outs["paged"]
+    assert len(outs["slot"]) >= 1
+
+
+def test_mixtral_logits_match_hf():
+    """Weight mapping + MoE forward pinned against HF transformers'
+    MixtralForCausalLM on a tiny random checkpoint (the same exactness
+    contract as the llama/qwen/gemma families)."""
+    torch = pytest.importorskip("torch")
+    from transformers import MixtralConfig, MixtralForCausalLM
+
+    from agentcontrolplane_tpu.engine.weights import params_from_state_dict
+    from agentcontrolplane_tpu.models.llama import LlamaConfig
+
+    tiny = LlamaConfig(
+        vocab_size=256,
+        dim=64,
+        n_layers=2,
+        n_heads=4,
+        n_kv_heads=2,
+        ffn_dim=128,
+        max_seq_len=128,
+        rope_theta=10000.0,
+        n_experts=4,
+        experts_per_token=2,
+        expert_capacity_factor=8.0,  # no drops: HF routes without capacity
+        dtype=jnp.float32,
+    )
+    hf_config = MixtralConfig(
+        vocab_size=tiny.vocab_size,
+        hidden_size=tiny.dim,
+        num_hidden_layers=tiny.n_layers,
+        num_attention_heads=tiny.n_heads,
+        num_key_value_heads=tiny.n_kv_heads,
+        intermediate_size=tiny.ffn_dim,
+        num_local_experts=tiny.n_experts,
+        num_experts_per_tok=tiny.experts_per_token,
+        rms_norm_eps=tiny.norm_eps,
+        rope_theta=tiny.rope_theta,
+        max_position_embeddings=tiny.max_seq_len,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    model = MixtralForCausalLM(hf_config).eval()
+    params = params_from_state_dict(model.state_dict(), tiny)
+    assert params["layers"]["w1"].shape == (2, 4, 64, 128)
+    assert params["layers"]["router"].shape == (2, 64, 4)
+    tokens = np.random.default_rng(0).integers(0, tiny.vocab_size, size=(2, 13))
+    with __import__("torch").no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, dtype=jnp.int32), tiny))
+    np.testing.assert_allclose(ours, hf_logits, rtol=3e-4, atol=3e-4)
+
+
+def test_mixtral_config_from_hf(tmp_path):
+    import json
+
+    from agentcontrolplane_tpu.engine.weights import config_from_hf
+
+    cfg = {
+        "model_type": "mixtral",
+        "vocab_size": 32000,
+        "hidden_size": 4096,
+        "num_hidden_layers": 32,
+        "num_attention_heads": 32,
+        "num_key_value_heads": 8,
+        "intermediate_size": 14336,
+        "num_local_experts": 8,
+        "num_experts_per_tok": 2,
+        "rope_theta": 1000000.0,
+        "max_position_embeddings": 32768,
+    }
+    p = tmp_path / "config.json"
+    p.write_text(json.dumps(cfg))
+    c = config_from_hf(str(p))
+    assert c.n_experts == 8 and c.experts_per_token == 2
+    assert c.ffn_dim == 14336
+
+
+def test_moe_train_step_over_dp_ep_mesh():
+    """The trainer takes the MoE family unchanged: one dp2 x ep2 x tp2
+    train step produces a finite loss that matches the unsharded step."""
+    import optax
+
+    from agentcontrolplane_tpu.train.trainer import Trainer
+
+    cfg = dataclasses.replace(MOE, vocab_size=128)
+    batch = np.random.default_rng(0).integers(1, cfg.vocab_size, size=(4, 16))
+
+    def one_step(mesh_axes):
+        mesh = make_mesh(mesh_axes, devices=jax.devices()[: int(np.prod(list(mesh_axes.values())))])
+        tr = Trainer(config=cfg, mesh=mesh, optimizer=optax.adamw(1e-3))
+        params, opt = tr.init(jax.random.key(0))
+        tokens, mask = tr.shard_batch(batch)
+        _, _, loss = tr.train_step(params, opt, tokens, mask)
+        return float(loss)
+
+    sharded = one_step({"dp": 2, "ep": 2, "tp": 2})
+    base = one_step({"dp": 1, "tp": 1})
+    assert np.isfinite(sharded)
+    np.testing.assert_allclose(sharded, base, rtol=2e-3)
+
+
+def test_moe_serves_on_expert_parallel_mesh():
+    """Engine over serving_mesh(ep=2): greedy tokens identical to tp-only."""
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+
+    cfg = dataclasses.replace(MOE, vocab_size=512)
+
+    def run(mesh):
+        eng = Engine(
+            config=cfg, tokenizer=ByteTokenizer(), mesh=mesh,
+            max_slots=2, max_ctx=64, prefill_buckets=(32, 64),
+            decode_block_size=4, seed=0,
+        )
+        eng.start()
+        try:
+            return eng.generate(
+                "expert parallel", SamplingParams(temperature=0.0, max_tokens=8)
+            ).tokens
+        finally:
+            eng.stop()
+
+    ref = run(make_mesh({"tp": 2}, devices=jax.devices()[:2]))
+    ep = run(make_mesh({"ep": 2, "tp": 2}, devices=jax.devices()[:4]))
+    assert ep == ref and len(ref) >= 1
