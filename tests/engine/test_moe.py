@@ -304,3 +304,23 @@ def test_moe_serves_on_expert_parallel_mesh():
     ref = run(make_mesh({"tp": 2}, devices=jax.devices()[:2]))
     ep = run(make_mesh({"ep": 2, "tp": 2}, devices=jax.devices()[:4]))
     assert ep == ref and len(ref) >= 1
+
+
+def test_moe_int8_quantization():
+    """Weight-only int8 applies per expert stack ([L, E, D, F] tensors;
+    per-channel scales over the contraction dim) and moe_ffn dequantizes
+    transparently — outputs close to bf16."""
+    from agentcontrolplane_tpu.ops.quant import quantize
+
+    router, w1, w3, w2 = _weights(seed=5)
+    rng = np.random.default_rng(6)
+    x = jnp.asarray(rng.normal(size=(11, 64)), dtype=jnp.float32)
+    cap = expert_capacity(11, 4, 2, 8.0)
+    ref = moe_ffn(x, router, w1, w3, w2, experts_per_token=2, capacity=cap)
+    out = moe_ffn(
+        x, router, quantize(w1), quantize(w3), quantize(w2),
+        experts_per_token=2, capacity=cap,
+    )
+    assert quantize(w1).q.shape == (4, 64, 128)
+    assert quantize(w1).scale.shape == (4, 1, 128)  # per-channel over D
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.05)
