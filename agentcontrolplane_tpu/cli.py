@@ -37,6 +37,103 @@ def _client(args, timeout: float | None = 30.0):
     return httpx.Client(base_url=args.server, timeout=timeout, headers=headers)
 
 
+def _add_tpu_flags(p) -> None:
+    """Engine flags shared by `run` and `engine-follower` (multi-host ranks
+    must construct identical engines)."""
+    p.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
+    p.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
+    p.add_argument(
+        "--tpu-lora",
+        default=None,
+        help="LoRA adapter dir (train.lora.save_lora) merged into the checkpoint at load",
+    )
+    p.add_argument("--tpu-slots", type=int, default=64)
+    p.add_argument("--tpu-ctx", type=int, default=2048)
+    p.add_argument(
+        "--tpu-tp", type=int, default=0,
+        help="tensor parallelism (0 = all devices after --tpu-sp/--tpu-ep)",
+    )
+    p.add_argument(
+        "--tpu-sp", type=int, default=1,
+        help="context parallelism: shard the KV cache's ctx dim (slot) or "
+        "within-page dim (paged) over an 'sp' mesh axis",
+    )
+    p.add_argument(
+        "--tpu-ep", type=int, default=1,
+        help="expert parallelism: shard MoE expert stacks over an 'ep' "
+        "mesh axis (Mixtral-family presets/checkpoints)",
+    )
+    p.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
+    p.add_argument("--tpu-quantize", choices=["int8"], default=None)
+
+
+def _build_engine(args, coordination=None):
+    """Engine construction shared by `run` (leader/single-host) and
+    `engine-follower` — multi-host lockstep requires every rank to build
+    the IDENTICAL engine (same config/mesh/layout flags)."""
+    from .engine.engine import Engine
+    from .engine.tokenizer import ByteTokenizer, HFTokenizer
+
+    kw = dict(
+        max_slots=args.tpu_slots,
+        max_ctx=args.tpu_ctx,
+        kv_layout=args.tpu_kv_layout,
+        quantize=args.tpu_quantize,
+        coordination=coordination,
+    )
+    if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:
+        from .parallel.mesh import serving_mesh
+
+        kw["mesh"] = serving_mesh(args.tpu_tp, args.tpu_sp, args.tpu_ep)
+    if args.tpu_checkpoint:
+        from .engine.weights import load_safetensors_dir
+
+        # LoRA merge AND quantization both happen host-side at load, in
+        # that order — the bf16 (and unmerged) copy of a big model never
+        # reaches the device
+        params, config = load_safetensors_dir(
+            args.tpu_checkpoint,
+            quantize=args.tpu_quantize,
+            lora_path=args.tpu_lora,
+        )
+        if args.tpu_lora:
+            print(f"merged LoRA adapter from {args.tpu_lora}", flush=True)
+        tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
+        tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
+        return Engine(config=config, params=params, tokenizer=tokenizer, **kw)
+    return Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(), **kw)
+
+
+def cmd_engine_follower(args) -> int:
+    """A non-zero rank of a multi-host serving cluster: joins the
+    jax.distributed runtime, replays rank 0's admission frames, and serves
+    until the leader's stop frame. No control plane runs here."""
+    from .utils import setup_logging
+
+    setup_logging(os.environ.get("ACP_TPU_LOG_LEVEL", "INFO"))
+    from .engine.coordination import CoordinationFollower
+    from .parallel.distributed import initialize_distributed, runtime_info
+
+    initialize_distributed()
+    import jax as _jax
+
+    if _jax.process_count() > 1 and _jax.process_index() == 0:
+        print("error: rank 0 runs `acp-tpu run`, not engine-follower", file=sys.stderr)
+        return 2
+    coordination = CoordinationFollower(args.coordinator)
+    engine = _build_engine(args, coordination)
+    engine.start()
+    print(f"engine follower serving: {runtime_info()}", flush=True)
+    try:
+        engine._thread.join()  # until the leader's stop frame
+    except KeyboardInterrupt:
+        pass
+    finally:
+        engine.stop()
+        coordination.close()
+    return 0
+
+
 def cmd_run(args) -> int:
     from .operator import Operator, OperatorOptions
     from .utils import setup_logging
@@ -48,37 +145,31 @@ def cmd_run(args) -> int:
         return 2
     engine = None
     if args.tpu_preset or args.tpu_checkpoint:
-        from .engine.engine import Engine
-        from .engine.tokenizer import ByteTokenizer, HFTokenizer
+        # multi-host serving: join the jax.distributed cluster (env-driven
+        # no-op single-host); this leader process broadcasts admission
+        # frames to `acp-tpu engine-follower` processes on the other hosts
+        from .parallel.distributed import initialize_distributed
 
-        kw = dict(
-            max_slots=args.tpu_slots,
-            max_ctx=args.tpu_ctx,
-            kv_layout=args.tpu_kv_layout,
-            quantize=args.tpu_quantize,
-        )
-        if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:
-            from .parallel.mesh import serving_mesh
+        initialize_distributed()
+        import jax as _jax
 
-            kw["mesh"] = serving_mesh(args.tpu_tp, args.tpu_sp, args.tpu_ep)
-        if args.tpu_checkpoint:
-            from .engine.weights import load_safetensors_dir
+        coordination = None
+        if _jax.process_count() > 1:
+            from .engine.coordination import CoordinationLeader
 
-            # LoRA merge AND quantization both happen host-side at load, in
-            # that order — the bf16 (and unmerged) copy of a big model never
-            # reaches the device
-            params, config = load_safetensors_dir(
-                args.tpu_checkpoint,
-                quantize=args.tpu_quantize,
-                lora_path=args.tpu_lora,
+            if _jax.process_index() != 0:
+                print(
+                    "error: on multi-host ranks > 0 run `acp-tpu "
+                    "engine-follower`, not `run`", file=sys.stderr,
+                )
+                return 2
+            coordination = CoordinationLeader(
+                bind=os.environ.get("ACP_COORD_BIND", "0.0.0.0:8091")
             )
-            if args.tpu_lora:
-                print(f"merged LoRA adapter from {args.tpu_lora}", flush=True)
-            tok_path = os.path.join(args.tpu_checkpoint, "tokenizer.json")
-            tokenizer = HFTokenizer(tok_path) if os.path.exists(tok_path) else ByteTokenizer()
-            engine = Engine(config=config, params=params, tokenizer=tokenizer, **kw)
-        else:
-            engine = Engine(config=args.tpu_preset, tokenizer=ByteTokenizer(), **kw)
+            print(f"serving coordination on {coordination.address}; waiting for "
+                  f"{_jax.process_count() - 1} follower(s)", flush=True)
+            coordination.wait_for_followers(_jax.process_count() - 1)
+        engine = _build_engine(args, coordination)
         engine.start()
         if args.tpu_prewarm:
             # background: the REST API comes up immediately; early requests
@@ -539,31 +630,7 @@ def build_parser() -> argparse.ArgumentParser:
         "--tls-client-ca", default=os.environ.get("ACP_TLS_CLIENT_CA") or None,
         help="require client certificates signed by this CA (mTLS)",
     )
-    run.add_argument("--tpu-preset", default=None, help="serve a model preset on TPU")
-    run.add_argument("--tpu-checkpoint", default=None, help="HF checkpoint dir to serve")
-    run.add_argument(
-        "--tpu-lora",
-        default=None,
-        help="LoRA adapter dir (train.lora.save_lora) merged into the checkpoint at load",
-    )
-    run.add_argument("--tpu-slots", type=int, default=64)
-    run.add_argument("--tpu-ctx", type=int, default=2048)
-    run.add_argument(
-        "--tpu-tp", type=int, default=0,
-        help="tensor parallelism (0 = all devices after --tpu-sp)",
-    )
-    run.add_argument(
-        "--tpu-sp", type=int, default=1,
-        help="context parallelism: shard the KV cache's ctx dim (slot) or "
-        "within-page dim (paged) over an 'sp' mesh axis",
-    )
-    run.add_argument(
-        "--tpu-ep", type=int, default=1,
-        help="expert parallelism: shard MoE expert stacks over an 'ep' "
-        "mesh axis (Mixtral-family presets/checkpoints)",
-    )
-    run.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
-    run.add_argument("--tpu-quantize", choices=["int8"], default=None)
+    _add_tpu_flags(run)
     run.add_argument(
         "--tpu-prewarm",
         action=argparse.BooleanOptionalAction,
@@ -571,6 +638,18 @@ def build_parser() -> argparse.ArgumentParser:
         help="compile serving programs in the background at startup",
     )
     run.set_defaults(fn=cmd_run)
+
+    fol = sub.add_parser(
+        "engine-follower",
+        help="multi-host serving: a rank>0 engine that replays rank 0's "
+        "admission frames (pass the SAME --tpu-* flags as rank 0's run)",
+    )
+    fol.add_argument(
+        "--coordinator", required=True, metavar="HOST:PORT",
+        help="rank 0's serving-coordination address (printed by `run`)",
+    )
+    _add_tpu_flags(fol)
+    fol.set_defaults(fn=cmd_engine_follower)
 
     ap = sub.add_parser("apply", help="apply manifests")
     ap.add_argument("-f", "--filename", required=True)

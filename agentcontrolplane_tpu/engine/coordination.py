@@ -1,0 +1,214 @@
+"""Multi-host serving coordination — the admission broadcast channel.
+
+Multi-host SPMD serving needs every process to run the engine loop in
+lockstep: each decode/prefill dispatch is a GLOBAL program over the shared
+mesh, so every host must make IDENTICAL host-side decisions (which
+requests join which slots, in which order, with which cancellations). All
+of those decisions are deterministic functions of the request stream — so
+coordination reduces to replicating that stream.
+
+Rank 0 (the leader — the process attached to the control plane) drains its
+local submit queue once per engine-loop iteration and publishes a FRAME:
+
+    {"seq": i, "reqs": [serialized requests...], "cancels": [rids...],
+     "stop": false}
+
+Followers block for frame i, enqueue the same requests into their local
+engine (dummy futures; results are discarded — every host computes the
+same tokens, only the leader's futures have consumers), and the shared
+deterministic admission logic (strict FIFO + identical pool state) does
+the rest. The tensors themselves never touch this channel — they ride
+ICI/DCN inside XLA collectives; this socket carries a few hundred bytes of
+token ids per admission.
+
+Lockstep is self-pacing: the leader cannot complete dispatch i until every
+follower joins the same global program, so followers can never fall
+unboundedly behind the frame stream.
+
+Transport: length-prefixed JSON over TCP (leader binds, followers
+connect) — host-network traffic, like jax.distributed's own gRPC
+coordinator. A follower that cannot produce the next frame within
+``recv_timeout`` treats the cluster as dead and crashes its engine (the
+global dispatch would hang anyway).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import logging
+import socket
+import struct
+import threading
+from typing import Any, Optional
+
+log = logging.getLogger("acp_tpu.engine.coordination")
+
+_LEN = struct.Struct("!I")
+_MAX_FRAME = 64 * 1024 * 1024
+
+
+def serialize_request(req) -> dict[str, Any]:
+    """_Request -> wire dict (tokens + sampling; futures/callbacks stay
+    host-local)."""
+    return {
+        "rid": req.rid,
+        "prompt": list(req.prompt),
+        "sampling": dataclasses.asdict(req.sampling),
+        "truncated": bool(req.truncated),
+    }
+
+
+def deserialize_request(doc: dict[str, Any]):
+    from concurrent.futures import Future
+
+    from .engine import SamplingParams, _Request
+
+    s = dict(doc["sampling"])
+    s["forced_prefix"] = tuple(s.get("forced_prefix") or ())
+    return _Request(
+        rid=doc["rid"],
+        prompt=list(doc["prompt"]),
+        sampling=SamplingParams(**s),
+        future=Future(),  # no consumer on followers
+        truncated=bool(doc["truncated"]),
+    )
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("coordination peer closed")
+        buf += chunk
+    return buf
+
+
+class CoordinationLeader:
+    """Rank 0's side: accepts follower connections and publishes frames."""
+
+    def __init__(self, bind: str = "0.0.0.0:0", expected_followers: int = 0):
+        host, _, port = bind.rpartition(":")
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host or "0.0.0.0", int(port or 0)))
+        self._sock.listen(64)
+        self.address = "%s:%d" % self._sock.getsockname()[:2]
+        self._followers: list[socket.socket] = []
+        self._lock = threading.Lock()
+        self._seq = 0
+        self._stopped = False
+        self._accept_thread = threading.Thread(target=self._accept_loop, daemon=True)
+        self._accept_thread.start()
+        self._expected = expected_followers
+
+    def _accept_loop(self) -> None:
+        while not self._stopped:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            with self._lock:
+                self._followers.append(conn)
+            log.info("coordination follower joined (%d)", len(self._followers))
+
+    def wait_for_followers(self, n: int, timeout: float = 120.0) -> None:
+        import time
+
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if len(self._followers) >= n:
+                    return
+            time.sleep(0.02)
+        raise TimeoutError(f"only {len(self._followers)}/{n} followers joined")
+
+    def publish(self, reqs: list, cancels: list[str], stop: bool = False) -> int:
+        """Broadcast one frame; returns its seq. Dead followers are dropped
+        (their absence from the next global dispatch is the real failure)."""
+        with self._lock:
+            frame = {
+                "seq": self._seq,
+                "reqs": [serialize_request(r) for r in reqs],
+                "cancels": sorted(cancels),
+                "stop": stop,
+            }
+            payload = json.dumps(frame).encode()
+            dead = []
+            for conn in self._followers:
+                try:
+                    _send_frame(conn, payload)
+                except OSError:
+                    dead.append(conn)
+            for conn in dead:
+                self._followers.remove(conn)
+                log.warning("coordination follower dropped")
+            self._seq += 1
+            return frame["seq"]
+
+    def close(self) -> None:
+        self._stopped = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+        with self._lock:
+            for conn in self._followers:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._followers.clear()
+
+
+class CoordinationFollower:
+    """A non-zero rank's side: receives the frame stream in order."""
+
+    def __init__(self, address: str, connect_timeout: float = 120.0,
+                 recv_timeout: float = 600.0):
+        import time
+
+        host, _, port = address.rpartition(":")
+        deadline = time.monotonic() + connect_timeout
+        while True:
+            # retry until the leader binds (process startup order is
+            # arbitrary — jax.distributed init finishes on all ranks before
+            # rank 0 reaches its leader-socket setup only by luck)
+            try:
+                self._sock = socket.create_connection(
+                    (host, int(port)), timeout=max(1.0, deadline - time.monotonic())
+                )
+                break
+            except OSError:
+                if time.monotonic() >= deadline:
+                    raise
+                time.sleep(0.1)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock.settimeout(recv_timeout)
+        self._next_seq = 0
+
+    def recv(self) -> dict[str, Any]:
+        """Block for the next frame (ordered; raises on timeout/close)."""
+        n = _LEN.unpack(_recv_exact(self._sock, _LEN.size))[0]
+        if n > _MAX_FRAME:
+            raise ConnectionError(f"coordination frame too large ({n} bytes)")
+        frame = json.loads(_recv_exact(self._sock, n))
+        if frame["seq"] != self._next_seq:
+            raise ConnectionError(
+                f"coordination frame out of order: got {frame['seq']}, "
+                f"want {self._next_seq}"
+            )
+        self._next_seq += 1
+        return frame
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -176,10 +176,18 @@ class Engine:
         page_lookahead_blocks: int = 8,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
+        # Multi-host lockstep serving (engine/coordination.py): rank 0
+        # passes a CoordinationLeader (it drains the submit queue and
+        # broadcasts per-iteration admission frames); other ranks pass a
+        # CoordinationFollower (they replay the frame stream — their
+        # submit() is disabled). None = single-host (the default).
+        coordination: Optional[object] = None,
     ):
         from ..xla_cache import enable_persistent_compilation_cache
 
         enable_persistent_compilation_cache()
+        self._coordination = coordination
+        self._coord_follower = coordination is not None and hasattr(coordination, "recv")
         self.decode_block_size = max(1, decode_block_size)
         if kv_layout not in ("slot", "paged"):
             raise ValueError(f"kv_layout must be 'slot' or 'paged', got {kv_layout!r}")
@@ -318,7 +326,11 @@ class Engine:
                 )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
-        self._rng = jax.device_put(jax.random.key(seed), self._replicated)
+        # computed ON device (jit + out_shardings) rather than device_put so
+        # the replicated key is valid under multihost meshes too
+        self._rng = jax.jit(
+            lambda: jax.random.key(seed), out_shardings=self._replicated
+        )()
         self._queue: "queue.Queue[Optional[_Request]]" = queue.Queue()
         # admission order is strict FIFO: requests the pool can't fit yet
         # stay at the head of this deque (no starvation of large requests)
@@ -398,6 +410,14 @@ class Engine:
         self._build_jitted()
 
     def _put(self, x) -> jax.Array:
+        if jax.process_count() > 1:
+            # multihost: device_put cannot target non-addressable devices;
+            # every process supplies its local shards of the same replicated
+            # value (the coordination layer guarantees the values match)
+            arr = np.asarray(x)
+            return jax.make_array_from_callback(
+                arr.shape, self._replicated, lambda idx: arr[idx]
+            )
         return jax.device_put(x, self._replicated)
 
     # -- jitted programs -------------------------------------------------
@@ -600,6 +620,10 @@ class Engine:
                 return
             self._stopping = True
             self._queue.put(None)
+            if self._coord_follower:
+                # the loop may be parked in recv(); closing the channel
+                # unblocks it, and _admit treats it as a clean stop
+                self._coordination.close()
             self._thread.join(timeout=30)
             self._thread = None
 
@@ -676,6 +700,14 @@ class Engine:
             on_tokens=on_tokens,
             truncated=truncated,
         )
+        if self._coord_follower:
+            # any locally-originated request (prewarm included) would break
+            # lockstep — followers only replay the leader's frame stream
+            req.future.set_exception(RuntimeError(
+                "coordinated follower engines do not accept submissions "
+                "(submit through rank 0's engine)"
+            ))
+            return req.future
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
             return req.future
@@ -962,19 +994,53 @@ class Engine:
 
     def _admit(self, block: bool) -> bool:
         """Move queued requests into free slots (prefill), strictly FIFO.
-        Returns True if anything was admitted."""
-        # drain the cross-thread queue into the ordered waiting deque
+        Returns True if anything was admitted.
+
+        Multi-host lockstep: the request stream is the ONLY nondeterministic
+        input to admission, so the leader broadcasts each iteration's drained
+        requests + cancel snapshot as a frame and followers replay it — every
+        process then runs the identical pure admission logic and joins the
+        identical global dispatches (see engine/coordination.py)."""
         may_block = block and not self._waiting and not self._slots
-        while True:
+        if self._coord_follower:
             try:
-                req = self._queue.get(timeout=0.05) if may_block else self._queue.get_nowait()
-            except queue.Empty:
-                break
-            may_block = False
-            if req is None:
+                frame = self._coordination.recv()
+            except (ConnectionError, OSError) as e:
+                if self._stopping:  # local stop() closed the channel
+                    return False
+                raise RuntimeError(f"serving coordination channel lost: {e}")
+            if frame["stop"]:
                 self._stopping = True
                 return False
-            self._waiting.append(req)
+            from .coordination import deserialize_request
+
+            for doc in frame["reqs"]:
+                self._waiting.append(deserialize_request(doc))
+            self._cancelled.update(frame["cancels"])
+        else:
+            # drain the cross-thread queue into the ordered waiting deque
+            drained: list[_Request] = []
+            saw_stop = False
+            while True:
+                try:
+                    req = self._queue.get(timeout=0.05) if may_block else self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                may_block = False
+                if req is None:
+                    saw_stop = True
+                    break
+                drained.append(req)
+            if self._coordination is not None:
+                # leader: publish BEFORE applying, so a crash between the
+                # two can only lose work symmetrically (followers time out)
+                self._coordination.publish(
+                    drained, sorted(self._cancelled), stop=saw_stop
+                )
+            if saw_stop:
+                self._stopping = True
+                return False
+            self._waiting.extend(drained)
 
         if self._cancelled and self._waiting:
             kept = type(self._waiting)()

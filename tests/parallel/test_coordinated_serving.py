@@ -1,0 +1,181 @@
+"""Coordinated multi-host serving (engine/coordination.py).
+
+Two layers of proof:
+1. Protocol determinism in ONE process: a follower engine replaying the
+   leader's frame stream generates exactly the same tokens (admission is a
+   pure function of the replicated request stream).
+2. REAL 2-OS-process SPMD: two jax.distributed processes form one global
+   tp=4 mesh; rank 0's leader engine and rank 1's follower engine join the
+   SAME global dispatches in lockstep, and rank 0's greedy tokens match a
+   single-process run of the same global computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.coordination import (
+    CoordinationFollower,
+    CoordinationLeader,
+    deserialize_request,
+    serialize_request,
+)
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+SERVE_WORKER = os.path.join(os.path.dirname(__file__), "mp_serve_worker.py")
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+TINY = dataclasses.replace(PRESETS["tiny"], vocab_size=512)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_request_serialization_round_trip():
+    from concurrent.futures import Future
+
+    from agentcontrolplane_tpu.engine.engine import _Request
+
+    req = _Request(
+        rid="abc123",
+        prompt=[1, 2, 3],
+        sampling=SamplingParams(
+            temperature=0.5, top_k=4, max_tokens=7, json_only=True,
+            forced_prefix=(9, 8),
+        ),
+        future=Future(),
+        truncated=True,
+    )
+    out = deserialize_request(json.loads(json.dumps(serialize_request(req))))
+    assert out.rid == req.rid and out.prompt == req.prompt
+    assert out.sampling == req.sampling
+    assert out.truncated is True
+
+
+def _engine(mesh, coordination=None):
+    return Engine(
+        config=TINY,
+        tokenizer=ByteTokenizer(),
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        prefix_cache_entries=0,
+        seed=0,
+        coordination=coordination,
+    )
+
+
+def test_follower_replays_leader_stream_identically():
+    """One process, two engines: the follower consumes only the frame
+    stream, yet generates the same token count and drains to idle — the
+    decisions are fully determined by the frames."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    leader_chan = CoordinationLeader(bind="127.0.0.1:0")
+    leader = _engine(mesh, coordination=leader_chan)
+    follower = _engine(mesh, coordination=CoordinationFollower(leader_chan.address))
+    leader_chan.wait_for_followers(1, timeout=30.0)
+    leader.start()
+    follower.start()
+    try:
+        futs = [
+            leader.submit("prompt %d" % i, SamplingParams(temperature=0.0, max_tokens=6))
+            for i in range(3)
+        ]
+        results = [f.result(timeout=300) for f in futs]
+        total = sum(len(r.tokens) for r in results)
+        assert total > 0
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (
+                follower.tokens_generated == leader.tokens_generated
+                and follower.stats()["active_slots"] == 0
+            ):
+                break
+            time.sleep(0.05)
+        assert follower.tokens_generated == leader.tokens_generated
+        assert follower.stats()["waiting"] == 0
+    finally:
+        leader.stop()  # publishes the stop frame; follower loop ends with it
+        follower.stop()
+        leader_chan.close()
+
+
+def test_follower_rejects_local_submissions():
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    leader_chan = CoordinationLeader(bind="127.0.0.1:0")
+    follower = _engine(mesh, coordination=CoordinationFollower(leader_chan.address))
+    follower.start()
+    try:
+        fut = follower.submit("nope", SamplingParams(max_tokens=2))
+        with pytest.raises(RuntimeError, match="rank 0"):
+            fut.result(timeout=10)
+    finally:
+        follower.stop()
+        leader_chan.close()
+
+
+def _spawn(pid: int, nproc: int, jax_port: int, coord_port: int) -> subprocess.Popen:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    env.pop("JAX_PLATFORMS", None)  # worker forces cpu via jax.config
+    return subprocess.Popen(
+        [sys.executable, SERVE_WORKER, str(pid), str(nproc), str(jax_port), str(coord_port)],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        env=env,
+        text=True,
+    )
+
+
+def test_two_process_coordinated_serving_matches_single_process():
+    jax_port, coord_port = _free_port(), _free_port()
+    procs = [_spawn(i, 2, jax_port, coord_port) for i in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        assert p.returncode == 0, f"serve worker failed:\n{err[-3000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+
+    assert outs[1] == {"follower": "done"}
+    two_proc_tokens = outs[0]["tokens"]
+    assert all(len(t) > 0 for t in two_proc_tokens)
+
+    # single-process reference: same GLOBAL computation (tp=4? no — the
+    # 2-proc mesh is tp=4 over 4 devices; replicate with 4 local devices)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+    env.pop("JAX_PLATFORMS", None)
+    ref = subprocess.run(
+        [sys.executable, SERVE_WORKER, "0", "1", "0", "0"],
+        capture_output=True,
+        text=True,
+        timeout=420,
+        env=env,
+    )
+    assert ref.returncode == 0, f"reference worker failed:\n{ref.stderr[-3000:]}"
+    ref_tokens = json.loads(ref.stdout.strip().splitlines()[-1])["tokens"]
+    assert two_proc_tokens == ref_tokens
