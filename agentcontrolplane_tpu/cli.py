@@ -166,7 +166,12 @@ def cmd_run(args) -> int:
             coordination = CoordinationLeader(
                 bind=os.environ.get("ACP_COORD_BIND", "0.0.0.0:8091")
             )
-            print(f"serving coordination on {coordination.address}; waiting for "
+            # a wildcard bind is not a routable --coordinator target;
+            # print this host's name in its place
+            import socket as _socket
+
+            shown = coordination.address.replace("0.0.0.0", _socket.getfqdn())
+            print(f"serving coordination on {shown}; waiting for "
                   f"{_jax.process_count() - 1} follower(s)", flush=True)
             coordination.wait_for_followers(_jax.process_count() - 1)
         engine = _build_engine(args, coordination)

@@ -129,15 +129,21 @@ class CoordinationLeader:
             time.sleep(0.02)
         raise TimeoutError(f"only {len(self._followers)}/{n} followers joined")
 
-    def publish(self, reqs: list, cancels: list[str], stop: bool = False) -> int:
+    def publish(
+        self, reqs: list, cancels: list[str], stop: bool = False,
+        hold: bool = False,
+    ) -> int:
         """Broadcast one frame; returns its seq. Dead followers are dropped
-        (their absence from the next global dispatch is the real failure)."""
+        (their absence from the next global dispatch is the real failure).
+        ``hold`` replicates the leader's admission hold (prewarm batch
+        formation) so followers skip slot-filling the same iterations."""
         with self._lock:
             frame = {
                 "seq": self._seq,
                 "reqs": [serialize_request(r) for r in reqs],
                 "cancels": sorted(cancels),
                 "stop": stop,
+                "hold": hold,
             }
             payload = json.dumps(frame).encode()
             dead = []

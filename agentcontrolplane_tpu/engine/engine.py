@@ -395,6 +395,13 @@ class Engine:
         # slots are released at the next engine-loop iteration so orphaned
         # generations don't pin capacity to max_tokens
         self._cancelled: set[str] = set()
+        # the cancel set the ENGINE LOOP consumes. Single-host it is the
+        # same object as _cancelled; under coordination it holds only
+        # rids that have been replicated through the frame stream, so every
+        # rank applies cancels at the same iteration (lockstep).
+        self._applied_cancels: set[str] = (
+            self._cancelled if coordination is None else set()
+        )
         self._admission_held = 0  # hold depth; see hold_admission()
         self._admission_lock = threading.Lock()  # guards the depth counter
         # device-resident decode state (see _decode_once): None until the
@@ -656,6 +663,7 @@ class Engine:
             self._free = list(range(self.max_slots))
             self._waiting.clear()
             self._cancelled.clear()
+            self._applied_cancels.clear()
             self._seq_lens[:] = 0
             self._last_tokens[:] = 0
             self._con_states[:] = 0
@@ -1016,7 +1024,8 @@ class Engine:
 
             for doc in frame["reqs"]:
                 self._waiting.append(deserialize_request(doc))
-            self._cancelled.update(frame["cancels"])
+            self._applied_cancels.update(frame["cancels"])
+            held = bool(frame.get("hold"))
         else:
             # drain the cross-thread queue into the ordered waiting deque
             drained: list[_Request] = []
@@ -1031,40 +1040,71 @@ class Engine:
                     saw_stop = True
                     break
                 drained.append(req)
+            # the hold state is read ONCE and drives both the frame and the
+            # local decision — a live re-read below could release between
+            # publish and fill, desynchronizing ranks
+            held = bool(self._admission_held)
             if self._coordination is not None:
-                # leader: publish BEFORE applying, so a crash between the
-                # two can only lose work symmetrically (followers time out)
-                self._coordination.publish(
-                    drained, sorted(self._cancelled), stop=saw_stop
+                # leader: only cancels whose requests are already part of
+                # the replicated stream may be published — a cancel racing
+                # its own still-in-transit request would be pruned by
+                # followers before the request arrives, then admitted there
+                # but cancelled here. Unpublishable cancels wait in
+                # _cancelled for a later frame; truly stale rids (request
+                # already finished) are pruned against the in-transit queue.
+                published_live = {r.rid for r in self._waiting}
+                published_live.update(
+                    sl.request.rid for sl in self._slots.values()
                 )
+                published_live.update(r.rid for r in drained)
+                pending = {r for r in self._cancelled if r in published_live}
+                self._cancelled.difference_update(pending)
+                with self._queue.mutex:
+                    transit = {
+                        r.rid for r in self._queue.queue if r is not None
+                    }
+                self._cancelled &= transit
+                # publish BEFORE applying, so a crash between the two can
+                # only lose work symmetrically (followers time out)
+                self._coordination.publish(
+                    drained, sorted(pending), stop=saw_stop, hold=held
+                )
+                self._applied_cancels.update(pending)
             if saw_stop:
                 self._stopping = True
+                # hand the drained-but-never-admitted requests to the
+                # shutdown drain so their futures fail instead of hanging
+                self._waiting.extend(drained)
                 return False
             self._waiting.extend(drained)
 
-        if self._cancelled and self._waiting:
+        if self._applied_cancels and self._waiting:
             kept = type(self._waiting)()
             while self._waiting:
                 r = self._waiting.popleft()
-                if r.rid in self._cancelled:
-                    self._cancelled.discard(r.rid)
+                if r.rid in self._applied_cancels:
+                    self._applied_cancels.discard(r.rid)
                     r.future.cancel()
                 else:
                     kept.append(r)
             self._waiting = kept
-        if self._cancelled:
+        if self._applied_cancels:
             # purge rids that raced _finish (request already completed): a
             # stale rid could collide with a future request's rid. A rid is
-            # live if its request is waiting, active, OR still in transit in
-            # the cross-thread queue (peeked under the queue mutex — without
-            # this, a submit-then-cancel racing the drain loses the cancel)
+            # live if its request is waiting or active — plus, single-host
+            # only, still in transit in the cross-thread queue (peeked under
+            # the queue mutex; without this a submit-then-cancel racing the
+            # drain loses the cancel). Under coordination in-transit rids
+            # are never in _applied_cancels, so the liveness rule is
+            # identical on every rank.
             live = {r.rid for r in self._waiting}
             live.update(sl.request.rid for sl in self._slots.values())
-            with self._queue.mutex:
-                live.update(r.rid for r in self._queue.queue if r is not None)
-            self._cancelled &= live
+            if self._coordination is None:
+                with self._queue.mutex:
+                    live.update(r.rid for r in self._queue.queue if r is not None)
+            self._applied_cancels &= live
 
-        if self._admission_held:
+        if held:
             if not self._slots:
                 # idle hold: don't busy-spin against the submitting thread
                 time.sleep(0.002)
@@ -1679,9 +1719,9 @@ class Engine:
         self._tables_dirty = True
 
     def _decode_once(self) -> None:
-        if self._cancelled:
+        if self._applied_cancels:
             for slot, sl in list(self._slots.items()):
-                if sl.request.rid in self._cancelled:
+                if sl.request.rid in self._applied_cancels:
                     self._finish(slot, "cancelled")
         if not self._slots:
             return
@@ -1810,6 +1850,7 @@ class Engine:
         sl = self._slots.pop(slot)
         self._state_dirty = True  # device lane must be re-uploaded inactive
         self._cancelled.discard(sl.request.rid)
+        self._applied_cancels.discard(sl.request.rid)
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._con_states[slot] = 0

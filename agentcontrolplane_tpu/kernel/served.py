@@ -395,6 +395,11 @@ class RemoteStore:
         self._wid = 0  # client-assigned watch ids (see watch())
         self._watches: dict[int, _RemoteWatch] = {}
         self._user_closed = False
+        # connection epoch: pending RPC slots and watches are stamped with
+        # the epoch of the connection that carries them, so a DYING reader
+        # thread's cleanup can never fail slots/watches that belong to a
+        # newer connection created by a concurrent _reconnect
+        self._conn_epoch = 0
         self._connect()
 
     # -- plumbing --------------------------------------------------------
@@ -411,11 +416,14 @@ class RemoteStore:
             sock = socket.create_connection(target, timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.settimeout(None)  # reader thread blocks; per-op timeout below
-        self._sock = sock
-        self._wfile = sock.makefile("wb")
+        with self._send_lock:
+            self._conn_epoch += 1
+            epoch = self._conn_epoch
+            self._sock = sock
+            self._wfile = sock.makefile("wb")
         self._closed = threading.Event()
         self._reader = threading.Thread(
-            target=self._read_loop, args=(sock, self._closed), daemon=True
+            target=self._read_loop, args=(sock, self._closed, epoch), daemon=True
         )
         self._reader.start()
 
@@ -449,7 +457,9 @@ class RemoteStore:
                 f"{self._reconnect_attempts} attempts: {last}"
             )
 
-    def _read_loop(self, sock: socket.socket, closed: threading.Event) -> None:
+    def _read_loop(
+        self, sock: socket.socket, closed: threading.Event, epoch: int
+    ) -> None:
         try:
             f = sock.makefile("rb")
             while True:
@@ -470,13 +480,16 @@ class RemoteStore:
             pass
         finally:
             closed.set()
-            # unblock every caller and end every watch
+            # unblock every caller whose request rode THIS connection and
+            # end THIS connection's watches — never a newer connection's
             with self._pending_lock:
                 slots = list(self._pending.values())
             for slot in slots:
-                slot["event"].set()
+                if slot.get("epoch") == epoch:
+                    slot["event"].set()
             for w in list(self._watches.values()):
-                w._deliver(_RemoteWatch._SENTINEL)
+                if getattr(w, "_epoch", epoch) == epoch:
+                    w._deliver(_RemoteWatch._SENTINEL)
 
     def _on_watch_event(self, msg: dict[str, Any]) -> None:
         w = self._watches.get(int(msg["watch"]))
@@ -509,6 +522,7 @@ class RemoteStore:
                 frame = json.dumps({"id": rid, "op": op, "args": args}).encode() + b"\n"
                 try:
                     with self._send_lock:
+                        slot["epoch"] = self._conn_epoch
                         self._wfile.write(frame)
                         self._wfile.flush()
                 except OSError:
@@ -611,6 +625,7 @@ class RemoteStore:
             self._wid += 1
             wid = self._wid
         w = _RemoteWatch(self, wid)
+        w._epoch = self._conn_epoch
         self._watches[wid] = w
         try:
             self._call("watch", kinds=sorted(kinds), namespace=namespace, wid=wid)

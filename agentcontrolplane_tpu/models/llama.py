@@ -68,6 +68,9 @@ class LlamaConfig:
     # to the residual stream. 2.0 keeps drops negligible at serving batch
     # sizes; tests use no-drop capacities.
     expert_capacity_factor: float = 2.0
+    # dispatch/combine group size: tokens are routed in fixed-size groups so
+    # the one-hot dispatch tensors stay O(group) per token instead of O(N)
+    moe_group_size: int = 512
     dtype: Any = jnp.bfloat16
 
     @property
@@ -326,7 +329,8 @@ def _attn_mlp(
         from ..ops.moe import expert_capacity, moe_ffn
 
         cap = expert_capacity(
-            B * T, c.n_experts, c.experts_per_token, c.expert_capacity_factor
+            min(B * T, c.moe_group_size),
+            c.n_experts, c.experts_per_token, c.expert_capacity_factor,
         )
         y = moe_ffn(
             h.reshape(B * T, D),
@@ -335,6 +339,7 @@ def _attn_mlp(
             experts_per_token=c.experts_per_token,
             capacity=cap,
             act=act,
+            group_size=c.moe_group_size,
         )
         x = x + y.reshape(B, T, D)
     else:

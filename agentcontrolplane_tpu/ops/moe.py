@@ -62,8 +62,48 @@ def moe_ffn(
     experts_per_token: int,
     capacity: int,
     act=jax.nn.silu,
+    group_size: int = 512,
 ) -> jax.Array:
-    """Routed FFN over flattened tokens; returns [N, D] in x.dtype."""
+    """Routed FFN over flattened tokens; returns [N, D] in x.dtype.
+
+    Tokens are processed in fixed-size GROUPS (GShard's grouping): the
+    dispatch/combine tensors are [G, E, C] per group with C derived from G,
+    so their size — and the dispatch einsum FLOPs — stay CONSTANT per token
+    as N grows. Without grouping both are O(N^2·k/E): a 4k-token Mixtral
+    prefill would spend orders of magnitude more on dispatch than on the
+    experts themselves. ``capacity`` is the PER-GROUP capacity (compute it
+    from group_size, e.g. ``expert_capacity(min(N, group_size), ...)``)."""
+    N, D = x.shape
+    if N > group_size:
+        G = group_size
+        n_groups = -(-N // G)
+        pad = n_groups * G - N
+        xp = jnp.pad(x, ((0, pad), (0, 0)))
+        # pad rows are masked out of dispatch/combine entirely (they must
+        # not consume any expert's capacity in the last group)
+        valid = (jnp.arange(n_groups * G) < N).reshape(n_groups, G)
+        grouped = jax.vmap(
+            lambda g, v: _moe_ffn_group(
+                g, router_w, w1, w3, w2, experts_per_token, capacity, act, v
+            )
+        )(xp.reshape(n_groups, G, D), valid)
+        return grouped.reshape(n_groups * G, D)[:N]
+    return _moe_ffn_group(
+        x, router_w, w1, w3, w2, experts_per_token, capacity, act, None
+    )
+
+
+def _moe_ffn_group(
+    x: jax.Array,  # [N, D] one group's tokens
+    router_w: jax.Array,
+    w1: jax.Array,
+    w3: jax.Array,
+    w2: jax.Array,
+    experts_per_token: int,
+    capacity: int,
+    act,
+    valid: jax.Array | None = None,  # [N] bool; False rows take no capacity
+) -> jax.Array:
     N, D = x.shape
     E = router_w.shape[-1]
     k = experts_per_token
@@ -76,9 +116,11 @@ def moe_ffn(
     # flatten choices in (choice-major, token) order so lower-k choices win
     # slots first, then cumsum one-hots per expert. [k, N] -> [k*N, E]
     choice_onehot = jax.nn.one_hot(top_idx.T.reshape(-1), E, dtype=jnp.int32)
+    if valid is not None:
+        choice_onehot = choice_onehot * jnp.tile(valid, k).astype(jnp.int32)[:, None]
     pos_in_expert = jnp.cumsum(choice_onehot, axis=0) * choice_onehot - 1  # [k*N, E]
-    pos = jnp.max(pos_in_expert, axis=-1)  # [k*N] (-1 only if onehot row is 0: never)
-    fits = pos < C
+    pos = jnp.max(pos_in_expert, axis=-1)  # [k*N] (-1 for masked-out rows)
+    fits = (pos < C) & (pos >= 0)
 
     # dispatch/combine tensors [N, E, C]; overflowed choices vanish (zero
     # rows) and the residual connection carries the token

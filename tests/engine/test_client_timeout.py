@@ -87,3 +87,31 @@ async def test_future_without_admitted_attr_uses_request_timeout():
     fut = make_future(admitted=None)
     with pytest.raises(asyncio.TimeoutError):
         await client._await_result(fut)
+
+
+def test_requests_drained_at_stop_fail_instead_of_hanging():
+    """A request racing stop() into the same queue drain must have its
+    future failed (review finding: the drained-but-unadmitted list was
+    discarded, hanging the caller forever)."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    eng = Engine(
+        config=dataclasses.replace(PRESETS["tiny"], vocab_size=512),
+        tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 1}, devices=jax.devices()[:1]),
+        max_slots=2, max_ctx=64, prefill_buckets=(32,),
+        decode_block_size=4, prefix_cache_entries=0, seed=0,
+    )
+    eng.start()
+    with eng.hold_admission():  # keep the request in the queue/waiting
+        fut = eng.submit("hang?", SamplingParams(temperature=0.0, max_tokens=4))
+        eng.stop()
+    with pytest.raises((RuntimeError, asyncio.CancelledError, Exception)):
+        fut.result(timeout=30)

@@ -324,3 +324,20 @@ def test_moe_int8_quantization():
     assert quantize(w1).q.shape == (4, 64, 128)
     assert quantize(w1).scale.shape == (4, 1, 128)  # per-channel over D
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=0.1, atol=0.05)
+
+
+def test_moe_grouped_matches_reference_across_group_boundaries():
+    """N > group_size splits tokens into fixed-capacity groups (the thing
+    that keeps dispatch O(group) per token); with slack capacity the result
+    must still match the exact per-token reference — including the padded
+    final group, whose pad rows must consume no expert capacity."""
+    router, w1, w3, w2 = _weights(seed=7)
+    rng = np.random.default_rng(8)
+    x = jnp.asarray(rng.normal(size=(21, 64)), dtype=jnp.float32)
+    ref = moe_ffn_reference(x, router, w1, w3, w2, experts_per_token=2)
+    out = moe_ffn(
+        x, router, w1, w3, w2, experts_per_token=2,
+        capacity=expert_capacity(8, 4, 2, 8.0),  # per-group (G=8)
+        group_size=8,  # 21 tokens -> groups of 8, 8, 5(+3 pad)
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=1e-5, atol=1e-5)
