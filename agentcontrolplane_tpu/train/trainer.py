@@ -60,12 +60,22 @@ class Trainer:
     mesh: Mesh
     optimizer: optax.GradientTransformation
     sequence_parallel: bool = False  # ring attention over the 'sp' axis
+    # GPipe over the mesh's 'pp' axis (parallel/pipeline.py): layer stages
+    # per rank, microbatched schedule, autodiff'd backward. Composes with
+    # dp (batch) and tp (in-stage matmuls); exclusive with ring attention.
+    pipeline_parallel: bool = False
+    n_microbatches: int = 0  # 0 = 2 * pp
 
     def __post_init__(self):
         c, mesh = self.config, self.mesh
         has_sp = "sp" in mesh.axis_names and mesh.shape["sp"] > 1
         if self.sequence_parallel and not has_sp:
             raise ValueError("sequence_parallel requires an 'sp' mesh axis > 1")
+        has_pp = "pp" in mesh.axis_names and mesh.shape["pp"] > 1
+        if self.pipeline_parallel and not has_pp:
+            raise ValueError("pipeline_parallel requires a 'pp' mesh axis > 1")
+        if self.pipeline_parallel and self.sequence_parallel:
+            raise ValueError("pipeline_parallel and sequence_parallel are exclusive")
 
         attn_impl = None
         if self.sequence_parallel:
@@ -74,9 +84,19 @@ class Trainer:
             )
 
         abstract = jax.eval_shape(lambda k: init_params(c, k), jax.random.key(0))
-        self.param_sharding = param_shardings(mesh, c, abstract)
+        if self.pipeline_parallel:
+            from ..parallel.pipeline import pipeline_shardings
+
+            self.param_sharding = pipeline_shardings(mesh, c, abstract)
+        else:
+            self.param_sharding = param_shardings(mesh, c, abstract)
+        from ..parallel.mesh import _prune_spec_axes
+
         self.batch_sharding = NamedSharding(
-            mesh, P("dp", "sp" if has_sp else None)
+            mesh,
+            _prune_spec_axes(  # pure-pp meshes have no dp axis
+                P("dp", "sp" if has_sp else None), mesh.axis_names
+            ),
         )
         # Optimizer-state leaves mirroring a param shape (adam mu/nu etc.)
         # inherit that param's sharding; everything else (counts, scalars) is
@@ -99,8 +119,16 @@ class Trainer:
             abstract_opt,
         )
 
-        def loss_fn(params, tokens, loss_mask):
-            return lm_loss(params, tokens, loss_mask, c, attn_impl=attn_impl)
+        if self.pipeline_parallel:
+            from ..parallel.pipeline import pipeline_loss_fn
+
+            def loss_fn(params, tokens, loss_mask):
+                return pipeline_loss_fn(
+                    params, tokens, loss_mask, c, mesh, self.n_microbatches
+                )
+        else:
+            def loss_fn(params, tokens, loss_mask):
+                return lm_loss(params, tokens, loss_mask, c, attn_impl=attn_impl)
 
         def train_step(params, opt_state, tokens, loss_mask):
             loss, grads = jax.value_and_grad(loss_fn)(params, tokens, loss_mask)
