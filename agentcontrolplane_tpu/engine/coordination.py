@@ -40,7 +40,7 @@ import logging
 import socket
 import struct
 import threading
-from typing import Any, Optional
+from typing import Any
 
 log = logging.getLogger("acp_tpu.engine.coordination")
 

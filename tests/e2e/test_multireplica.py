@@ -23,7 +23,6 @@ import signal
 import subprocess
 import sys
 
-import pytest
 
 from agentcontrolplane_tpu.kernel import Store, StoreServer, wait_for
 from agentcontrolplane_tpu.testing import make_agent, make_llm, make_task
