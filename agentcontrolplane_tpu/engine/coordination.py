@@ -146,6 +146,13 @@ class CoordinationLeader:
                 "hold": hold,
             }
             payload = json.dumps(frame).encode()
+            if reqs or cancels or stop:  # don't count idle keepalive frames
+                from ..observability.metrics import REGISTRY
+
+                REGISTRY.counter_add(
+                    "acp_coordination_frames_total",
+                    help="non-idle multi-host admission frames published",
+                )
             dead = []
             for conn in self._followers:
                 try:

@@ -142,18 +142,30 @@ class _Conn:
             self.close()
 
     def _handle(self, req: dict[str, Any]) -> None:
+        from ..observability.metrics import REGISTRY
+
         rid = req.get("id")
         op = req.get("op")
         args = req.get("args") or {}
         try:
             payload = self._dispatch(op, args)
         except Exception as e:
+            REGISTRY.counter_add(
+                "acp_store_rpc_total",
+                labels={"op": str(op), "result": "error"},
+                help="served-store RPCs by op",
+            )
             self.send({
                 "id": rid,
                 "err": type(e).__name__,
                 "msg": str(e),
             })
         else:
+            REGISTRY.counter_add(
+                "acp_store_rpc_total",
+                labels={"op": str(op), "result": "ok"},
+                help="served-store RPCs by op",
+            )
             self.send({"id": rid, "ok": payload})
 
     def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
