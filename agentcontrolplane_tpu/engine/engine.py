@@ -28,7 +28,6 @@ lease layer never serializes the engine.
 from __future__ import annotations
 
 import contextlib
-import dataclasses
 import heapq
 import logging
 import queue
@@ -37,7 +36,6 @@ import time
 import uuid
 from concurrent.futures import Future, InvalidStateError
 from dataclasses import dataclass, field
-from functools import partial
 from typing import Any, Optional, Sequence
 
 import jax
@@ -57,11 +55,9 @@ from ..ops.sampling import sample
 from ..parallel.mesh import (
     kv_cache_shardings,
     param_shardings,
-    replicated,
     serving_mesh,
 )
 from .tokenizer import ByteTokenizer, Tokenizer
-from .weights import sharded_init
 
 log = logging.getLogger("acp_tpu.engine")
 

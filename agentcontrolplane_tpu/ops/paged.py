@@ -17,7 +17,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from .attention import NEG_INF, repeat_kv
+from .attention import NEG_INF
 
 TRASH_PAGE = 0
 

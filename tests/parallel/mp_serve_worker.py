@@ -89,6 +89,15 @@ def main() -> None:
             print(json.dumps({"follower": "done"}), flush=True)
     finally:
         engine.stop()
+        if nproc > 1:
+            # exit barrier: a rank tearing its runtime down while the other
+            # still has the final decode block's collectives in flight
+            # aborts gloo mid-transfer; align both ranks after their engine
+            # loops have fully drained before any process exits
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("acp-serve-exit")
+            jax.distributed.shutdown()
         if coordination is not None:
             coordination.close()
 

@@ -40,6 +40,16 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__)
 TINY = dataclasses.replace(PRESETS["tiny"], vocab_size=512)
 
 
+def _last_json(out: str) -> dict:
+    """gloo prints connection banners on stdout (including AFTER our JSON
+    when the exit barrier runs); take the last parseable JSON line."""
+    for line in reversed(out.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            return json.loads(line)
+    raise AssertionError(f"no JSON line in worker output: {out[-500:]!r}")
+
+
 def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("127.0.0.1", 0))
@@ -157,7 +167,7 @@ def test_two_process_coordinated_serving_matches_single_process():
                 q.kill()
             raise
         assert p.returncode == 0, f"serve worker failed:\n{err[-3000:]}"
-        outs.append(json.loads(out.strip().splitlines()[-1]))
+        outs.append(_last_json(out))
 
     assert outs[1] == {"follower": "done"}
     two_proc_tokens = outs[0]["tokens"]
@@ -177,5 +187,5 @@ def test_two_process_coordinated_serving_matches_single_process():
         env=env,
     )
     assert ref.returncode == 0, f"reference worker failed:\n{ref.stderr[-3000:]}"
-    ref_tokens = json.loads(ref.stdout.strip().splitlines()[-1])["tokens"]
+    ref_tokens = _last_json(ref.stdout)["tokens"]
     assert two_proc_tokens == ref_tokens
