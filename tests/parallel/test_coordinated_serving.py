@@ -189,3 +189,79 @@ def test_two_process_coordinated_serving_matches_single_process():
     assert ref.returncode == 0, f"reference worker failed:\n{ref.stderr[-3000:]}"
     ref_tokens = _last_json(ref.stdout)["tokens"]
     assert two_proc_tokens == ref_tokens
+
+
+def test_cancel_lockstep_between_leader_and_follower():
+    """A cancelled in-flight request must finish (freeing its slot) at the
+    SAME frame on both engines — cancels apply only through the replicated
+    frame stream, never from the leader's live set."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    leader_chan = CoordinationLeader(bind="127.0.0.1:0")
+    leader = _engine(mesh, coordination=leader_chan)
+    follower = _engine(mesh, coordination=CoordinationFollower(leader_chan.address))
+    leader_chan.wait_for_followers(1, timeout=30.0)
+    leader.start()
+    follower.start()
+    try:
+        long = leader.submit(
+            "cancel me", SamplingParams(temperature=0.0, max_tokens=4096)
+        )
+        short = leader.submit(
+            "finish me", SamplingParams(temperature=0.0, max_tokens=6)
+        )
+        short.result(timeout=300)
+        leader.cancel(long)
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            ls, fs = leader.stats(), follower.stats()
+            if (
+                ls["active_slots"] == 0 and fs["active_slots"] == 0
+                and leader.tokens_generated == follower.tokens_generated
+            ):
+                break
+            time.sleep(0.05)
+        assert leader.stats()["active_slots"] == 0
+        assert follower.stats()["active_slots"] == 0
+        assert leader.tokens_generated == follower.tokens_generated
+    finally:
+        leader.stop()
+        follower.stop()
+        leader_chan.close()
+
+
+def test_admission_hold_replicates_through_frames():
+    """hold_admission (prewarm batch formation) rides the frame stream:
+    followers skip slot-filling the same iterations, then admit the same
+    single batch — token counts stay equal."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    leader_chan = CoordinationLeader(bind="127.0.0.1:0")
+    leader = _engine(mesh, coordination=leader_chan)
+    follower = _engine(mesh, coordination=CoordinationFollower(leader_chan.address))
+    leader_chan.wait_for_followers(1, timeout=30.0)
+    leader.start()
+    follower.start()
+    try:
+        with leader.hold_admission():
+            futs = [
+                leader.submit(
+                    "held %d" % i, SamplingParams(temperature=0.0, max_tokens=5)
+                )
+                for i in range(3)
+            ]
+            time.sleep(0.5)  # several held frames stream to the follower
+            assert leader.stats()["active_slots"] == 0  # nothing admitted yet
+        for f in futs:
+            f.result(timeout=300)
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            if (
+                follower.tokens_generated == leader.tokens_generated
+                and follower.stats()["active_slots"] == 0
+            ):
+                break
+            time.sleep(0.05)
+        assert follower.tokens_generated == leader.tokens_generated
+    finally:
+        leader.stop()
+        follower.stop()
+        leader_chan.close()
