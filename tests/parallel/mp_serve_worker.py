@@ -22,6 +22,12 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 import jax
 
 jax.config.update("jax_platforms", "cpu")  # the axon harness overrides the env var
+# The persistent compile cache holds XLA:CPU AOT entries compiled on other
+# machines (the cpu_aot_loader machine-feature warnings). If one rank loads
+# a cached executable while the other recompiles fresh, their collective
+# DECOMPOSITIONS can differ -> gloo "received data size doesn't match"
+# aborts mid-run. Multi-process CPU workers must compile deterministically.
+jax.config.update("jax_enable_compilation_cache", False)
 
 import dataclasses
 

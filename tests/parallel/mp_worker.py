@@ -17,6 +17,10 @@ os.environ.setdefault("XLA_FLAGS", "--xla_force_host_platform_device_count=2")
 import jax
 
 jax.config.update("jax_platforms", "cpu")  # the axon harness overrides the env var
+# deterministic compiles across ranks (see mp_serve_worker.py): a cache
+# hit on one rank + fresh compile on the other can decompose collectives
+# differently and abort gloo mid-run
+jax.config.update("jax_enable_compilation_cache", False)
 
 import numpy as np
 import optax

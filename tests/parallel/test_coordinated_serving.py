@@ -158,16 +158,21 @@ def _spawn(pid: int, nproc: int, jax_port: int, coord_port: int) -> subprocess.P
 def test_two_process_coordinated_serving_matches_single_process():
     jax_port, coord_port = _free_port(), _free_port()
     procs = [_spawn(i, 2, jax_port, coord_port) for i in range(2)]
-    outs = []
+    results = []
     for p in procs:
         try:
-            out, err = p.communicate(timeout=420)
+            results.append(p.communicate(timeout=420))
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
             raise
-        assert p.returncode == 0, f"serve worker failed:\n{err[-3000:]}"
-        outs.append(_last_json(out))
+    # report BOTH ranks on failure: a gloo abort on one rank is usually
+    # the symptom of the OTHER rank dying first
+    for i, p in enumerate(procs):
+        assert p.returncode == 0, "rank %d failed:\n%s\n--- other rank ---\n%s" % (
+            i, results[i][1][-2000:], results[1 - i][1][-2000:]
+        )
+    outs = [_last_json(out) for out, _ in results]
 
     assert outs[1] == {"follower": "done"}
     two_proc_tokens = outs[0]["tokens"]
