@@ -1,0 +1,134 @@
+"""Generate docs/crd-reference.md from the pydantic API models.
+
+Counterpart of the reference's hand-written acp/docs/crd-reference.md, but
+generated so it CANNOT drift from the code: tests/test_docs_reference.py
+regenerates it and fails if the committed file differs.
+
+    python scripts/gen_crd_reference.py > docs/crd-reference.md
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import typing
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from agentcontrolplane_tpu.api import resources as R  # noqa: E402
+from agentcontrolplane_tpu.api.meta import APIModel  # noqa: E402
+
+KINDS = [
+    ("LLM", R.LLMSpec, R.LLMStatus),
+    ("Agent", R.AgentSpec, R.AgentStatus),
+    ("Task", R.TaskSpec, R.TaskStatus),
+    ("ToolCall", R.ToolCallSpec, R.ToolCallStatus),
+    ("MCPServer", R.MCPServerSpec, R.MCPServerStatus),
+    ("ContactChannel", R.ContactChannelSpec, R.ContactChannelStatus),
+    ("Secret", R.SecretSpec, None),
+]
+
+
+def _type_name(tp) -> str:
+    origin = typing.get_origin(tp)
+    if origin is typing.Union or str(origin) == "types.UnionType":
+        args = [a for a in typing.get_args(tp) if a is not type(None)]
+        inner = " | ".join(_type_name(a) for a in args)
+        return inner
+    if origin in (list, tuple):
+        args = typing.get_args(tp)
+        return f"[{_type_name(args[0])}]" if args else "[...]"
+    if origin is dict:
+        k, v = typing.get_args(tp) or (str, str)
+        return f"{{{_type_name(k)}: {_type_name(v)}}}"
+    if origin is typing.Literal:
+        return " \\| ".join(repr(a) for a in typing.get_args(tp))
+    if isinstance(tp, type):
+        if issubclass(tp, APIModel):
+            return f"[{tp.__name__}](#{tp.__name__.lower()})"
+        return tp.__name__
+    return str(tp).replace("typing.", "")
+
+
+def _default(field) -> str:
+    from pydantic_core import PydanticUndefined
+
+    if field.default is PydanticUndefined:
+        if field.default_factory is not None:
+            return "`{}`" if "dict" in repr(field.default_factory) else "(factory)"
+        return "**required**"
+    d = field.default
+    if d is None:
+        return "`null`"
+    return f"`{d!r}`".replace("'", '"')
+
+
+def _rows(model) -> list[str]:
+    out = []
+    for name, field in model.model_fields.items():
+        camel = field.alias or name
+        desc = (field.description or "").replace("\n", " ")
+        out.append(
+            f"| `{camel}` | {_type_name(field.annotation)} | {_default(field)} | {desc} |"
+        )
+    return out
+
+
+def _submodels(model, seen) -> list:
+    found = []
+
+    def visit(tp):
+        origin = typing.get_origin(tp)
+        if origin is not None:
+            for a in typing.get_args(tp):
+                visit(a)
+            return
+        if isinstance(tp, type) and issubclass(tp, APIModel) and tp not in seen:
+            seen.add(tp)
+            found.append(tp)
+            for f in tp.model_fields.values():
+                visit(f.annotation)
+
+    for f in model.model_fields.values():
+        visit(f.annotation)
+    return found
+
+
+def main() -> None:
+    print("# API reference (generated)")
+    print()
+    print("Field-by-field reference for every kind, generated from the")
+    print("pydantic models in `api/resources.py` by")
+    print("`scripts/gen_crd_reference.py` (lockstep-pinned by")
+    print("`tests/test_docs_reference.py` — regenerate after API changes).")
+    print("Manifests accept both camelCase (shown) and snake_case field")
+    print("names. Counterpart of the reference's `acp/docs/crd-reference.md`.")
+    seen: set = set()
+    sub_queue: list = []
+    for kind, spec, status in KINDS:
+        print(f"\n## {kind}\n")
+        print("### spec\n")
+        print("| field | type | default | notes |")
+        print("|---|---|---|---|")
+        for row in _rows(spec):
+            print(row)
+        sub_queue += _submodels(spec, seen)
+        if status is not None:
+            print("\n### status\n")
+            print("| field | type | default | notes |")
+            print("|---|---|---|---|")
+            for row in _rows(status):
+                print(row)
+            sub_queue += _submodels(status, seen)
+    if sub_queue:
+        print("\n## Shared types\n")
+        for tp in sub_queue:
+            print(f"\n### {tp.__name__}\n")
+            print("| field | type | default | notes |")
+            print("|---|---|---|---|")
+            for row in _rows(tp):
+                print(row)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,27 @@
+"""docs/crd-reference.md is GENERATED from the pydantic models; this test
+keeps it in lockstep with the code (regenerate with
+``python scripts/gen_crd_reference.py > docs/crd-reference.md``)."""
+
+from __future__ import annotations
+
+import io
+import pathlib
+import sys
+from contextlib import redirect_stdout
+
+REPO = pathlib.Path(__file__).parent.parent
+
+
+def test_crd_reference_matches_models():
+    sys.path.insert(0, str(REPO / "scripts"))
+    import gen_crd_reference
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        gen_crd_reference.main()
+    expected = buf.getvalue()
+    actual = (REPO / "docs" / "crd-reference.md").read_text()
+    assert actual == expected, (
+        "docs/crd-reference.md is stale — regenerate with "
+        "`python scripts/gen_crd_reference.py > docs/crd-reference.md`"
+    )
