@@ -42,6 +42,8 @@ import struct
 import threading
 from typing import Any
 
+from ..observability.metrics import REGISTRY
+
 log = logging.getLogger("acp_tpu.engine.coordination")
 
 _LEN = struct.Struct("!I")
@@ -147,8 +149,6 @@ class CoordinationLeader:
             }
             payload = json.dumps(frame).encode()
             if reqs or cancels or stop:  # don't count idle keepalive frames
-                from ..observability.metrics import REGISTRY
-
                 REGISTRY.counter_add(
                     "acp_coordination_frames_total",
                     help="non-idle multi-host admission frames published",

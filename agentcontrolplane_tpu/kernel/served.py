@@ -44,6 +44,7 @@ from ..api.meta import Resource
 from ..api.resources import from_doc
 from .errors import AlreadyExists, Conflict, Invalid, NotFound
 from .store import Store, Watch, WatchEvent, _current_loop
+from ..observability.metrics import REGISTRY
 
 log = logging.getLogger("acp_tpu.served")
 
@@ -57,6 +58,12 @@ _ERRORS: dict[str, type[Exception]] = {
 # A context window with many tool results can be large; frames are one JSON
 # line each, so cap defensively rather than at a "typical" size.
 _MAX_FRAME = 64 * 1024 * 1024
+# ops that may appear as metric labels — a client-controlled op string must
+# never mint unbounded counter series
+_KNOWN_OPS = frozenset({
+    "ping", "create", "get", "list", "update", "update_status", "delete",
+    "phase_counts", "watch", "unwatch",
+})
 _OUTBOX_CAP = 10_000
 
 
@@ -142,17 +149,16 @@ class _Conn:
             self.close()
 
     def _handle(self, req: dict[str, Any]) -> None:
-        from ..observability.metrics import REGISTRY
-
         rid = req.get("id")
         op = req.get("op")
         args = req.get("args") or {}
+        op_label = op if op in _KNOWN_OPS else "unknown"
         try:
             payload = self._dispatch(op, args)
         except Exception as e:
             REGISTRY.counter_add(
                 "acp_store_rpc_total",
-                labels={"op": str(op), "result": "error"},
+                labels={"op": op_label, "result": "error"},
                 help="served-store RPCs by op",
             )
             self.send({
@@ -163,7 +169,7 @@ class _Conn:
         else:
             REGISTRY.counter_add(
                 "acp_store_rpc_total",
-                labels={"op": str(op), "result": "ok"},
+                labels={"op": op_label, "result": "ok"},
                 help="served-store RPCs by op",
             )
             self.send({"id": rid, "ok": payload})

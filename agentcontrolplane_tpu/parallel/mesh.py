@@ -139,10 +139,13 @@ def _prune_spec_axes(spec: P, axis_names) -> P:
     ])
 
 
-def param_shardings(mesh: Mesh, config: LlamaConfig, params_like: dict) -> dict:
+def param_shardings(
+    mesh: Mesh, config: LlamaConfig, params_like: dict, specs: dict | None = None
+) -> dict:
     """NamedShardings matching the params pytree structure (drops lm_head for
-    tied-embedding configs and bias specs for bias-free architectures)."""
-    specs = dict(param_specs(config))
+    tied-embedding configs and bias specs for bias-free architectures).
+    ``specs`` overrides the base spec dict (e.g. pipeline_param_specs)."""
+    specs = dict(specs if specs is not None else param_specs(config))
     if "lm_head" not in params_like:
         specs.pop("lm_head")
     layers_like = params_like.get("layers")

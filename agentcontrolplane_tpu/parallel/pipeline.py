@@ -32,7 +32,7 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
 from ..models.llama import LlamaConfig, _attn_mlp, _embed, _final_norm_w
 from ..ops.attention import causal_attention
@@ -52,20 +52,10 @@ def pipeline_param_specs(config: LlamaConfig) -> dict:
 
 
 def pipeline_shardings(mesh, config: LlamaConfig, params_like: dict) -> dict:
-    from .mesh import _prune_spec_axes
+    from .mesh import param_shardings
 
-    specs = dict(pipeline_param_specs(config))
-    if "lm_head" not in params_like:
-        specs.pop("lm_head", None)
-    layers_like = params_like.get("layers")
-    if isinstance(layers_like, dict):
-        specs["layers"] = {
-            k: v for k, v in specs["layers"].items() if k in layers_like
-        }
-    return jax.tree_util.tree_map(
-        lambda spec: NamedSharding(mesh, _prune_spec_axes(spec, mesh.axis_names)),
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
+    return param_shardings(
+        mesh, config, params_like, specs=pipeline_param_specs(config)
     )
 
 

@@ -308,8 +308,9 @@ def _attach_last_known_good(doc: dict) -> None:
     except (OSError, json.JSONDecodeError):
         return
     if lkg.get("value"):
-        doc["last_known_good"] = lkg
-        _flush_doc(doc)
+        with _FLUSH_LOCK:  # same mutate+flush discipline as every other site
+            doc["last_known_good"] = lkg
+            _flush_doc(doc)
 
 
 def _flush_doc(doc: dict) -> None:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import sys
+import types
 import typing
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -31,7 +32,7 @@ KINDS = [
 
 def _type_name(tp) -> str:
     origin = typing.get_origin(tp)
-    if origin is typing.Union or str(origin) == "types.UnionType":
+    if origin is typing.Union or origin is types.UnionType:
         args = [a for a in typing.get_args(tp) if a is not type(None)]
         inner = " | ".join(_type_name(a) for a in args)
         return inner
