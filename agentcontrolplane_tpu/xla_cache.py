@@ -30,6 +30,20 @@ def enable_persistent_compilation_cache() -> bool:
         return True
     if os.environ.get("ACP_XLA_CACHE", "1") in ("0", "false", "no"):
         return False
+    try:
+        import jax
+
+        if jax.process_count() > 1:
+            # Multi-host lockstep requires every rank to COMPILE the same
+            # program the same way. A cache hit on one rank + fresh compile
+            # on another can decompose collectives differently (observed as
+            # gloo size-mismatch aborts on CPU meshes); per-process caches
+            # also race on shared filesystems. Cold compiles are once per
+            # process here — correctness wins.
+            log.info("multi-host run: persistent compilation cache disabled")
+            return False
+    except Exception:
+        pass  # backend not initialized yet; single-process paths continue
     cache_dir = os.environ.get("ACP_XLA_CACHE_DIR") or os.path.join(
         os.path.expanduser("~"), ".cache", "acp_tpu_xla"
     )
