@@ -1059,7 +1059,11 @@ class Engine:
                     transit = {
                         r.rid for r in self._queue.queue if r is not None
                     }
-                self._cancelled &= transit
+                # keep cancels that raced in AFTER the pending snapshot for
+                # requests that are (or just became) part of the published
+                # stream — they publish next frame; drop only truly stale
+                # rids referencing nothing live anywhere
+                self._cancelled &= transit | published_live
                 # publish BEFORE applying, so a crash between the two can
                 # only lose work symmetrically (followers time out)
                 self._coordination.publish(
