@@ -218,6 +218,17 @@ class TaskReconciler:
         try:
             llm = self.store.get("LLM", agent.spec.llm_ref.name, task.namespace)
             assert isinstance(llm, LLM)
+            if llm.spec.provider == "tpu" and getattr(self.llm_factory, "engine", None) is None:
+                # multi-replica: THIS replica has no serving engine (a
+                # follower joined for control-plane capacity). Leave the
+                # task for the engine-owning replica instead of burning a
+                # failed send + error churn; the lease releases in our
+                # caller's finally, so the owner's next attempt wins it.
+                task.status.status_detail = (
+                    "waiting for an engine-serving replica (provider: tpu)"
+                )
+                self._update_status(task)
+                return Result.after(self.requeue_delay)
             api_key = resolve_secret_key(self.store, task.namespace, llm.spec.api_key_from)
             client = await self.llm_factory.create_client(llm, api_key)
         except (NotFound, Invalid) as e:

@@ -385,3 +385,25 @@ async def test_context_policy_applied_to_llm_request(harness):
     # the persisted history kept EVERYTHING (checkpoint intact) + new answer
     stored = store.get("Task", "test-task").status.context_window
     assert len(stored) == 12
+
+
+async def test_engineless_replica_defers_tpu_tasks(harness):
+    """Multi-replica: a follower with no serving engine must leave a
+    provider:tpu task for the engine-owning replica — quiet requeue with a
+    status detail, no failed send, no error event, lease released."""
+    store, rec, mock, recorder = harness
+    make_llm(store, name="tpu-llm", provider="tpu")
+    make_agent(store, name="agent", llm="tpu-llm")
+    make_task(store, name="t", agent="agent", user_message="hi")
+    await step(rec, "t")  # '' -> Initializing
+    await step(rec, "t")  # -> ReadyForLLM
+
+    assert getattr(rec.llm_factory, "engine", "missing") is None  # follower shape
+    res = await step(rec, "t")
+    task = store.get("Task", "t")
+    assert task.status.phase == "ReadyForLLM"  # untouched, not Failed
+    assert "engine-serving replica" in task.status.status_detail
+    assert res.requeue_after == rec.requeue_delay
+    assert mock.requests == []  # nothing was sent anywhere
+    # the lease is released so the owner can take it immediately
+    assert lease.try_acquire(store, "task-llm-t", "engine-owner")
