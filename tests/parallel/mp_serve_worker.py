@@ -90,8 +90,12 @@ def main() -> None:
             tokens = [f.result(timeout=300).tokens for f in futs]
             print(json.dumps({"tokens": tokens}), flush=True)
         else:
-            # follower: serve until the leader's stop frame ends the loop
-            engine._thread.join(timeout=300)
+            # follower: serve until the leader's stop frame ends the loop.
+            # NO timeout here: giving up early would stop this engine
+            # mid-stream and desynchronize the ranks' dispatch sequences
+            # (the leader always publishes stop in its finally; a dead
+            # leader surfaces via the recv timeout crashing the loop).
+            engine._thread.join()
             print(json.dumps({"follower": "done"}), flush=True)
     finally:
         engine.stop()

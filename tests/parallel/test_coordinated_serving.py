@@ -161,7 +161,7 @@ def test_two_process_coordinated_serving_matches_single_process():
     results = []
     for p in procs:
         try:
-            results.append(p.communicate(timeout=420))
+            results.append(p.communicate(timeout=540))
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
@@ -188,7 +188,7 @@ def test_two_process_coordinated_serving_matches_single_process():
         [sys.executable, SERVE_WORKER, "0", "1", "0", "0"],
         capture_output=True,
         text=True,
-        timeout=420,
+        timeout=540,
         env=env,
     )
     assert ref.returncode == 0, f"reference worker failed:\n{ref.stderr[-3000:]}"
