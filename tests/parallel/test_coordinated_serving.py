@@ -178,8 +178,8 @@ def test_two_process_coordinated_serving_matches_single_process():
     two_proc_tokens = outs[0]["tokens"]
     assert all(len(t) > 0 for t in two_proc_tokens)
 
-    # single-process reference: same GLOBAL computation (tp=4? no — the
-    # 2-proc mesh is tp=4 over 4 devices; replicate with 4 local devices)
+    # single-process reference: the same global tp=4 computation, with all
+    # 4 virtual devices local to one process
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
