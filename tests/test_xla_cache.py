@@ -1,0 +1,28 @@
+"""xla_cache: the persistent compile cache must refuse to arm in
+multi-host processes (divergent collective decompositions across ranks —
+see tests/parallel/mp_serve_worker.py) and honor the opt-out env."""
+
+from __future__ import annotations
+
+import jax
+
+from agentcontrolplane_tpu import xla_cache
+
+
+def test_cache_disabled_for_multihost(monkeypatch):
+    monkeypatch.setattr(xla_cache, "_enabled", False)
+    monkeypatch.setattr(jax, "process_count", lambda: 2)
+    assert xla_cache.enable_persistent_compilation_cache() is False
+
+
+def test_cache_env_opt_out(monkeypatch):
+    monkeypatch.setattr(xla_cache, "_enabled", False)
+    monkeypatch.setenv("ACP_XLA_CACHE", "0")
+    assert xla_cache.enable_persistent_compilation_cache() is False
+
+
+def test_cache_enables_single_process(monkeypatch, tmp_path):
+    monkeypatch.setattr(xla_cache, "_enabled", False)
+    monkeypatch.setenv("ACP_XLA_CACHE_DIR", str(tmp_path / "cache"))
+    assert xla_cache.enable_persistent_compilation_cache() is True
+    assert (tmp_path / "cache").is_dir()
