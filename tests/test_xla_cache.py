@@ -24,5 +24,12 @@ def test_cache_env_opt_out(monkeypatch):
 def test_cache_enables_single_process(monkeypatch, tmp_path):
     monkeypatch.setattr(xla_cache, "_enabled", False)
     monkeypatch.setenv("ACP_XLA_CACHE_DIR", str(tmp_path / "cache"))
+    # record instead of mutating REAL global jax config (the tmp dir is
+    # deleted after this test; later compiles must not point at it)
+    updates: dict = {}
+    monkeypatch.setattr(
+        jax.config, "update", lambda k, v: updates.__setitem__(k, v)
+    )
     assert xla_cache.enable_persistent_compilation_cache() is True
     assert (tmp_path / "cache").is_dir()
+    assert updates["jax_compilation_cache_dir"] == str(tmp_path / "cache")
