@@ -287,15 +287,52 @@ _FLUSH_LOCK = threading.Lock()  # doc is mutated from reader threads too
 # tunnel down attaches it (clearly labeled, with its timestamp) so a
 # transient outage at capture time doesn't erase evidence a real
 # measurement happened earlier. Never copied into the headline fields.
-_LKG_PATH = os.environ.get(
-    "ACP_BENCH_LKG_PATH", "/tmp/tpu_runs/last_known_good.json"
-)
+def _lkg_path() -> str:
+    # read per call, not at import: tests MUST be able to redirect this to a
+    # tmp path via monkeypatch.setenv after bench is already imported
+    return os.environ.get("ACP_BENCH_LKG_PATH", "/tmp/tpu_runs/last_known_good.json")
+
+
+def _lkg_content_refusal(doc: dict) -> str | None:
+    """Content-provenance rules shared by BOTH the save and attach sides, so
+    the two can never drift: a doc whose headline is marked as a stub, or
+    whose platform is not a real accelerator, is never hardware evidence —
+    whether it is about to be written or was found already on disk."""
+    note = str(doc.get("headline_note", ""))
+    if "stub" in note.lower():
+        return f"headline_note {note!r} marks a stub result"
+    backend = doc.get("platform", {}).get("backend")
+    if backend in (None, "", "cpu"):
+        return f"platform backend {backend!r} is not a real accelerator"
+    return None
+
+
+def _lkg_refusal(doc: dict) -> str | None:
+    """Why this doc must NOT be persisted as last-known-good, or None if it
+    may. Provenance guard (VERDICT r4 #1): a harness test drove the real
+    ``_parent()`` with a stub child and a faked TPU probe, and the fabricated
+    777.0 tok/s it emitted was persisted to the real LKG file and then
+    embedded in the judged BENCH_r04.json. Nothing produced by a test
+    process, and nothing whose headline is marked as a stub, may ever become
+    last-known-good — the file exists to carry HARDWARE measurements across
+    tunnel outages, so a false positive here poisons a judged artifact while
+    a false negative merely loses a convenience."""
+    if os.environ.get("PYTEST_CURRENT_TEST"):
+        return "running under pytest — test runs are never hardware evidence"
+    if not doc.get("value", 0) > 0:
+        return "no positive headline value"
+    return _lkg_content_refusal(doc)
 
 
 def _save_last_known_good(doc: dict) -> None:
+    refusal = _lkg_refusal(doc)
+    if refusal is not None:
+        _log(f"NOT persisting last-known-good: {refusal}")
+        return
+    path = _lkg_path()
     try:
-        os.makedirs(os.path.dirname(_LKG_PATH), exist_ok=True)
-        with open(_LKG_PATH, "w") as f:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "w") as f:
             json.dump({**doc, "measured_at": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}, f)
     except OSError as e:
         _log(f"could not persist last-known-good: {e}")
@@ -303,9 +340,15 @@ def _save_last_known_good(doc: dict) -> None:
 
 def _attach_last_known_good(doc: dict) -> None:
     try:
-        with open(_LKG_PATH) as f:
+        with open(_lkg_path()) as f:
             lkg = json.load(f)
     except (OSError, json.JSONDecodeError):
+        return
+    # defense in depth: refuse to SURFACE a bad-provenance doc even if one
+    # got written by an older bench.py (the poisoned r4 file is exactly this)
+    refusal = _lkg_content_refusal(lkg)
+    if refusal is not None:
+        _log(f"ignoring last-known-good file: {refusal}")
         return
     if lkg.get("value"):
         with _FLUSH_LOCK:  # same mutate+flush discipline as every other site
@@ -340,11 +383,7 @@ def _parent() -> None:
         with _FLUSH_LOCK:
             doc["notes"] = [n for n in notes if n]
             _flush_doc(doc)
-            if (
-                doc.get("value", 0) > 0
-                and doc.get("platform", {}).get("backend") not in (None, "cpu")
-            ):
-                _save_last_known_good(doc)  # real accelerator numbers only
+            _save_last_known_good(doc)  # self-guarded: real hardware runs only
         for n in notes:
             _log(n)
 
@@ -411,6 +450,12 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["value"] = val.get("tok_s_per_chip", 0.0)
                 doc["vs_baseline"] = round(doc["value"] / TARGET_TOK_S, 3)
                 doc["headline_note"] = str(val.get("note", ""))
+                if "mfu" in val:
+                    doc["mfu"] = val["mfu"]
+                    # record the denominator so the MFU stays re-derivable
+                    # if the peak table is ever corrected
+                    if "peak_flops_per_chip" in val:
+                        doc["peak_flops_per_chip"] = val["peak_flops_per_chip"]
             elif key == "ttft" and got["ttft"] is None:
                 got["ttft"] = val
                 doc["ttft_first_toolcall_ms"] = val
@@ -483,6 +528,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         if isinstance(ab, dict) and "tok_s_per_chip" in ab:
             with _FLUSH_LOCK:
                 doc[f"{other}_tok_s_per_chip"] = ab["tok_s_per_chip"]
+                if "mfu" in ab:
+                    doc[f"{other}_mfu"] = ab["mfu"]
                 doc["kv_layout_winner"] = (
                     kv_layout if doc["value"] >= ab["tok_s_per_chip"] else other
                 )
@@ -492,6 +539,74 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
             doc["ab_error"] = f"ab phase stalled at '{status}'"
     elif ab_on and headline:
         doc["ab_skipped"] = f"only {remaining:.0f}s of total budget left"
+
+
+# ---------------------------------------------------------------------------
+# FLOPs model (VERDICT r4 #3: MFU next to tok/s — throughput alone can't
+# show distance from roofline)
+# ---------------------------------------------------------------------------
+
+_PEAK_BF16_FLOPS = {
+    # dense bf16 MXU peak per chip, FLOP/s, keyed by substring of the PJRT
+    # device_kind. Weight-only int8 serving still multiplies in bf16 (the
+    # int8->bf16 convert fuses into the operand load — ops/quant.py), so
+    # bf16 peak is the denominator in both quant modes. Ordered most-specific
+    # first: matching iterates in insertion order, and "v4" would otherwise
+    # swallow the half-peak "v4 lite" (v4i).
+    "v4 lite": 138e12,
+    "v5 lite": 197e12,
+    "v6 lite": 918e12,
+    "v5e": 197e12,
+    "v5p": 459e12,
+    "v6e": 918e12,
+    "v4": 275e12,
+}
+
+
+def _peak_flops_per_chip(device_kind: str) -> float | None:
+    dk = (device_kind or "").lower()
+    for key, peak in _PEAK_BF16_FLOPS.items():
+        if key in dk:
+            return peak
+    return None
+
+
+def _matmul_params(c) -> float:
+    """Weights that participate in matmuls per decoded token: attention
+    projections + FFN (active experts only for MoE, plus the router) +
+    lm_head. The embedding gather is not a matmul; tied embeddings still pay
+    the lm_head matmul."""
+    hd = c.head_dim
+    attn = (
+        c.dim * c.n_heads * hd          # Wq
+        + 2 * c.dim * c.n_kv_heads * hd  # Wk, Wv
+        + c.n_heads * hd * c.dim         # Wo
+    )
+    if c.n_experts:
+        mlp = 3 * c.dim * c.ffn_dim * c.experts_per_token + c.dim * c.n_experts
+    else:
+        mlp = 3 * c.dim * c.ffn_dim  # gate, up, down
+    return float(c.n_layers * (attn + mlp) + c.dim * c.vocab_size)
+
+
+def _flops_per_token(c, ctx: float) -> float:
+    """2 FLOPs (mul+add) per matmul weight, plus the QK^T and AV score
+    matmuls against ``ctx`` cached positions (GQA shrinks the KV *cache*,
+    not these two matmuls — queries still use all n_heads)."""
+    attn_scores = 4.0 * c.n_layers * c.n_heads * c.head_dim * ctx
+    return 2.0 * _matmul_params(c) + attn_scores
+
+
+def _burst_model_flops(
+    c, prompt_len: int, prefills: int, gen_tokens: int, mean_ctx: float
+) -> float:
+    """Model FLOPs for one measured burst. The headline window includes the
+    prefill work (elapsed spans submit -> last token), so MFU must count it:
+    each prefill processes prompt_len tokens at mean attention context
+    prompt_len/2; each generated token is one decode step at mean_ctx."""
+    prefill = prefills * prompt_len * _flops_per_token(c, prompt_len / 2.0)
+    decode = gen_tokens * _flops_per_token(c, mean_ctx)
+    return prefill + decode
 
 
 # ---------------------------------------------------------------------------
@@ -626,12 +741,31 @@ def _child(args: argparse.Namespace) -> None:
                 time.sleep(0.2)
         return (total / elapsed) / max(n_chips, 1), total, elapsed, done
 
+    def mfu_fields(total: int, elapsed: float, done: int) -> dict:
+        """MFU for the measured burst, against the chip's dense bf16 peak.
+        Prefills counted at ``done`` when the deadline truncated the burst
+        (conservative: under-, never over-states utilization)."""
+        peak = _peak_flops_per_chip(devices[0].device_kind if devices else "")
+        if peak is None or elapsed <= 0:
+            return {}
+        # count one prefill per COMPLETED request even though the engine
+        # prefills every submission — on a truncated burst this undercounts
+        # work done, which understates (never overstates) MFU
+        prefills = done
+        mean_ctx = prompt_len + max_tokens / 2.0
+        flops = _burst_model_flops(config, prompt_len, prefills, total, mean_ctx)
+        return {
+            "mfu": round(flops / elapsed / max(n_chips, 1) / peak, 4),
+            "peak_flops_per_chip": peak,
+        }
+
     if args.phase == "ab":
         tok_s, total, elapsed, done = measure(
             warm_timeout=max(60.0, (args.budget or 900) / 3), drain=False
         )
         _result("ab", {
             "tok_s_per_chip": round(tok_s, 1),
+            **mfu_fields(total, elapsed, done),
             "note": (
                 f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); kv={kv_layout} "
                 f"quant={quantize or 'bf16'}; {done}/{n_requests} done"
@@ -644,6 +778,7 @@ def _child(args: argparse.Namespace) -> None:
         tok_s, total, elapsed, done = measure(drain=ttft_on)
         _result("headline", {
             "tok_s_per_chip": round(tok_s, 1),
+            **mfu_fields(total, elapsed, done),
             "note": (
                 f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
                 f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "

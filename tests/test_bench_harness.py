@@ -20,6 +20,17 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import bench
 
 
+@pytest.fixture(autouse=True)
+def _lkg_redirect(tmp_path, monkeypatch):
+    """EVERY test in this file writes last-known-good (if at all) to a tmp
+    path. Round-4 postmortem: a test drove the real _parent() with a stub
+    child + faked TPU probe and silently rewrote the REAL
+    /tmp/tpu_runs/last_known_good.json with a fabricated number that the
+    driver then embedded in the judged BENCH_r04.json. Belt (this fixture)
+    and braces (_lkg_refusal rejects pytest/stub provenance)."""
+    monkeypatch.setenv("ACP_BENCH_LKG_PATH", str(tmp_path / "lkg.json"))
+
+
 @pytest.fixture
 def stub_child(tmp_path, monkeypatch):
     """Point bench._THIS at a stub script; returns a setter for its body."""
@@ -214,6 +225,139 @@ def test_parent_flushes_headline_incrementally(stub_child, monkeypatch, capsys):
     assert lines[1]["value"] == 777.0
     assert lines[-1]["value"] == 777.0
     assert lines[-1]["vs_baseline"] == 0.777
+
+
+def test_stub_run_is_never_persisted_as_last_known_good(
+    stub_child, monkeypatch, capsys, tmp_path
+):
+    """The round-4 leak, replayed: real _parent(), stub child reporting a
+    fabricated number, probe faked as a TPU — and the LKG file must NOT be
+    written. Two independent guards fire here (stub note + pytest env);
+    either alone must hold."""
+    lkg = tmp_path / "lkg_guard.json"
+    monkeypatch.setenv("ACP_BENCH_LKG_PATH", str(lkg))
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        print("MARK engine_built", flush=True)
+        print("MARK warm_done", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 777.0, "note": "stub"}', flush=True)
+        """
+    )
+    monkeypatch.setattr(bench, "_cpu_forced_inline", lambda: False)
+    monkeypatch.setattr(
+        bench, "_probe_until",
+        lambda *a, **k: {"backend": "tpu", "n": 1, "device_kind": "TPU v5e"},
+    )
+    monkeypatch.setenv("ACP_BENCH_TTFT", "0")
+    monkeypatch.setenv("ACP_BENCH_AB", "0")
+    monkeypatch.setenv("ACP_BENCH_TOTAL_BUDGET_S", "600")
+    bench._parent()
+    assert not lkg.exists(), "a stub/pytest run must never write last-known-good"
+
+
+def test_lkg_refusal_rules(monkeypatch):
+    """Each provenance rule individually, with the pytest guard removed so
+    the downstream rules are actually reached."""
+    good = {
+        "value": 1234.5,
+        "headline_note": "64/64 requests completed",
+        "platform": {"backend": "tpu", "devices": 1},
+    }
+    # under pytest: refused regardless of content
+    assert "pytest" in bench._lkg_refusal(good)
+    monkeypatch.delenv("PYTEST_CURRENT_TEST", raising=False)
+    assert bench._lkg_refusal(good) is None
+    assert "stub" in bench._lkg_refusal({**good, "headline_note": "stub"})
+    assert "headline" in bench._lkg_refusal({**good, "value": 0.0})
+    assert "accelerator" in bench._lkg_refusal(
+        {**good, "platform": {"backend": "cpu"}}
+    )
+    assert "accelerator" in bench._lkg_refusal({**good, "platform": {}})
+
+
+def test_attach_ignores_poisoned_lkg_file(monkeypatch, tmp_path, capsys):
+    """An LKG file written by an older bench.py with stub provenance (the
+    actual r4 artifact) must not be surfaced into a new doc."""
+    import json
+
+    poisoned = tmp_path / "poisoned.json"
+    poisoned.write_text(json.dumps({
+        "value": 777.0, "headline_note": "stub",
+        "platform": {"backend": "tpu", "device_kind": "TPU v5e"},
+    }))
+    monkeypatch.setenv("ACP_BENCH_LKG_PATH", str(poisoned))
+    doc: dict = {}
+    bench._attach_last_known_good(doc)
+    assert "last_known_good" not in doc
+    # a clean hardware doc still attaches
+    clean = tmp_path / "clean.json"
+    clean.write_text(json.dumps({
+        "value": 1428.9, "headline_note": "64/64 requests completed",
+        "platform": {"backend": "tpu", "device_kind": "TPU v5e"},
+    }))
+    monkeypatch.setenv("ACP_BENCH_LKG_PATH", str(clean))
+    bench._attach_last_known_good(doc)
+    assert doc["last_known_good"]["value"] == 1428.9
+
+
+def test_flops_model_matches_hand_count():
+    """The MFU denominator/numerator on a tiny known config: hand-counted
+    matmul weights and attention-score FLOPs must agree exactly."""
+    from types import SimpleNamespace
+
+    c = SimpleNamespace(
+        dim=8, n_layers=2, n_heads=2, n_kv_heads=1, head_dim=4,
+        ffn_dim=16, vocab_size=32, n_experts=0, experts_per_token=0,
+    )
+    # per layer: Wq 8*8 + Wk 8*4 + Wv 8*4 + Wo 8*8 = 192; mlp 3*8*16 = 384
+    # total: 2*(192+384) + lm_head 8*32 = 1408
+    assert bench._matmul_params(c) == 1408.0
+    # decode at ctx=10: 2*1408 + 4*2*2*4*10 = 2816 + 640
+    assert bench._flops_per_token(c, 10.0) == 2816.0 + 640.0
+    # MoE variant: active experts replace the dense FFN, router added
+    cm = SimpleNamespace(
+        dim=8, n_layers=2, n_heads=2, n_kv_heads=1, head_dim=4,
+        ffn_dim=16, vocab_size=32, n_experts=4, experts_per_token=2,
+    )
+    # mlp: 3*8*16*2 + 8*4 = 800; total 2*(192+800) + 256 = 2240
+    assert bench._matmul_params(cm) == 2240.0
+
+
+def test_peak_flops_lookup():
+    assert bench._peak_flops_per_chip("TPU v5e") == 197e12
+    assert bench._peak_flops_per_chip("TPU v5 lite") == 197e12
+    assert bench._peak_flops_per_chip("TPU v4") == 275e12
+    assert bench._peak_flops_per_chip("cpu") is None
+    assert bench._peak_flops_per_chip("") is None
+
+
+def test_parent_surfaces_mfu_from_headline(stub_child, monkeypatch, capsys):
+    import json
+
+    stub_child(
+        """
+        print("MARK attach_ok 1", flush=True)
+        print("MARK engine_built", flush=True)
+        print("MARK warm_done", flush=True)
+        print('RESULT headline {"tok_s_per_chip": 777.0, "mfu": 0.31, "note": "stub"}', flush=True)
+        """
+    )
+    monkeypatch.setattr(bench, "_cpu_forced_inline", lambda: False)
+    monkeypatch.setattr(
+        bench, "_probe_until",
+        lambda *a, **k: {"backend": "tpu", "n": 1, "device_kind": "TPU v5e"},
+    )
+    monkeypatch.setenv("ACP_BENCH_TTFT", "0")
+    monkeypatch.setenv("ACP_BENCH_AB", "0")
+    monkeypatch.setenv("ACP_BENCH_TOTAL_BUDGET_S", "600")
+    bench._parent()
+    lines = [
+        json.loads(ln)
+        for ln in capsys.readouterr().out.strip().splitlines()
+        if ln.startswith("{")
+    ]
+    assert lines[-1]["mfu"] == 0.31
 
 
 def test_parent_emits_json_line_even_when_run_raises(monkeypatch, capsys):
