@@ -194,6 +194,7 @@ def cmd_run(args) -> int:
         db_path=args.db,
         store_address=args.store,
         serve_store=args.serve_store,
+        store_token=args.store_token,
         identity=args.identity or f"acp-tpu-{os.getpid()}",
         leader_election=args.leader_elect,
         api_port=args.port,
@@ -616,6 +617,14 @@ def build_parser() -> argparse.ArgumentParser:
         "--store", default=None, metavar="ADDR",
         help="join another replica's served store instead of owning one "
         "(multi-replica: leases + leader election hold across processes)",
+    )
+    run.add_argument(
+        "--store-token",
+        default=os.environ.get("ACP_STORE_TOKEN", ""),
+        help="shared secret for the served-store socket — required from "
+        "joining replicas when serving, presented when joining (default: "
+        "$ACP_STORE_TOKEN). Empty disables auth: acceptable only for "
+        "unix:// sockets (0600) or network-isolated loopback tcp://",
     )
     run.add_argument(
         "--api-token",

@@ -2,14 +2,14 @@ from .errors import AlreadyExists, Conflict, Invalid, NotFound, StoreError
 from .events import EventRecorder
 from .queue import WorkQueue
 from .runtime import LeaderElector, Manager, Reconciler, Result, map_owner
-from .served import RemoteStore, StoreServer
+from .served import RemoteStore, StoreAuthError, StoreServer
 from .store import Backend, MemoryBackend, SqliteBackend, Store, Watch, WatchEvent, wait_for
 from . import lease
 
 __all__ = [
     "AlreadyExists", "Conflict", "Invalid", "NotFound", "StoreError",
     "EventRecorder", "WorkQueue", "LeaderElector", "Manager", "Reconciler",
-    "Result", "map_owner", "RemoteStore", "StoreServer", "Backend",
+    "Result", "map_owner", "RemoteStore", "StoreAuthError", "StoreServer", "Backend",
     "MemoryBackend", "SqliteBackend", "Store", "Watch", "WatchEvent",
     "wait_for", "lease",
 ]

@@ -20,7 +20,7 @@ This module gives the in-tree Store the same property:
 Protocol (one JSON object per line, UTF-8):
   request   {"id": 7, "op": "get", "args": {...}}
   reply     {"id": 7, "ok": <payload>}  |  {"id": 7, "err": "Conflict", "msg": "..."}
-  watch事件 pushed server->client: {"watch": 3, "type": "ADDED", "object": {...}}
+  watch event pushed server->client: {"watch": 3, "type": "ADDED", "object": {...}}
 
 Watch delivery is decoupled from the store lock: the server-side subscriber
 only enqueues onto a bounded per-connection outbox drained by a writer
@@ -31,6 +31,7 @@ watches end, and its level-triggered reconcilers resync on reconnect).
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import os
@@ -54,6 +55,24 @@ _ERRORS: dict[str, type[Exception]] = {
     "AlreadyExists": AlreadyExists,
     "Invalid": Invalid,
 }
+# populated after StoreAuthError is defined below
+
+
+
+class _Unauthorized(Exception):
+    """Raised server-side on a bad/missing store token; the connection is
+    dropped right after the error reply is flushed."""
+
+
+class StoreAuthError(ConnectionError):
+    """The served store rejected this client's token. Never retried by the
+    lazy-reconnect loop — a wrong secret does not become right by retrying."""
+
+
+# the server replies with the exception's type name; both spellings map to
+# the client-side auth error
+_ERRORS["Unauthorized"] = StoreAuthError
+_ERRORS["_Unauthorized"] = StoreAuthError
 
 # A context window with many tool results can be large; frames are one JSON
 # line each, so cap defensively rather than at a "typical" size.
@@ -61,8 +80,8 @@ _MAX_FRAME = 64 * 1024 * 1024
 # ops that may appear as metric labels — a client-controlled op string must
 # never mint unbounded counter series
 _KNOWN_OPS = frozenset({
-    "ping", "create", "get", "list", "update", "update_status", "delete",
-    "phase_counts", "watch", "unwatch",
+    "ping", "auth", "create", "get", "list", "update", "update_status",
+    "delete", "phase_counts", "watch", "unwatch",
 })
 _OUTBOX_CAP = 10_000
 
@@ -95,6 +114,9 @@ class _Conn:
         self.outbox: "queue.Queue[bytes | None]" = queue.Queue(maxsize=_OUTBOX_CAP)
         self.unsubs: dict[int, Callable[[], None]] = {}
         self.closed = threading.Event()
+        # with a server token, every op except the auth handshake is refused
+        # until the client proves knowledge of it
+        self.authed = server.token is None
         self._reader = threading.Thread(target=self._read_loop, daemon=True)
         self._writer = threading.Thread(target=self._write_loop, daemon=True)
 
@@ -166,6 +188,13 @@ class _Conn:
                 "err": type(e).__name__,
                 "msg": str(e),
             })
+            if isinstance(e, _Unauthorized):
+                # give the writer a moment to flush the refusal, then cut
+                for _ in range(50):
+                    if self.outbox.empty():
+                        break
+                    time.sleep(0.01)
+                self.close()
         else:
             REGISTRY.counter_add(
                 "acp_store_rpc_total",
@@ -176,6 +205,21 @@ class _Conn:
 
     def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
         store = self.server.store
+        if op == "auth":
+            # constant-time compare on BYTES — compare_digest on str raises
+            # TypeError for non-ASCII, which would lock out replicas holding
+            # the CORRECT secret (same pitfall server/rest.py avoids)
+            supplied = str(a.get("token", "")).encode("utf-8", "surrogateescape")
+            if self.server.token is not None and not hmac.compare_digest(
+                supplied, self.server.token.encode("utf-8", "surrogateescape")
+            ):
+                raise _Unauthorized("bad store token")
+            self.authed = True
+            return "ok"
+        if not self.authed:
+            # an unauthenticated peer gets exactly one error reply and no
+            # second op — this socket carries Secrets and Leases
+            raise _Unauthorized("store token required before any other op")
         if op == "ping":
             return "pong"
         if op == "create":
@@ -259,8 +303,18 @@ class StoreServer:
     >>> # elsewhere: RemoteStore("unix:///tmp/acp-store.sock")
     """
 
-    def __init__(self, store: Store, address: str = "tcp://127.0.0.1:0"):
+    def __init__(
+        self,
+        store: Store,
+        address: str = "tcp://127.0.0.1:0",
+        token: Optional[str] = None,
+    ):
         self.store = store
+        # Shared-secret handshake (ADVICE r4: this surface carries Secrets
+        # and Lease writes, and must not lag the REST API's bearer-token
+        # posture). None disables auth — acceptable only for unix sockets
+        # (0600, same-user) or network-isolated loopback TCP.
+        self.token = token or None
         self._requested = address
         self._family, self._target = _parse_address(address)
         self._sock: Optional[socket.socket] = None
@@ -286,6 +340,9 @@ class StoreServer:
                 pass
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
             sock.bind(path)
+            # owner-only: the socket grants full control-plane read/write
+            # (Secrets included), so default umask perms are too broad
+            os.chmod(path, 0o600)
             self.address = f"unix://{path}"
         else:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -335,6 +392,20 @@ class StoreServer:
                 pass
 
 
+class _EndOfWatch:
+    """End-of-stream marker stamped with the connection epoch that died.
+    Consumption is epoch-aware: a marker older than the epoch the watch's
+    live subscription rides is STALE and skipped — without this, a dying
+    reader racing watch()'s registration could end a freshly re-established
+    watch whose server-side subscription keeps streaming into a queue
+    nobody drains."""
+
+    __slots__ = ("epoch",)
+
+    def __init__(self, epoch: float):
+        self.epoch = epoch
+
+
 class _RemoteWatch:
     """Client-side watch handle; same interface as :class:`~.store.Watch`."""
 
@@ -343,6 +414,7 @@ class _RemoteWatch:
     def __init__(self, remote: "RemoteStore", wid: int):
         self._remote = remote
         self.wid = wid
+        self._epoch = 0
         import asyncio
 
         self.queue: "asyncio.Queue" = asyncio.Queue()
@@ -355,32 +427,47 @@ class _RemoteWatch:
         else:
             self.queue.put_nowait(item)
 
+    def _ended_by(self, ev: Any) -> bool:
+        """True if this queue item terminates the stream for THIS epoch."""
+        if ev is self._SENTINEL:
+            return True
+        return isinstance(ev, _EndOfWatch) and ev.epoch >= self._epoch
+
     def __aiter__(self) -> "_RemoteWatch":
         return self
 
     async def __anext__(self) -> WatchEvent:
-        ev = await self.queue.get()
-        if ev is self._SENTINEL:
-            raise StopAsyncIteration
-        return ev
+        while True:
+            ev = await self.queue.get()
+            if self._ended_by(ev):
+                raise StopAsyncIteration
+            if isinstance(ev, _EndOfWatch):
+                continue  # stale end from a connection this watch outlived
+            return ev
 
     async def next(self, timeout: float | None = None) -> Optional[WatchEvent]:
         import asyncio
 
-        try:
-            ev = await asyncio.wait_for(self.queue.get(), timeout)
-        except asyncio.TimeoutError:
-            return None
-        if ev is self._SENTINEL:
-            return None
-        return ev
+        loop = asyncio.get_running_loop()
+        deadline = None if timeout is None else loop.time() + timeout
+        while True:
+            remaining = None if deadline is None else max(0.0, deadline - loop.time())
+            try:
+                ev = await asyncio.wait_for(self.queue.get(), remaining)
+            except asyncio.TimeoutError:
+                return None
+            if self._ended_by(ev):
+                return None
+            if isinstance(ev, _EndOfWatch):
+                continue
+            return ev
 
     def stop(self) -> None:
         if self._stopped:
             return
         self._stopped = True
         self._remote._stop_watch(self)
-        self._deliver(self._SENTINEL)
+        self._deliver(_EndOfWatch(float("inf")))
 
 
 class RemoteStore:
@@ -400,8 +487,10 @@ class RemoteStore:
         timeout: float = 30.0,
         reconnect_attempts: int = 5,
         reconnect_backoff: float = 0.2,
+        token: Optional[str] = None,
     ):
         self.address = address
+        self._token = token or None
         self._timeout = timeout
         self._reconnect_attempts = reconnect_attempts
         self._reconnect_backoff = reconnect_backoff
@@ -433,7 +522,31 @@ class RemoteStore:
         else:
             sock = socket.create_connection(target, timeout=self._timeout)
             sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            sock.settimeout(None)  # reader thread blocks; per-op timeout below
+        # The auth handshake runs synchronously BEFORE the reader thread
+        # exists, on the same buffered reader the thread will inherit (two
+        # makefiles would split buffered bytes). Nothing else can be in
+        # flight: the server sends no unsolicited frames pre-watch.
+        rfile = sock.makefile("rb")
+        if self._token:
+            sock.settimeout(self._timeout)
+            try:
+                sock.sendall(
+                    json.dumps(
+                        {"id": 0, "op": "auth", "args": {"token": self._token}}
+                    ).encode() + b"\n"
+                )
+                line = rfile.readline(_MAX_FRAME + 1)
+                reply = json.loads(line) if line.strip() else {}
+            except (OSError, ValueError) as e:
+                sock.close()
+                raise ConnectionError(f"store auth handshake failed: {e}")
+            if reply.get("ok") != "ok":
+                sock.close()
+                raise StoreAuthError(
+                    f"store at {self.address} rejected token: "
+                    f"{reply.get('msg', 'no reply')}"
+                )
+        sock.settimeout(None)  # reader thread blocks; per-op timeout in _call
         with self._send_lock:
             self._conn_epoch += 1
             epoch = self._conn_epoch
@@ -441,7 +554,9 @@ class RemoteStore:
             self._wfile = sock.makefile("wb")
         self._closed = threading.Event()
         self._reader = threading.Thread(
-            target=self._read_loop, args=(sock, self._closed, epoch), daemon=True
+            target=self._read_loop,
+            args=(rfile, self._closed, epoch),
+            daemon=True,
         )
         self._reader.start()
 
@@ -458,15 +573,36 @@ class RemoteStore:
                 )
             if not self._closed.is_set():
                 return  # another caller already reconnected
-            # stale watch handles can never receive again; drop them (their
-            # sentinels were delivered by the dead reader)
-            self._watches.clear()
+            # Drop only handles that rode the DYING connection (or earlier).
+            # A handle just registered by a concurrent watch() whose
+            # subscribe RPC will ride the NEW connection must survive this
+            # prune: clearing indiscriminately here made the first
+            # re-established watch after a store-owner restart permanently
+            # deaf (the server streamed events the client silently dropped,
+            # and no sentinel ever ended the consumer's async-for). watch()
+            # additionally re-verifies its registration after the RPC, which
+            # covers the stamp-vs-prune race this filter cannot see. Each
+            # pruned handle gets an end marker HERE: the dying reader's own
+            # cleanup may run after this prune emptied the dict, in which
+            # case it delivers to nobody (a duplicate marker is skipped or
+            # terminal — both fine; a missing one hangs the consumer
+            # forever).
+            dead = self._conn_epoch
+            kept: dict[int, _RemoteWatch] = {}
+            for wid, w in self._watches.items():
+                if w._epoch > dead:
+                    kept[wid] = w
+                else:
+                    w._deliver(_EndOfWatch(dead))
+            self._watches = kept
             last: Exception | None = None
             for attempt in range(self._reconnect_attempts):
                 try:
                     self._connect()
                     log.info("served-store reconnected to %s", self.address)
                     return
+                except StoreAuthError:
+                    raise  # a wrong secret does not become right by retrying
                 except OSError as e:
                     last = e
                     time.sleep(self._reconnect_backoff * (2 ** attempt))
@@ -475,11 +611,8 @@ class RemoteStore:
                 f"{self._reconnect_attempts} attempts: {last}"
             )
 
-    def _read_loop(
-        self, sock: socket.socket, closed: threading.Event, epoch: int
-    ) -> None:
+    def _read_loop(self, f, closed: threading.Event, epoch: int) -> None:
         try:
-            f = sock.makefile("rb")
             while True:
                 line = f.readline(_MAX_FRAME + 1)  # bounded (see _Conn)
                 if not line or len(line) > _MAX_FRAME or not line.endswith(b"\n"):
@@ -506,8 +639,11 @@ class RemoteStore:
                 if slot.get("epoch") == epoch:
                     slot["event"].set()
             for w in list(self._watches.values()):
-                if getattr(w, "_epoch", epoch) == epoch:
-                    w._deliver(_RemoteWatch._SENTINEL)
+                if w._epoch <= epoch:
+                    # epoch-stamped: if the handle later realigns to a newer
+                    # connection (watch() racing this death), the consumer
+                    # skips this marker as stale instead of going deaf-ended
+                    w._deliver(_EndOfWatch(epoch))
 
     def _on_watch_event(self, msg: dict[str, Any]) -> None:
         w = self._watches.get(int(msg["watch"]))
@@ -521,13 +657,18 @@ class RemoteStore:
         w._deliver(ev)
 
     def _call(self, op: str, **args: Any) -> Any:
+        return self._call_ex(op, **args)[0]
+
+    def _call_ex(self, op: str, **args: Any) -> tuple[Any, int]:
         # At-most-once with lazy reconnect: a dead connection is revived
         # BEFORE sending, and a send that fails outright is retried once on
         # a fresh connection (the op never reached the server). A reply
         # lost MID-FLIGHT is NOT retried — the server may have executed the
         # mutation, and a blind replay would turn e.g. create into a bogus
         # AlreadyExists; the caller (level-triggered reconcilers) owns
-        # semantic recovery, and the next _call reconnects.
+        # semantic recovery, and the next _call reconnects. Returns the
+        # payload AND the connection epoch the op actually rode — watch()
+        # needs the latter to align its handle with the carrying connection.
         for attempt in (0, 1):
             if self._closed.is_set():
                 self._reconnect()  # raises ConnectionError when hopeless
@@ -565,7 +706,7 @@ class RemoteStore:
             if "err" in reply:
                 exc = _ERRORS.get(reply["err"], RuntimeError)
                 raise exc(reply.get("msg", reply["err"]))
-            return reply.get("ok")
+            return reply.get("ok"), slot["epoch"]
 
     # -- Store API -------------------------------------------------------
 
@@ -636,21 +777,41 @@ class RemoteStore:
     ) -> _RemoteWatch:
         if isinstance(kinds, str):
             kinds = [kinds]
-        # register BEFORE the RPC: the server subscribes before replying,
-        # so an event can be in flight ahead of the reply frame — the
-        # reader thread must already know this wid or the event is lost
         with self._pending_lock:
             self._wid += 1
             wid = self._wid
         w = _RemoteWatch(self, wid)
-        w._epoch = self._conn_epoch
-        self._watches[wid] = w
-        try:
-            self._call("watch", kinds=sorted(kinds), namespace=namespace, wid=wid)
-        except BaseException:
-            self._watches.pop(wid, None)
-            raise
-        return w
+        # register BEFORE the RPC: the server subscribes before replying,
+        # so an event can be in flight ahead of the reply frame — the
+        # reader thread must already know this wid or the event is lost.
+        # But a concurrent _reconnect (ours via _call, or another thread's)
+        # can prune the registration before the subscribe rides the NEW
+        # connection, leaving the server streaming events nobody hears with
+        # no sentinel to end the consumer's async-for. So after the RPC,
+        # verify the handle survived on the epoch that carried the
+        # subscribe; if not, tear the orphan subscription down and redo it.
+        for _ in range(3):
+            if self._closed.is_set():
+                self._reconnect()
+            w._epoch = self._conn_epoch
+            self._watches[wid] = w
+            try:
+                _, rode = self._call_ex(
+                    "watch", kinds=sorted(kinds), namespace=namespace, wid=wid
+                )
+            except BaseException:
+                self._watches.pop(wid, None)
+                raise
+            w._epoch = rode  # align with the connection that carries events
+            if self._watches.get(wid) is w:
+                return w
+            try:
+                self._call("unwatch", wid=wid)
+            except (ConnectionError, TimeoutError):
+                pass
+        raise ConnectionError(
+            f"could not establish a stable watch against {self.address}"
+        )
 
     def _stop_watch(self, w: _RemoteWatch) -> None:
         self._watches.pop(w.wid, None)

@@ -45,6 +45,10 @@ class OperatorOptions:
     # across processes — a surviving replica adopts a dead one's tasks.
     store_address: Optional[str] = None
     serve_store: Optional[str] = None
+    # Shared secret for the served-store socket, both sides: the serving
+    # replica requires it from every client, a joining replica presents it.
+    # Empty = no auth (unix:// 0600 sockets or isolated loopback only).
+    store_token: str = ""
     identity: str = "acp-tpu-0"
     leader_election: bool = False
     api_port: int = 8082
@@ -87,7 +91,9 @@ class Operator:
     ):
         self.options = options or OperatorOptions()
         if store is None and self.options.store_address:
-            store = RemoteStore(self.options.store_address)
+            store = RemoteStore(
+                self.options.store_address, token=self.options.store_token or None
+            )
         self.store = store or Store(
             SqliteBackend(self.options.db_path) if self.options.db_path else None
         )
@@ -95,7 +101,11 @@ class Operator:
         if self.options.serve_store:
             if not isinstance(self.store, Store):
                 raise ValueError("serve_store requires this replica to own a local Store")
-            self.store_server = StoreServer(self.store, self.options.serve_store)
+            self.store_server = StoreServer(
+                self.store,
+                self.options.serve_store,
+                token=self.options.store_token or None,
+            )
         self.tracer = tracer or Tracer()
         self.mcp_manager = MCPManager(self.store)
         self.human_backend = LocalHumanBackend()

@@ -184,6 +184,77 @@ def test_closed_connection_raises_connection_error(served):
         remote.get("Task", "anything")
 
 
+def test_store_token_handshake(tmp_path):
+    """The served socket carries Secrets and Lease writes, so with a server
+    token: right token = full API, wrong token = refused without retry,
+    no token = one error reply then the connection is dropped."""
+    import os as _os
+
+    from agentcontrolplane_tpu.kernel import StoreAuthError
+
+    store = Store()
+    path = f"{tmp_path}/auth.sock"
+    server = StoreServer(store, f"unix://{path}", token="s3cret").start()
+    try:
+        assert (_os.stat(path).st_mode & 0o777) == 0o600  # owner-only socket
+
+        ok = RemoteStore(server.address, timeout=5.0, token="s3cret")
+        ok.create(_task("t1"))
+        assert ok.get("Task", "t1").metadata.name == "t1"
+        ok.close()
+
+        with pytest.raises(StoreAuthError):
+            RemoteStore(server.address, timeout=5.0, token="wrong")
+
+        anon = RemoteStore(server.address, timeout=5.0)
+        with pytest.raises((StoreAuthError, ConnectionError, TimeoutError)):
+            anon.get("Task", "t1")
+        anon.close()
+    finally:
+        server.stop()
+
+
+async def test_store_token_watch_streams(tmp_path):
+    """Watches work over an authenticated connection, including after the
+    reconnect path re-runs the handshake."""
+    address = f"unix://{tmp_path}/authwatch.sock"
+    store = Store()
+    server = StoreServer(store, address, token="tok").start()
+    remote = RemoteStore(address, timeout=10.0, reconnect_backoff=0.05, token="tok")
+    try:
+        w = remote.watch("Task")
+        store.create(_task("t1"))
+        ev = await w.next(timeout=5.0)
+        assert ev is not None and ev.object.metadata.name == "t1"
+
+        server.stop()
+        assert await w.next(timeout=5.0) is None  # sentinel
+        server = StoreServer(store, address, token="tok").start()
+
+        w2 = remote.watch("Task")  # reconnects + re-authenticates
+        store.create(_task("t2"))
+        ev = await w2.next(timeout=5.0)
+        assert ev is not None and ev.object.metadata.name == "t2"
+        w2.stop()
+    finally:
+        remote.close()
+        server.stop()
+
+
+def test_tokenless_server_accepts_token_client(tmp_path):
+    """Rolling a token out: a client already configured with the secret can
+    still talk to a replica that has not restarted with one yet."""
+    store = Store()
+    server = StoreServer(store, f"unix://{tmp_path}/mixed.sock").start()
+    try:
+        remote = RemoteStore(server.address, timeout=5.0, token="early")
+        remote.create(_task("t1"))
+        assert store.get("Task", "t1").metadata.name == "t1"
+        remote.close()
+    finally:
+        server.stop()
+
+
 def test_tcp_transport(tmp_path):
     store = Store()
     server = StoreServer(store, "tcp://127.0.0.1:0").start()
@@ -239,6 +310,105 @@ async def test_remote_store_close_disables_reconnect(tmp_path):
         with pytest.raises((ConnectionError, OSError)):
             remote.get("Task", "anything")
     finally:
+        server.stop()
+
+
+async def test_first_rewatch_after_restart_is_not_deaf(tmp_path):
+    """When watch() is the FIRST RPC after the store owner dies, its own
+    _call performs the reconnect. The old reconnect path cleared the just-
+    registered handle, so the server streamed events the client silently
+    dropped and no sentinel ever arrived — the first re-established watch
+    was permanently deaf. It must stream."""
+    address = f"unix://{tmp_path}/deaf.sock"
+    store = Store()
+    server = StoreServer(store, address).start()
+    remote = RemoteStore(address, timeout=10.0, reconnect_backoff=0.05)
+    try:
+        remote.create(_task("t1"))
+        w0 = remote.watch("Task")
+        server.stop()
+        # sentinel proves the reader died and _closed is set, so the next
+        # watch() really is the call that reconnects
+        assert await w0.next(timeout=5.0) is None
+        server = StoreServer(store, address).start()
+
+        w1 = remote.watch("Task")
+        store.create(_task("t2"))
+        ev = await w1.next(timeout=5.0)
+        assert ev is not None and ev.object.metadata.name == "t2"
+        w1.stop()
+    finally:
+        remote.close()
+        server.stop()
+
+
+async def test_reconnect_prunes_only_stale_epoch_watches(tmp_path):
+    """The reconnect prune must be epoch-scoped: a handle stamped for the
+    NEW connection (a concurrent watch() racing the reconnect) survives,
+    while handles that rode the dead connection are dropped."""
+    import asyncio
+
+    from agentcontrolplane_tpu.kernel.served import _RemoteWatch
+
+    address = f"unix://{tmp_path}/prune.sock"
+    store = Store()
+    server = StoreServer(store, address).start()
+    remote = RemoteStore(address, timeout=10.0, reconnect_backoff=0.05)
+    try:
+        w_old = remote.watch("Task")
+        server.stop()
+        for _ in range(100):
+            if remote._closed.is_set():
+                break
+            await asyncio.sleep(0.05)
+        assert remote._closed.is_set()
+        server = StoreServer(store, address).start()
+
+        future_handle = _RemoteWatch(remote, 999)
+        future_handle._epoch = remote._conn_epoch + 1
+        remote._watches[999] = future_handle
+        # a handle that rode the dead connection but was registered after
+        # the reader's cleanup ran: ONLY the prune can end it
+        stale_handle = _RemoteWatch(remote, 998)
+        stale_handle._epoch = remote._conn_epoch
+        remote._watches[998] = stale_handle
+
+        assert remote.ping()  # triggers the reconnect + prune
+        assert 999 in remote._watches, "future-epoch handle must survive"
+        assert w_old.wid not in remote._watches, "dead-conn handle pruned"
+        assert 998 not in remote._watches
+        # the prune itself must deliver the end marker — a pruned-but-never-
+        # ended watch would hang its consumer forever
+        assert stale_handle.queue.qsize() == 1
+        assert await stale_handle.next(timeout=1.0) is None
+        w_old.stop()
+    finally:
+        remote.close()
+        server.stop()
+
+
+async def test_stale_end_marker_does_not_end_realigned_watch(tmp_path):
+    """A watch whose subscribe rode a NEWER connection than a queued end
+    marker must skip the marker and keep streaming (the marker belongs to a
+    connection the handle outlived)."""
+    from agentcontrolplane_tpu.kernel.served import _EndOfWatch
+
+    address = f"unix://{tmp_path}/stale.sock"
+    store = Store()
+    server = StoreServer(store, address).start()
+    remote = RemoteStore(address, timeout=10.0)
+    try:
+        w = remote.watch("Task")
+        w._deliver(_EndOfWatch(w._epoch - 1))  # stale: from an older epoch
+        store.create(_task("t1"))
+        ev = await w.next(timeout=5.0)
+        assert ev is not None and ev.object.metadata.name == "t1"
+        # a current-epoch marker still ends it
+        w._deliver(_EndOfWatch(w._epoch))
+        assert await w.next(timeout=5.0) is None
+        w.stop()
+    finally:
+        remote.close()
         server.stop()
 
 
