@@ -120,7 +120,15 @@ def cmd_engine_follower(args) -> int:
     if _jax.process_count() > 1 and _jax.process_index() == 0:
         print("error: rank 0 runs `acp-tpu run`, not engine-follower", file=sys.stderr)
         return 2
-    coordination = CoordinationFollower(args.coordinator)
+    from .engine.coordination import client_ssl_context
+
+    ca = os.environ.get("ACP_COORD_TLS_CA", "")
+    coordination = CoordinationFollower(
+        args.coordinator,
+        rank=_jax.process_index(),
+        token=os.environ.get("ACP_COORD_TOKEN", "") or None,
+        ssl_context=client_ssl_context(ca) if ca else None,
+    )
     engine = _build_engine(args, coordination)
     engine.start()
     print(f"engine follower serving: {runtime_info()}", flush=True)
@@ -163,8 +171,27 @@ def cmd_run(args) -> int:
                     "engine-follower`, not `run`", file=sys.stderr,
                 )
                 return 2
+            from .engine.coordination import server_ssl_context
+
+            bind = os.environ.get("ACP_COORD_BIND", "0.0.0.0:8091")
+            token = os.environ.get("ACP_COORD_TOKEN", "")
+            cert = os.environ.get("ACP_COORD_TLS_CERT", "")
+            key = os.environ.get("ACP_COORD_TLS_KEY", "")
+            bind_host = bind.rpartition(":")[0]
+            if not token and bind_host not in ("127.0.0.1", "localhost", "::1"):
+                # the frame stream carries every request's prompt token ids,
+                # and any raw connector would count toward lockstep
+                print(
+                    "error: serving coordination on a non-loopback interface "
+                    f"({bind}) requires ACP_COORD_TOKEN (and ideally "
+                    "ACP_COORD_TLS_CERT/KEY); set ACP_COORD_BIND=127.0.0.1:8091 "
+                    "for single-host use", file=sys.stderr,
+                )
+                return 2
             coordination = CoordinationLeader(
-                bind=os.environ.get("ACP_COORD_BIND", "0.0.0.0:8091")
+                bind=bind,
+                token=token or None,
+                ssl_context=server_ssl_context(cert, key) if cert and key else None,
             )
             # a wildcard bind is not a routable --coordinator target;
             # print this host's name in its place

@@ -30,6 +30,15 @@ connect) — host-network traffic, like jax.distributed's own gRPC
 coordinator. A follower that cannot produce the next frame within
 ``recv_timeout`` treats the cluster as dead and crashes its engine (the
 global dispatch would hang anyway).
+
+Security: a connection only counts as a follower after a HELLO frame
+carrying the follower's jax process rank and (when the leader was given
+one) a shared token — a stray TCP connector must be able neither to
+satisfy ``wait_for_followers`` (lockstep would then hang or diverge) nor
+to receive the frame stream, which carries every request's prompt token
+ids. Optional TLS (``server_ssl_context``/``client_ssl_context``) gives
+the channel the REST surface's encryption posture; the token alone
+authenticates but does not encrypt.
 """
 
 from __future__ import annotations
@@ -40,14 +49,37 @@ import logging
 import socket
 import struct
 import threading
-from typing import Any
+from typing import Any, Optional
 
 from ..observability.metrics import REGISTRY
+from ..utils.tokens import token_matches
 
 log = logging.getLogger("acp_tpu.engine.coordination")
 
 _LEN = struct.Struct("!I")
 _MAX_FRAME = 64 * 1024 * 1024
+_MAX_HELLO = 4096
+
+
+def server_ssl_context(cert_path: str, key_path: str):
+    """TLS context for the leader's listening socket."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(cert_path, key_path)
+    return ctx
+
+
+def client_ssl_context(ca_path: str):
+    """TLS context for followers: CA-pinned, hostname-free (clusters dial
+    leaders by IP/rank, not DNS names the cert could carry)."""
+    import ssl
+
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_CLIENT)
+    ctx.load_verify_locations(ca_path)
+    ctx.check_hostname = False
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    return ctx
 
 
 def serialize_request(req) -> dict[str, Any]:
@@ -94,8 +126,18 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 class CoordinationLeader:
     """Rank 0's side: accepts follower connections and publishes frames."""
 
-    def __init__(self, bind: str = "0.0.0.0:0", expected_followers: int = 0):
+    def __init__(
+        self,
+        bind: str = "0.0.0.0:0",
+        expected_followers: int = 0,
+        token: Optional[str] = None,
+        ssl_context=None,
+        handshake_timeout: float = 30.0,
+    ):
         host, _, port = bind.rpartition(":")
+        self._token = token or None
+        self._ssl = ssl_context
+        self._handshake_timeout = handshake_timeout
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host or "0.0.0.0", int(port or 0)))
@@ -115,10 +157,55 @@ class CoordinationLeader:
                 conn, _ = self._sock.accept()
             except OSError:
                 return
+            # handshake in its own thread: a stalled or hostile peer mid-TLS
+            # or mid-hello must not block other followers from joining
+            threading.Thread(
+                target=self._admit, args=(conn,), daemon=True
+            ).start()
+
+    def _admit(self, conn: socket.socket) -> None:
+        """Verify the HELLO frame; only then does the connection count as a
+        follower (wait_for_followers tallies authenticated peers ONLY)."""
+        rank = None
+        try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            with self._lock:
-                self._followers.append(conn)
-            log.info("coordination follower joined (%d)", len(self._followers))
+            # timeout BEFORE the TLS wrap: wrap_socket performs the whole
+            # handshake, and the wrapped socket inherits this timeout — a
+            # peer that connects and sends nothing must not pin this thread
+            # and its fd forever
+            conn.settimeout(self._handshake_timeout)
+            if self._ssl is not None:
+                conn = self._ssl.wrap_socket(conn, server_side=True)
+            n = _LEN.unpack(_recv_exact(conn, _LEN.size))[0]
+            if n > _MAX_HELLO:
+                raise ConnectionError(f"oversized hello ({n} bytes)")
+            hello = json.loads(_recv_exact(conn, n)).get("hello") or {}
+            if self._token is not None and not token_matches(
+                str(hello.get("token", "")), self._token
+            ):
+                raise ConnectionError("bad coordination token")
+            rank = hello.get("rank")
+            if not isinstance(rank, int) or rank < 1:
+                raise ConnectionError(f"invalid follower rank {rank!r}")
+            _send_frame(conn, json.dumps({"hello_ok": True}).encode())
+            conn.settimeout(None)
+        except (OSError, ValueError, ConnectionError) as e:
+            log.warning("coordination connection rejected: %s", e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        with self._lock:
+            if self._stopped:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._followers.append(conn)
+            joined = len(self._followers)
+        log.info("coordination follower rank %d joined (%d)", rank, joined)
 
     def wait_for_followers(self, n: int, timeout: float = 120.0) -> None:
         import time
@@ -184,9 +271,20 @@ class CoordinationFollower:
     """A non-zero rank's side: receives the frame stream in order."""
 
     def __init__(self, address: str, connect_timeout: float = 120.0,
-                 recv_timeout: float = 600.0):
+                 recv_timeout: float = 600.0, rank: Optional[int] = None,
+                 token: Optional[str] = None, ssl_context=None):
         import time
 
+        if rank is None:
+            # the follower's identity in the hello frame is its jax process
+            # rank; outside a multi-process runtime (single-proc tests that
+            # play follower in the same process) any rank >= 1 is honest
+            try:
+                import jax
+
+                rank = jax.process_index() or 1
+            except Exception:
+                rank = 1
         host, _, port = address.rpartition(":")
         deadline = time.monotonic() + connect_timeout
         while True:
@@ -203,6 +301,29 @@ class CoordinationFollower:
                     raise
                 time.sleep(0.1)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if ssl_context is not None:
+            self._sock = ssl_context.wrap_socket(self._sock, server_hostname=host)
+        # hello: prove the token and identify the rank; the leader only
+        # counts this connection as a follower after verifying the frame
+        self._sock.settimeout(min(30.0, recv_timeout))
+        _send_frame(
+            self._sock,
+            json.dumps({"hello": {"rank": int(rank), "token": token or ""}}).encode(),
+        )
+        try:
+            n = _LEN.unpack(_recv_exact(self._sock, _LEN.size))[0]
+            if n > _MAX_HELLO:
+                raise ConnectionError("oversized hello reply")
+            reply = json.loads(_recv_exact(self._sock, n))
+        except (OSError, ValueError, ConnectionError):
+            self._sock.close()
+            raise ConnectionError(
+                "coordination leader rejected the hello (wrong token, rank 0, "
+                "or a TLS/plaintext mismatch)"
+            )
+        if not reply.get("hello_ok"):
+            self._sock.close()
+            raise ConnectionError(f"coordination hello refused: {reply}")
         self._sock.settimeout(recv_timeout)
         self._next_seq = 0
 

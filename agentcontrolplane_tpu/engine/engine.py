@@ -1048,22 +1048,29 @@ class Engine:
                 # but cancelled here. Unpublishable cancels wait in
                 # _cancelled for a later frame; truly stale rids (request
                 # already finished) are pruned against the in-transit queue.
+                # Snapshot FIRST: cancel() adds rids from other threads with
+                # no lock, so every prune below must remove only rids this
+                # snapshot examined against liveness views taken AFTER it.
+                # The previous live-set intersection dropped a cancel that
+                # landed after the snapshots for a request submitted after
+                # the transit peek — that request then decoded to max_tokens
+                # uncancellable.
+                snapshot = set(self._cancelled)
                 published_live = {r.rid for r in self._waiting}
                 published_live.update(
                     sl.request.rid for sl in self._slots.values()
                 )
                 published_live.update(r.rid for r in drained)
-                pending = {r for r in self._cancelled if r in published_live}
-                self._cancelled.difference_update(pending)
+                pending = snapshot & published_live
                 with self._queue.mutex:
                     transit = {
                         r.rid for r in self._queue.queue if r is not None
                     }
-                # keep cancels that raced in AFTER the pending snapshot for
-                # requests that are (or just became) part of the published
-                # stream — they publish next frame; drop only truly stale
-                # rids referencing nothing live anywhere
-                self._cancelled &= transit | published_live
+                # pending publishes now; snapshot rids live nowhere are
+                # truly stale; anything cancel() added since the snapshot
+                # stays for the next iteration's examination
+                self._cancelled -= pending
+                self._cancelled -= snapshot - (transit | published_live)
                 # publish BEFORE applying, so a crash between the two can
                 # only lose work symmetrically (followers time out)
                 self._coordination.publish(
@@ -1097,12 +1104,17 @@ class Engine:
             # drain loses the cancel). Under coordination in-transit rids
             # are never in _applied_cancels, so the liveness rule is
             # identical on every rank.
+            # snapshot-then-subtract, NOT a live intersection: single-host
+            # _applied_cancels IS _cancelled, which cancel() mutates from
+            # other threads — an intersection drops a cancel added after the
+            # liveness views for a request still in transit
+            snapshot = set(self._applied_cancels)
             live = {r.rid for r in self._waiting}
             live.update(sl.request.rid for sl in self._slots.values())
             if self._coordination is None:
                 with self._queue.mutex:
                     live.update(r.rid for r in self._queue.queue if r is not None)
-            self._applied_cancels &= live
+            self._applied_cancels -= snapshot - live
 
         if held:
             if not self._slots:

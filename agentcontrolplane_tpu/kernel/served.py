@@ -31,7 +31,6 @@ watches end, and its level-triggered reconcilers resync on reconnect).
 
 from __future__ import annotations
 
-import hmac
 import json
 import logging
 import os
@@ -46,6 +45,7 @@ from ..api.resources import from_doc
 from .errors import AlreadyExists, Conflict, Invalid, NotFound
 from .store import Store, Watch, WatchEvent, _current_loop
 from ..observability.metrics import REGISTRY
+from ..utils.tokens import token_matches
 
 log = logging.getLogger("acp_tpu.served")
 
@@ -206,12 +206,8 @@ class _Conn:
     def _dispatch(self, op: str, a: dict[str, Any]) -> Any:
         store = self.server.store
         if op == "auth":
-            # constant-time compare on BYTES — compare_digest on str raises
-            # TypeError for non-ASCII, which would lock out replicas holding
-            # the CORRECT secret (same pitfall server/rest.py avoids)
-            supplied = str(a.get("token", "")).encode("utf-8", "surrogateescape")
-            if self.server.token is not None and not hmac.compare_digest(
-                supplied, self.server.token.encode("utf-8", "surrogateescape")
+            if self.server.token is not None and not token_matches(
+                str(a.get("token", "")), self.server.token
             ):
                 raise _Unauthorized("bad store token")
             self.authed = True

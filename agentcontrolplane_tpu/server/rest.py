@@ -73,17 +73,15 @@ def _auth_middleware(token: str):
     standalone stand-in for the reference's authn/authz-filtered serving
     posture (acp/cmd/main.go:167-206). Enabled when a token is configured
     (--api-token / ACP_API_TOKEN); default off for localhost dev."""
-    import hmac
+    from ..utils.tokens import token_matches
 
-    expected = f"Bearer {token}".encode()
+    expected = f"Bearer {token}"
 
     @web.middleware
     async def middleware(request: web.Request, handler):
         if request.path not in _UNAUTHENTICATED_PATHS:
-            supplied = request.headers.get("Authorization", "")
-            # compare bytes: compare_digest on str raises on non-ASCII input
-            if not hmac.compare_digest(
-                supplied.encode("utf-8", "surrogateescape"), expected
+            if not token_matches(
+                request.headers.get("Authorization", ""), expected
             ):
                 return _json_error(401, "unauthorized")
         return await handler(request)
@@ -145,6 +143,7 @@ class RestServer:
         self.app = web.Application(middlewares=middlewares)
         self._register_routes()
         self._runner: Optional[web.AppRunner] = None
+        self._site: Optional[web.TCPSite] = None
         self.bound_port: Optional[int] = None
         # TLS posture (acp/cmd/main.go:118-166 parity): cert+key => HTTPS,
         # client CA => verified client certs (mTLS). The context is built
@@ -177,9 +176,12 @@ class RestServer:
 
     async def _tls_reload_loop(self) -> None:
         """Cert-watcher parity (acp/cmd/main.go:124-136): rotated cert/key
-        files are picked up for NEW handshakes without a restart. Reloading
-        into the live SSLContext is safe — in-flight connections keep their
-        session; only new handshakes see the new chain."""
+        files are picked up for NEW handshakes without a restart. A FRESH
+        SSLContext is built and the listener swapped to it — reloading into
+        the live context would be additive for the client-CA trust store
+        (``load_verify_locations`` never unloads), so a rotated-OUT client
+        CA would keep passing mTLS until restart. In-flight connections
+        keep their session; the accept gap during the swap is a few ms."""
         interval = float(os.environ.get("ACP_TLS_RELOAD_INTERVAL_S", "30"))
         while True:
             await asyncio.sleep(interval)
@@ -188,14 +190,45 @@ class RestServer:
             except OSError:
                 continue  # mid-rotation; retry next tick
             if mtimes != self._tls_mtimes and self._ssl_context is not None:
-                cert, key, client_ca = self._tls_paths  # type: ignore[misc]
                 try:
-                    self._ssl_context.load_cert_chain(cert, key)
-                    if client_ca:
-                        self._ssl_context.load_verify_locations(client_ca)
-                    self._tls_mtimes = mtimes
+                    new_ctx = self._build_ssl_context()
                 except (OSError, ssl.SSLError):
                     continue  # partial rotation; keep serving the old chain
+                try:
+                    await self._swap_listener(new_ctx)
+                except (OSError, RuntimeError):
+                    continue  # swap failed; mtimes stay stale so we retry
+                self._ssl_context = new_ctx
+                self._tls_mtimes = mtimes
+
+    async def _swap_listener(self, new_ctx: ssl.SSLContext) -> None:
+        """Stop the listening socket and re-bind it with the new context.
+        Existing connections are owned by the runner and survive; only the
+        accept loop restarts. Failure handling matters: a site whose
+        start() failed must never be left in self._site (its stop() raises
+        RuntimeError and would kill the reload loop), and losing the bind
+        entirely must fall back to re-binding with the OLD context rather
+        than leaving the server refusing all new connections."""
+        if self._runner is None or self.bound_port is None:
+            return
+        port = self.bound_port
+        if self._site is not None:
+            await self._site.stop()
+            self._site = None  # never retain a stopped/unstarted site
+        site = web.TCPSite(self._runner, self.host, port, ssl_context=new_ctx)
+        try:
+            await site.start()
+        except OSError:
+            fallback = web.TCPSite(
+                self._runner, self.host, port, ssl_context=self._ssl_context
+            )
+            try:
+                await fallback.start()
+                self._site = fallback
+            except OSError:
+                pass  # _site stays None; the next tick re-attempts the bind
+            raise
+        self._site = site
 
     def _register_routes(self) -> None:
         r = self.app.router
@@ -234,11 +267,11 @@ class RestServer:
         re-acquisition (see kernel.runtime._leader_gated_runner)."""
         self._runner = web.AppRunner(self.app)
         await self._runner.setup()
-        site = web.TCPSite(
+        self._site = web.TCPSite(
             self._runner, self.host, self.port, ssl_context=self._ssl_context
         )
-        await site.start()
-        self.bound_port = site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
+        await self._site.start()
+        self.bound_port = self._site._server.sockets[0].getsockname()[1]  # type: ignore[union-attr]
         reloader = (
             asyncio.ensure_future(self._tls_reload_loop())
             if self._ssl_context is not None

@@ -1,3 +1,4 @@
 from .logging import setup_logging
+from .tokens import token_matches
 
-__all__ = ["setup_logging"]
+__all__ = ["setup_logging", "token_matches"]

@@ -1,0 +1,19 @@
+"""Shared-secret comparison used by every authenticated socket surface
+(REST bearer middleware, served-store handshake, serving-coordination
+hello). One implementation so a hardening change cannot silently miss a
+surface."""
+
+from __future__ import annotations
+
+import hmac
+
+
+def token_matches(supplied: str, expected: str) -> bool:
+    """Constant-time equality on BYTES — ``hmac.compare_digest`` on str
+    raises TypeError for non-ASCII input, which would reject the CORRECT
+    secret (surrogateescape keeps even undecodable env-var bytes
+    comparable)."""
+    return hmac.compare_digest(
+        supplied.encode("utf-8", "surrogateescape"),
+        expected.encode("utf-8", "surrogateescape"),
+    )

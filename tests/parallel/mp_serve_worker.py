@@ -71,10 +71,14 @@ def main() -> None:
     if nproc == 1:
         coordination = None
     elif pid == 0:
-        coordination = CoordinationLeader(bind=f"127.0.0.1:{coord_port}")
+        coordination = CoordinationLeader(
+            bind=f"127.0.0.1:{coord_port}", token="mp-secret"
+        )
         coordination.wait_for_followers(nproc - 1, timeout=120.0)
     else:
-        coordination = CoordinationFollower(f"127.0.0.1:{coord_port}")
+        coordination = CoordinationFollower(
+            f"127.0.0.1:{coord_port}", rank=pid, token="mp-secret"
+        )
 
     engine = build_engine(mesh, coordination)
     engine.start()

@@ -92,6 +92,104 @@ def _engine(mesh, coordination=None):
     )
 
 
+def _self_signed_cert(tmp_path):
+    """Self-signed cert+key for the coordination TLS leg (the follower pins
+    the same cert as its CA)."""
+    import datetime
+
+    from cryptography import x509
+    from cryptography.hazmat.primitives import hashes, serialization
+    from cryptography.hazmat.primitives.asymmetric import rsa
+    from cryptography.x509.oid import NameOID
+
+    key = rsa.generate_private_key(public_exponent=65537, key_size=2048)
+    name = x509.Name([x509.NameAttribute(NameOID.COMMON_NAME, "acp-coord")])
+    cert = (
+        x509.CertificateBuilder()
+        .subject_name(name)
+        .issuer_name(name)
+        .public_key(key.public_key())
+        .serial_number(x509.random_serial_number())
+        .not_valid_before(datetime.datetime.utcnow() - datetime.timedelta(days=1))
+        .not_valid_after(datetime.datetime.utcnow() + datetime.timedelta(days=1))
+        .sign(key, hashes.SHA256())
+    )
+    cert_path = tmp_path / "coord.crt"
+    key_path = tmp_path / "coord.key"
+    cert_path.write_bytes(cert.public_bytes(serialization.Encoding.PEM))
+    key_path.write_bytes(
+        key.private_bytes(
+            serialization.Encoding.PEM,
+            serialization.PrivateFormat.TraditionalOpenSSL,
+            serialization.NoEncryption(),
+        )
+    )
+    return str(cert_path), str(key_path)
+
+
+def test_follower_handshake_gates_admission():
+    """Only a peer that completes the HELLO (rank + token) counts as a
+    follower: a stray TCP connector must neither satisfy
+    wait_for_followers nor receive frames, and a wrong token is refused."""
+    leader = CoordinationLeader(bind="127.0.0.1:0", token="sekrit",
+                               handshake_timeout=3.0)
+    host, _, port = leader.address.rpartition(":")
+    try:
+        stray = socket.create_connection((host, int(port)))
+        with pytest.raises(TimeoutError):
+            leader.wait_for_followers(1, timeout=1.0)
+        stray.close()
+
+        with pytest.raises(ConnectionError):
+            CoordinationFollower(
+                leader.address, rank=1, token="wrong",
+                connect_timeout=5.0, recv_timeout=5.0,
+            )
+
+        fol = CoordinationFollower(leader.address, rank=1, token="sekrit")
+        leader.wait_for_followers(1, timeout=10.0)
+        leader.publish([], ["cancel-1"])
+        frame = fol.recv()
+        assert frame["seq"] == 0 and frame["cancels"] == ["cancel-1"]
+        fol.close()
+    finally:
+        leader.close()
+
+
+def test_coordination_over_tls(tmp_path):
+    """The frame channel with the REST surface's encryption posture: TLS +
+    token; a plaintext client cannot join a TLS leader."""
+    from agentcontrolplane_tpu.engine.coordination import (
+        client_ssl_context,
+        server_ssl_context,
+    )
+
+    cert, key = _self_signed_cert(tmp_path)
+    leader = CoordinationLeader(
+        bind="127.0.0.1:0", token="sekrit",
+        ssl_context=server_ssl_context(cert, key), handshake_timeout=3.0,
+    )
+    try:
+        fol = CoordinationFollower(
+            leader.address, rank=1, token="sekrit",
+            ssl_context=client_ssl_context(cert),
+        )
+        leader.wait_for_followers(1, timeout=10.0)
+        leader.publish([], [], hold=True)
+        leader.publish([], [], stop=True)
+        assert fol.recv()["hold"] is True
+        assert fol.recv()["stop"] is True
+        fol.close()
+
+        with pytest.raises((ConnectionError, OSError)):
+            CoordinationFollower(
+                leader.address, rank=1, token="sekrit",
+                connect_timeout=5.0, recv_timeout=5.0,
+            )
+    finally:
+        leader.close()
+
+
 def test_follower_replays_leader_stream_identically():
     """One process, two engines: the follower consumes only the frame
     stream, yet generates the same token count and drains to idle — the

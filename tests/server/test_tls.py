@@ -159,6 +159,61 @@ async def test_mtls_requires_client_cert(tmp_path):
             assert resp.status == 200
 
 
+async def test_client_ca_rotation_revokes_old_ca(tmp_path, monkeypatch):
+    """Rotating the client CA must REPLACE trust, not extend it: a fresh
+    context is swapped into the listener, because load_verify_locations on
+    a live SSLContext is additive and would keep trusting the rotated-out
+    CA until restart."""
+    monkeypatch.setenv("ACP_TLS_RELOAD_INTERVAL_S", "0.1")
+    ca1_cert, _, ca1_obj, ca1_key = _make_cert(tmp_path, "ca1", "acp-ca1", is_ca=True)
+    ca2_cert, _, ca2_obj, ca2_key = _make_cert(tmp_path, "ca2", "acp-ca2", is_ca=True)
+    cert, key, *_ = _make_cert(tmp_path, "server", "acp-tpu")
+    c1_cert, c1_key, *_ = _make_cert(
+        tmp_path, "c1", "client-1", issuer_key=ca1_key, issuer_cert=ca1_obj
+    )
+    c2_cert, c2_key, *_ = _make_cert(
+        tmp_path, "c2", "client-2", issuer_key=ca2_key, issuer_cert=ca2_obj
+    )
+    client_ca = tmp_path / "client-ca.pem"
+    client_ca.write_bytes(ca1_cert.read_bytes())
+    async with TLSHarness(
+        tmp_path,
+        tls_cert_path=str(cert),
+        tls_key_path=str(key),
+        tls_client_ca_path=str(client_ca),
+    ) as h:
+        async with aiohttp.ClientSession() as http:
+            r = await http.get(
+                f"{h.base}/healthz", ssl=_client_ssl(cert, c1_cert, c1_key)
+            )
+            assert r.status == 200
+
+        client_ca.write_bytes(ca2_cert.read_bytes())  # rotate CA1 -> CA2
+
+        ok2 = False
+        for _ in range(100):  # wait for the reload tick to swap the listener
+            async with aiohttp.ClientSession() as http:
+                try:
+                    r = await http.get(
+                        f"{h.base}/healthz", ssl=_client_ssl(cert, c2_cert, c2_key)
+                    )
+                    ok2 = r.status == 200
+                except aiohttp.ClientError:
+                    ok2 = False
+            if ok2:
+                break
+            await asyncio.sleep(0.1)
+        assert ok2, "rotated-in client CA was never accepted"
+
+        # the rotated-OUT CA must fail a FRESH handshake (new session = no
+        # pooled connection to ride)
+        async with aiohttp.ClientSession() as http:
+            with pytest.raises(aiohttp.ClientError):
+                await http.get(
+                    f"{h.base}/healthz", ssl=_client_ssl(cert, c1_cert, c1_key)
+                )
+
+
 async def test_cert_rotation_without_restart(tmp_path, monkeypatch):
     """Cert-watcher parity: overwrite the cert/key files; new handshakes
     pick up the rotated chain without a server restart."""
