@@ -525,6 +525,11 @@ class LeaseSpec(APIModel):
     lease_duration_seconds: float = 30.0
     acquire_time: float = 0.0
     renew_time: float = 0.0
+    # fencing token (k8s leaseTransitions analogue): bumped every time the
+    # lease changes hands, NEVER on renewal. A write fenced on (holder,
+    # epoch) is rejected once a new holder adopts — a deposed-but-alive
+    # leader's in-flight writes cannot land on a stale view.
+    epoch: int = 0
 
 
 class Lease(Resource):

@@ -3,13 +3,16 @@ from .events import EventRecorder
 from .queue import WorkQueue
 from .runtime import LeaderElector, Manager, Reconciler, Result, map_owner
 from .served import RemoteStore, StoreAuthError, StoreServer
-from .store import Backend, MemoryBackend, SqliteBackend, Store, Watch, WatchEvent, wait_for
+from .store import (
+    Backend, FencedStore, MemoryBackend, SqliteBackend, Store, Watch,
+    WatchEvent, wait_for,
+)
 from . import lease
 
 __all__ = [
     "AlreadyExists", "Conflict", "Invalid", "NotFound", "StoreError",
     "EventRecorder", "WorkQueue", "LeaderElector", "Manager", "Reconciler",
     "Result", "map_owner", "RemoteStore", "StoreAuthError", "StoreServer", "Backend",
-    "MemoryBackend", "SqliteBackend", "Store", "Watch", "WatchEvent",
+    "FencedStore", "MemoryBackend", "SqliteBackend", "Store", "Watch", "WatchEvent",
     "wait_for", "lease",
 ]

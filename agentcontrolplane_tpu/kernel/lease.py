@@ -26,7 +26,22 @@ def try_acquire(
     ttl: float = 30.0,
     now: float | None = None,
 ) -> bool:
-    """Attempt to acquire/renew the lease. Returns True iff held by ``holder``.
+    """Attempt to acquire/renew the lease. Returns True iff held by ``holder``."""
+    return try_acquire_epoch(store, name, holder, namespace, ttl, now) is not None
+
+
+def try_acquire_epoch(
+    store: Store,
+    name: str,
+    holder: str,
+    namespace: str = "default",
+    ttl: float = 30.0,
+    now: float | None = None,
+) -> int | None:
+    """Attempt to acquire/renew the lease. Returns the lease EPOCH iff held
+    by ``holder`` afterwards, else None. The epoch is the fencing token:
+    bumped on every change of holder (create = 1), stable across renewals —
+    see Store fencing (``store.create(..., fence=...)``).
 
     Semantics mirror acquireTaskLease (task/state_machine.go:1069-1132):
     - absent        -> create, acquired
@@ -45,52 +60,61 @@ def try_acquire(
                 lease_duration_seconds=ttl,
                 acquire_time=now,
                 renew_time=now,
+                epoch=1,
             ),
         )
         try:
             store.create(lease)
-            return True
+            return 1
         except AlreadyExists:
-            return False
+            return None
 
     assert isinstance(existing, Lease)
     spec = existing.spec
     expired = now - spec.renew_time > spec.lease_duration_seconds
     if spec.holder_identity == holder or expired:
+        takeover = spec.holder_identity != holder
+        epoch = spec.epoch + 1 if takeover else spec.epoch
         existing.spec = LeaseSpec(
             holder_identity=holder,
             lease_duration_seconds=ttl,
-            acquire_time=now if spec.holder_identity != holder else spec.acquire_time,
+            acquire_time=now if takeover else spec.acquire_time,
             renew_time=now,
+            epoch=epoch,
         )
         try:
             store.update(existing)
-            return True
+            return epoch
         except (Conflict, NotFound):
-            return False
-    return False
+            return None
+    return None
 
 
 def release(store: Store, name: str, holder: str, namespace: str = "default") -> None:
-    """Delete the lease if held by ``holder`` (best-effort).
+    """Relinquish the lease if held by ``holder`` (best-effort).
 
-    The delete is guarded by the observed resource_version: if the holder
-    outlived the TTL and another replica adopted the expired lease between
-    our get and delete, the precondition fails (Conflict) and the new
-    holder's lease survives — otherwise a third replica could acquire while
-    the adopter's work is still in flight."""
+    The Lease object is KEPT (holder cleared, renew_time zeroed so any
+    replica can adopt immediately) rather than deleted: deleting would
+    reset the epoch counter to 1 on the next create, and a fencing token
+    minted before an earlier deposition could validate again — epochs must
+    be monotonic for the lifetime of the lease name. The update is
+    CAS-guarded by the object's resource_version: if another replica
+    adopted between our get and write, the write Conflicts and the new
+    holder's lease survives untouched."""
     try:
         lease = store.get("Lease", name, namespace)
     except NotFound:
         return
     assert isinstance(lease, Lease)
     if lease.spec.holder_identity == holder:
+        lease.spec = LeaseSpec(
+            holder_identity="",
+            lease_duration_seconds=lease.spec.lease_duration_seconds,
+            acquire_time=lease.spec.acquire_time,
+            renew_time=0.0,
+            epoch=lease.spec.epoch,
+        )
         try:
-            store.delete(
-                "Lease",
-                name,
-                namespace,
-                resource_version=lease.metadata.resource_version,
-            )
+            store.update(lease)
         except (NotFound, Conflict):
             pass

@@ -91,13 +91,33 @@ class LeaderElector:
         self._ttl = ttl
         self._renew = renew_interval
         self.is_leader = False
+        # fencing token: the lease epoch under which we currently lead
+        # (None while not leading). Bumped by the lease on every change of
+        # holder, so a token minted before a deposition can never validate.
+        self.epoch: Optional[int] = None
         self._task: Optional[asyncio.Task] = None
+
+    def fence(self) -> Optional[dict]:
+        """The current fencing token for store mutations, or None when not
+        leading. Read at CALL time by FencedStore so every leader-gated
+        write carries the freshest view this replica has."""
+        epoch = self.epoch
+        if not self.is_leader or epoch is None:
+            return None
+        return {
+            "name": self._lease_name,
+            "namespace": self._namespace,
+            "holder": self.identity,
+            "epoch": epoch,
+        }
 
     async def _run(self) -> None:
         while True:
-            self.is_leader = leaselib.try_acquire(
+            epoch = leaselib.try_acquire_epoch(
                 self._store, self._lease_name, self.identity, self._namespace, self._ttl
             )
+            self.epoch = epoch
+            self.is_leader = epoch is not None
             await asyncio.sleep(self._renew)
 
     def start(self) -> None:
@@ -113,6 +133,7 @@ class LeaderElector:
         if self.is_leader:
             leaselib.release(self._store, self._lease_name, self.identity, self._namespace)
             self.is_leader = False
+            self.epoch = None
 
 
 Runnable = Callable[[], Awaitable[None]]
@@ -137,6 +158,18 @@ class Manager:
         self.elector = LeaderElector(store, self.identity) if leader_election else None
         self._started = False
         self._stopping = False
+
+    def fenced_store(self):
+        """A Store view for leader-gated work: every mutation carries the
+        elector's current fencing token and is rejected by the store once
+        another replica adopts the election lease (see Store._check_fence).
+        Falls back to the raw store when leader election is off — a single
+        replica has nobody to be fenced against."""
+        if self.elector is None:
+            return self.store
+        from .store import FencedStore
+
+        return FencedStore(self.store, self.elector.fence)
 
     def add_controller(
         self,

@@ -219,7 +219,7 @@ class _Conn:
         if op == "ping":
             return "pong"
         if op == "create":
-            return _doc(store.create(from_doc(a["doc"])))
+            return _doc(store.create(from_doc(a["doc"]), fence=a.get("fence")))
         if op == "get":
             return _doc(store.get(a["kind"], a["name"], a.get("namespace", "default")))
         if op == "list":
@@ -230,13 +230,14 @@ class _Conn:
                 )
             ]
         if op == "update":
-            return _doc(store.update(from_doc(a["doc"])))
+            return _doc(store.update(from_doc(a["doc"]), fence=a.get("fence")))
         if op == "update_status":
-            return _doc(store.update_status(from_doc(a["doc"])))
+            return _doc(store.update_status(from_doc(a["doc"]), fence=a.get("fence")))
         if op == "delete":
             store.delete(
                 a["kind"], a["name"], a.get("namespace", "default"),
                 resource_version=a.get("resource_version"),
+                fence=a.get("fence"),
             )
             return None
         if op == "phase_counts":
@@ -706,8 +707,8 @@ class RemoteStore:
 
     # -- Store API -------------------------------------------------------
 
-    def create(self, obj: Resource) -> Resource:
-        return from_doc(self._call("create", doc=_doc(obj)))
+    def create(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
+        return from_doc(self._call("create", doc=_doc(obj), fence=fence))
 
     def get(self, kind: str, name: str, namespace: str = "default") -> Resource:
         return from_doc(self._call("get", kind=kind, name=name, namespace=namespace))
@@ -729,11 +730,11 @@ class RemoteStore:
         )
         return [from_doc(d) for d in docs]
 
-    def update(self, obj: Resource) -> Resource:
-        return from_doc(self._call("update", doc=_doc(obj)))
+    def update(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
+        return from_doc(self._call("update", doc=_doc(obj), fence=fence))
 
-    def update_status(self, obj: Resource) -> Resource:
-        return from_doc(self._call("update_status", doc=_doc(obj)))
+    def update_status(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
+        return from_doc(self._call("update_status", doc=_doc(obj), fence=fence))
 
     def delete(
         self,
@@ -741,10 +742,11 @@ class RemoteStore:
         name: str,
         namespace: str = "default",
         resource_version: Optional[int] = None,
+        fence: Optional[dict] = None,
     ) -> None:
         self._call(
             "delete", kind=kind, name=name, namespace=namespace,
-            resource_version=resource_version,
+            resource_version=resource_version, fence=fence,
         )
 
     def phase_counts(self) -> dict[tuple[str, str], int]:

@@ -173,6 +173,11 @@ class Store:
         self._backend = backend or MemoryBackend()
         self._lock = threading.RLock()
         self._objects: dict[Key, dict[str, Any]] = {}
+        # owner-clock timestamp of each Lease's last write: the fence expiry
+        # check compares against THIS clock, not the holder-written
+        # spec.renew_time — cross-host clock skew larger than the TTL would
+        # otherwise permanently fence out a live leader renewing over RPC
+        self._lease_touched: dict[Key, float] = {}
         self._watchers: list[_Watcher] = []
         self._subscribers: list[tuple[Callable[[str, dict[str, Any]], None],
                                       Optional[frozenset[str]], Optional[str]]] = []
@@ -232,14 +237,52 @@ class Store:
     def _doc(obj: Resource) -> dict[str, Any]:
         return json.loads(obj.model_dump_json())
 
+    # -- fencing ---------------------------------------------------------
+
+    def _check_fence(self, fence: Optional[dict]) -> None:
+        """Reject a mutation whose fencing token is stale. ``fence`` is
+        ``{"name", "namespace", "holder", "epoch"}`` naming an election
+        Lease; the check runs under the store lock, so it is atomic with
+        the write it guards — a deposed-but-alive leader (renew missed, GC
+        pause) whose in-flight write arrives after a new holder adopted the
+        lease observes Conflict instead of landing on a stale view. Lease
+        semantics: ``lease.try_acquire_epoch`` bumps ``spec.epoch`` on every
+        change of holder and never on renewal."""
+        if fence is None:
+            return
+        key = ("Lease", fence.get("namespace", "default"), fence["name"])
+        doc = self._objects.get(key)
+        if doc is None:
+            raise Conflict(f"fencing: election lease {key} is gone")
+        spec = doc.get("spec") or {}
+        if spec.get("holder_identity") != fence.get("holder"):
+            raise Conflict(
+                f"fencing: lease {key} now held by "
+                f"{spec.get('holder_identity')!r}, not {fence.get('holder')!r}"
+            )
+        if spec.get("epoch") != fence.get("epoch"):
+            raise Conflict(
+                f"fencing: lease {key} epoch {spec.get('epoch')} != "
+                f"token epoch {fence.get('epoch')}"
+            )
+        # expiry on the OWNER's clock: when did THIS store last see the
+        # lease written? The holder-written renew_time is another host's
+        # clock and skew > ttl would fence a live leader out permanently.
+        # After an owner restart no write has been seen yet; fall back to
+        # the spec timestamp until the first renew (< renew_interval away).
+        touched = self._lease_touched.get(key, spec.get("renew_time", 0))
+        if time.time() - touched > spec.get("lease_duration_seconds", 0):
+            raise Conflict(f"fencing: election lease {key} has expired")
+
     # -- CRUD ------------------------------------------------------------
 
-    def create(self, obj: Resource) -> Resource:
+    def create(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
         if not obj.kind:
             raise Invalid("object has no kind")
         if not obj.metadata.name:
             raise Invalid("object has no name")
         with self._lock:
+            self._check_fence(fence)
             key = obj.key
             if key in self._objects:
                 raise AlreadyExists(f"{key} already exists")
@@ -247,6 +290,8 @@ class Store:
             obj.metadata.generation = 1
             doc = self._doc(obj)
             self._objects[key] = doc
+            if obj.kind == "Lease":
+                self._lease_touched[key] = time.time()
             self._backend.put(doc, self._rv)
             self._notify("ADDED", doc)
         return from_doc(doc)
@@ -298,8 +343,11 @@ class Store:
         out.sort(key=lambda o: o.metadata.creation_timestamp)
         return out
 
-    def _update(self, obj: Resource, *, status_only: bool) -> Resource:
+    def _update(
+        self, obj: Resource, *, status_only: bool, fence: Optional[dict] = None
+    ) -> Resource:
         with self._lock:
+            self._check_fence(fence)
             key = obj.key
             cur = self._objects.get(key)
             if cur is None:
@@ -335,15 +383,17 @@ class Store:
                 self._rv -= 1
                 raise Invalid(f"invalid object state for {key}: {e}") from e
             self._objects[key] = new
+            if new.get("kind") == "Lease":
+                self._lease_touched[key] = time.time()
             self._backend.put(new, self._rv)
             self._notify("MODIFIED", new)
         return result
 
-    def update(self, obj: Resource) -> Resource:
-        return self._update(obj, status_only=False)
+    def update(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
+        return self._update(obj, status_only=False, fence=fence)
 
-    def update_status(self, obj: Resource) -> Resource:
-        return self._update(obj, status_only=True)
+    def update_status(self, obj: Resource, fence: Optional[dict] = None) -> Resource:
+        return self._update(obj, status_only=True, fence=fence)
 
     def delete(
         self,
@@ -351,12 +401,14 @@ class Store:
         name: str,
         namespace: str = "default",
         resource_version: Optional[int] = None,
+        fence: Optional[dict] = None,
     ) -> None:
         """Delete; with ``resource_version`` set, a precondition delete (k8s
         ``Preconditions.ResourceVersion``): raises Conflict if the stored
         object has moved on — used by lease release so a holder never deletes
         a lease another replica adopted after expiry."""
         with self._lock:
+            self._check_fence(fence)
             key = (kind, namespace, name)
             cur = self._objects.get(key)
             if cur is None:
@@ -370,6 +422,7 @@ class Store:
                     f"{cur['metadata']['resource_version']}"
                 )
             doc = self._objects.pop(key)
+            self._lease_touched.pop(key, None)
             self._backend.remove(key, self._rv)
             self._notify("DELETED", doc)
             self._gc_owned(doc["metadata"]["uid"])
@@ -488,3 +541,62 @@ async def wait_for(
         if time.monotonic() > deadline:
             raise TimeoutError(f"timed out waiting for {kind} {namespace}/{name}")
         await asyncio.sleep(poll)
+
+
+class FencedStore:
+    """A Store view whose every MUTATION carries a fencing token read from
+    ``fence_provider`` at call time (``None`` = not leader => immediate
+    Conflict). Leader-gated work (the REST server in multi-replica
+    deployments) writes through this view, so a deposed-but-alive leader's
+    in-flight writes are rejected by the store atomically with the check of
+    the election lease's holder+epoch — closing the window where a stale
+    leader could act for seconds on a poll-gated ``is_leader``. Reads and
+    watches pass through unfenced (serving a stale read is the same
+    exposure any cache has; only externally-visible mutation needs the
+    token)."""
+
+    def __init__(self, store, fence_provider: Callable[[], Optional[dict]]):
+        self._store = store
+        self._fence = fence_provider
+
+    def _require(self) -> dict:
+        fence = self._fence()
+        if fence is None:
+            raise Conflict("fencing: this replica is not the leader")
+        return fence
+
+    # -- fenced mutations -------------------------------------------------
+
+    def create(self, obj: Resource) -> Resource:
+        return self._store.create(obj, fence=self._require())
+
+    def update(self, obj: Resource) -> Resource:
+        return self._store.update(obj, fence=self._require())
+
+    def update_status(self, obj: Resource) -> Resource:
+        return self._store.update_status(obj, fence=self._require())
+
+    def delete(self, kind: str, name: str, namespace: str = "default",
+               resource_version: Optional[int] = None) -> None:
+        self._store.delete(kind, name, namespace,
+                           resource_version=resource_version,
+                           fence=self._require())
+
+    def mutate_status(self, kind: str, name: str, namespace: str,
+                      fn: Callable[[Resource], None], attempts: int = 3) -> Resource:
+        last: Exception | None = None
+        for _ in range(attempts):
+            obj = self._store.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update_status(obj)
+            except Conflict as e:
+                if "fencing" in str(e):
+                    raise  # deposed: retrying cannot help
+                last = e
+        raise last  # type: ignore[misc]
+
+    # -- reads/watches pass through --------------------------------------
+
+    def __getattr__(self, name: str):
+        return getattr(self._store, name)

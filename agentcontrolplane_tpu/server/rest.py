@@ -51,7 +51,7 @@ from ..api.resources import (
     Task,
     TaskSpec,
 )
-from ..kernel.errors import AlreadyExists, Invalid, NotFound
+from ..kernel.errors import AlreadyExists, Conflict, Invalid, NotFound
 from ..observability.metrics import REGISTRY
 from ..validation import generate_k8s_random_string, validate_task_message_input
 
@@ -66,6 +66,22 @@ def _json_error(status: int, message: str) -> web.Response:
 # health probes stay open (the reference likewise exempts healthz/readyz from
 # its metrics authn filter, acp/cmd/main.go:306-313)
 _UNAUTHENTICATED_PATHS = {"/healthz", "/readyz"}
+
+
+@web.middleware
+async def _error_middleware(request: web.Request, handler):
+    """Map kernel errors that escape a handler to proper statuses — in
+    particular a fencing Conflict from a deposed leader's FencedStore must
+    surface as 409, not a 500 with a traceback. Handlers that catch these
+    themselves are unaffected (this sees only what escapes)."""
+    try:
+        return await handler(request)
+    except (AlreadyExists, Conflict) as e:
+        return _json_error(409, str(e))
+    except NotFound as e:
+        return _json_error(404, str(e))
+    except Invalid as e:
+        return _json_error(400, str(e))
 
 
 def _auth_middleware(token: str):
@@ -132,7 +148,13 @@ def task_to_json(task: Task) -> dict[str, Any]:
 class RestServer:
     def __init__(self, operator: "Operator", host: str = "127.0.0.1", port: Optional[int] = None):
         self.operator = operator
-        self.store = operator.store
+        # Leader-gated serving writes through the FENCED view: once another
+        # replica adopts the election lease, this replica's in-flight REST
+        # mutations observe Conflict instead of landing on a stale
+        # leadership view (docs/distributed-locking.md, "Fencing").
+        # fenced_store() itself degrades to the raw store when leader
+        # election is off.
+        self.store = operator.manager.fenced_store()
         self.host = host
         self.port = port if port is not None else operator.options.api_port
         # options only — the CLI already defaults --api-token from
@@ -140,6 +162,7 @@ class RestServer:
         # on for embedded/test servers
         self.api_token = operator.options.api_token
         middlewares = [_auth_middleware(self.api_token)] if self.api_token else []
+        middlewares.append(_error_middleware)
         self.app = web.Application(middlewares=middlewares)
         self._register_routes()
         self._runner: Optional[web.AppRunner] = None
@@ -406,7 +429,12 @@ class RestServer:
                     self.store.delete(obj.kind, obj.metadata.name, obj.metadata.namespace)
                 except NotFound:
                     pass
-            status = 409 if isinstance(e, AlreadyExists) else 400
+                except Conflict:
+                    # deposed mid-create: the fenced cleanup cannot run
+                    # either; stop trying (remaining partials are inert —
+                    # no Agent references them) and report the deposition
+                    break
+            status = 409 if isinstance(e, (AlreadyExists, Conflict)) else 400
             return _json_error(status, str(e))
         return web.json_response({"name": name, "namespace": ns, "llm": llm.name}, status=201)
 
@@ -447,8 +475,6 @@ class RestServer:
     async def update_agent(self, request: web.Request) -> web.Response:
         """Partial update (server.go:970-1004): systemPrompt / description /
         mcpServers / subAgents; the agent controller revalidates."""
-        from ..kernel.errors import Conflict
-
         ns = request.query.get("namespace", "default")
         try:
             body = _strict_decode(

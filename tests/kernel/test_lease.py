@@ -33,10 +33,16 @@ def test_expired_lease_adopted(store):
 def test_release_only_by_holder(store):
     lease.try_acquire(store, "l", "pod-a", ttl=30, now=100.0)
     lease.release(store, "l", "pod-b")
-    assert store.try_get("Lease", "l") is not None
+    assert store.get("Lease", "l").spec.holder_identity == "pod-a"
     lease.release(store, "l", "pod-a")
-    assert store.try_get("Lease", "l") is None
+    # released = holder cleared but the object KEPT: deleting would reset
+    # the epoch and let a pre-deposition fencing token validate again
+    released = store.get("Lease", "l")
+    assert released.spec.holder_identity == ""
+    assert released.spec.epoch == 1
     lease.release(store, "l", "pod-a")  # idempotent
+    # the next acquisition (any holder) adopts immediately at a HIGHER epoch
+    assert lease.try_acquire_epoch(store, "l", "pod-b", ttl=30, now=101.0) == 2
 
 
 def test_release_does_not_delete_adopted_lease(store):
