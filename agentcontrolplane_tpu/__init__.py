@@ -29,4 +29,4 @@ Package layout:
 - ``train``      — sharded training/fine-tuning step (dp/tp/sp).
 """
 
-__version__ = "0.4.0"
+__version__ = "0.5.0"

@@ -1,24 +1,36 @@
-"""Operator replica subprocess for the two-process kill/adopt e2e.
+"""Operator subprocess for the multi-replica e2es (kill/adopt, owner RTO).
 
-Runs a FULL operator (all six controllers) against a RemoteStore served by
-the test process — one real OS process per replica, the topology the
-reference gets from N pods sharing one apiserver. The LLM is a mock whose
-latency comes from argv, so the test can hold replica A mid-``ReadyForLLM``
-(in-flight send, task-llm lease held) long enough to SIGKILL it.
+Runs a FULL operator (all six controllers) as one real OS process — the
+topology the reference gets from N pods sharing one apiserver. Two modes:
 
-Usage: python multireplica_worker.py <store-address> <identity> <delay_s> [lease_ttl]
+- ``--store ADDR``: a REPLICA joining a served store over RemoteStore;
+- ``--own DB ADDR``: the store OWNER — sqlite at DB, served at ADDR — so
+  the owner-kill/restart RTO e2e can SIGKILL the single sqlite writer.
+
+The LLM is a mock whose latency comes from argv, so a test can hold a
+replica mid-``ReadyForLLM`` (in-flight send, task-llm lease held) long
+enough to SIGKILL it.
+
+Usage: python multireplica_worker.py <identity> <delay_s> [lease_ttl]
+           (--store ADDR | --own DB ADDR)
 Prints "READY" once controllers are running; serves until killed.
 """
 
 from __future__ import annotations
 
+import argparse
 import asyncio
-import sys
 
 
 def main() -> None:
-    address, identity, delay_s = sys.argv[1], sys.argv[2], float(sys.argv[3])
-    lease_ttl = float(sys.argv[4]) if len(sys.argv) > 4 else 2.0
+    ap = argparse.ArgumentParser()
+    ap.add_argument("identity")
+    ap.add_argument("delay_s", type=float)
+    ap.add_argument("lease_ttl", nargs="?", type=float, default=2.0)
+    mode = ap.add_mutually_exclusive_group(required=True)
+    mode.add_argument("--store", metavar="ADDR")
+    mode.add_argument("--own", nargs=2, metavar=("DB", "ADDR"))
+    args = ap.parse_args()
 
     from agentcontrolplane_tpu.llmclient import (
         MockLLMClient,
@@ -29,21 +41,24 @@ def main() -> None:
 
     op = Operator(
         options=OperatorOptions(
-            store_address=address,
-            identity=identity,
+            store_address=args.store,
+            db_path=args.own[0] if args.own else None,
+            serve_store=args.own[1] if args.own else None,
+            identity=args.identity,
             enable_rest=False,
             llm_probe=False,
             verify_channel_credentials=False,
         ),
         llm_factory=MockLLMClientFactory(
             MockLLMClient(
-                default=assistant(f"answer from {identity}"), delay_s=delay_s
+                default=assistant(f"answer from {args.identity}"),
+                delay_s=args.delay_s,
             )
         ),
     )
     # fast cadence + short lease so adoption latency fits a test budget
     op.task_reconciler.requeue_delay = 0.05
-    op.task_reconciler.lease_ttl = lease_ttl
+    op.task_reconciler.lease_ttl = args.lease_ttl
     op.toolcall_reconciler.poll_interval = 0.05
 
     async def run() -> None:

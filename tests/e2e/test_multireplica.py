@@ -31,14 +31,14 @@ _WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "multireplica
 _REPO = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-async def _spawn_replica(
-    address: str, identity: str, delay_s: float, lease_ttl: float = 2.0
-) -> subprocess.Popen:
+async def _spawn_worker(extra_argv: list[str], identity: str) -> subprocess.Popen:
+    """Spawn a multireplica_worker process and wait (bounded) for READY;
+    the process is killed, not leaked, if startup fails or times out."""
     env = dict(os.environ)
     env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"  # replicas never touch the accelerator
     proc = subprocess.Popen(
-        [sys.executable, _WORKER, address, identity, str(delay_s), str(lease_ttl)],
+        [sys.executable, _WORKER, *extra_argv],
         stdout=subprocess.PIPE,
         stderr=subprocess.DEVNULL,
         text=True,
@@ -49,9 +49,22 @@ async def _spawn_replica(
         assert proc.stdout is not None
         return proc.stdout.readline()
 
-    line = await asyncio.wait_for(asyncio.to_thread(wait_ready), timeout=60.0)
-    assert line.strip() == "READY", f"replica {identity} failed to start: {line!r}"
+    try:
+        line = await asyncio.wait_for(asyncio.to_thread(wait_ready), timeout=60.0)
+        assert line.strip() == "READY", f"{identity} failed to start: {line!r}"
+    except BaseException:
+        proc.kill()  # also EOFs the orphaned readline thread on timeout
+        proc.wait(timeout=10)
+        raise
     return proc
+
+
+async def _spawn_replica(
+    address: str, identity: str, delay_s: float, lease_ttl: float = 2.0
+) -> subprocess.Popen:
+    return await _spawn_worker(
+        [identity, str(delay_s), str(lease_ttl), "--store", address], identity
+    )
 
 
 async def test_surviving_replica_adopts_killed_replicas_task(tmp_path):
@@ -117,6 +130,104 @@ async def test_surviving_replica_adopts_killed_replicas_task(tmp_path):
                 proc.wait(timeout=10)
         server.stop()
         store.close()
+
+
+async def test_store_owner_restart_under_load_measured_rto(tmp_path):
+    """Kill the store OWNER (the single sqlite writer) under a 64-task load,
+    restart it on the same db+socket, and require EVERY task to complete.
+    The measured stall window (kill -> first post-restart progress) is
+    printed so README's scaling-out section can cite a number. Reference
+    anchor: an apiserver/etcd outage, which controllers ride out by
+    re-list+re-watch (acp/docs/distributed-locking.md's etcd HA
+    assumption) — here the follower's RemoteStore reconnect + Manager
+    resync carry that contract."""
+    import time
+
+    db = str(tmp_path / "owner.db")
+    address = f"unix://{tmp_path}/owner.sock"
+
+    async def spawn_owner() -> subprocess.Popen:
+        return await _spawn_worker(
+            ["owner", "0.0", "2.0", "--own", db, address], "owner"
+        )
+
+    N = 64
+    owner = await spawn_owner()
+    follower = None
+    client = None
+    try:
+        from agentcontrolplane_tpu.kernel import Conflict, RemoteStore
+
+        client = RemoteStore(address, timeout=10.0, reconnect_backoff=0.1)
+        # unlike the bare-store tests above, the OWNER's controllers are
+        # already reconciling: our post-create status write can lose the rv
+        # race — fine, the owner's controllers mark readiness themselves
+        # (provider=mock needs no probe)
+        try:
+            make_llm(client, name="mock-llm", provider="mock")
+        except Conflict:
+            pass
+        try:
+            make_agent(client, name="agent", llm="mock-llm")
+        except Conflict:
+            pass
+        follower = await _spawn_replica(address, "follower", 0.0, lease_ttl=2.0)
+
+        for i in range(N):
+            make_task(client, name=f"load-{i}", agent="agent", user_message=f"task {i}")
+
+        def done_count() -> int:
+            try:
+                return sum(
+                    1 for t in client.list("Task")
+                    if t.status.phase == "FinalAnswer"
+                )
+            except (ConnectionError, TimeoutError):
+                return -1  # owner down; count unknown
+
+        # let the load get mid-flight (some done, most not), then kill
+        deadline = time.monotonic() + 60
+        while time.monotonic() < deadline:
+            n = done_count()
+            if n >= 3:
+                break
+            await asyncio.sleep(0.1)
+        pre_kill = done_count()
+        assert 0 < pre_kill < N, f"load finished too fast to test ({pre_kill}/{N})"
+
+        t_kill = time.monotonic()
+        owner.send_signal(signal.SIGKILL)
+        owner.wait(timeout=10)
+        await asyncio.sleep(0.5)  # a beat of real outage
+        owner = await spawn_owner()
+
+        # first post-restart progress = recovery point for the stall window
+        t_progress = None
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            n = done_count()
+            if t_progress is None and n > pre_kill:
+                t_progress = time.monotonic()
+            if n >= N:
+                break
+            await asyncio.sleep(0.1)
+        final = done_count()
+        assert final == N, f"only {final}/{N} tasks completed after owner restart"
+        assert t_progress is not None
+        stall = t_progress - t_kill
+        total = time.monotonic() - t_kill
+        print(f"RTO: stall_window={stall:.2f}s kill->all-done={total:.2f}s "
+              f"(pre-kill {pre_kill}/{N} complete)")
+        # generous bound: the point is a measured number, not a tight SLO —
+        # stall covers process restart + sqlite WAL resume + reconnects
+        assert stall < 60.0
+    finally:
+        if client is not None:
+            client.close()
+        for proc in (owner, follower):
+            if proc is not None and proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
 
 
 async def test_two_live_replicas_single_winner(tmp_path):
