@@ -217,6 +217,17 @@ def cmd_run(args) -> int:
     if args.store and (args.db or args.serve_store):
         raise SystemExit("--store joins a remote store; --db/--serve-store "
                          "belong to the replica that owns it")
+    if args.serve_store and args.serve_store.startswith("tcp://") and not args.store_token:
+        host = args.serve_store[len("tcp://"):].rpartition(":")[0]
+        if host not in ("127.0.0.1", "localhost", "::1"):
+            # same posture as the coordination channel: this socket grants
+            # full control-plane read/write (Secrets and Leases included)
+            raise SystemExit(
+                f"error: serving the store on a non-loopback interface "
+                f"({args.serve_store}) requires --store-token / "
+                f"$ACP_STORE_TOKEN; use unix:// or tcp://127.0.0.1 for "
+                f"token-less single-host setups"
+            )
     options = OperatorOptions(
         db_path=args.db,
         store_address=args.store,

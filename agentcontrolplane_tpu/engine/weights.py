@@ -195,6 +195,116 @@ def load_safetensors_dir(
     return params, config
 
 
+def write_synthetic_checkpoint(
+    path: str,
+    config: LlamaConfig,
+    seed: int = 0,
+    max_shard_bytes: int = 1 << 30,
+) -> int:
+    """Write a random-weight HF-format checkpoint (config.json +
+    sharded ``*.safetensors`` + ``model.safetensors.index.json``) with the
+    same tensor names, bf16 dtype, and shard layout a real Llama-3
+    checkpoint ships with (values are random). Exists to close the
+    no-egress verification gap — the load/quantize/shard path can be
+    exercised at full Llama-3-8B scale (~16 GiB on disk) without
+    downloading weights. Memory-bounded: one tensor generated at a time,
+    shards flushed at ``max_shard_bytes``. Returns total bytes written.
+
+    Plain Llama/Mistral architecture only: the qkv-bias (Qwen2), MoE
+    (Mixtral) and Gemma variants need extra/renamed tensors this
+    generator does not emit, and serving a silently wrong-shaped
+    checkpoint would be worse than refusing."""
+    import ml_dtypes
+    from safetensors.numpy import save_file
+
+    c = config
+    if c.qkv_bias or c.n_experts or c.head_dim_override is not None or c.norm_plus_one:
+        raise ValueError(
+            "write_synthetic_checkpoint supports the plain Llama/Mistral "
+            "architecture only (no qkv_bias / MoE experts / Gemma variants)"
+        )
+    hd = c.head_dim
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump({
+            "model_type": "llama",
+            "vocab_size": c.vocab_size,
+            "hidden_size": c.dim,
+            "num_hidden_layers": c.n_layers,
+            "num_attention_heads": c.n_heads,
+            "num_key_value_heads": c.n_kv_heads,
+            "intermediate_size": c.ffn_dim,
+            "rms_norm_eps": c.norm_eps,
+            "rope_theta": c.rope_theta,
+            "max_position_embeddings": c.max_seq_len,
+            "tie_word_embeddings": c.tie_embeddings,
+        }, f)
+
+    def tensor_plan():
+        # HF convention: linear weights are (out, in); norms are ones
+        yield "model.embed_tokens.weight", (c.vocab_size, c.dim), "normal"
+        for i in range(c.n_layers):
+            yield f"model.layers.{i}.self_attn.q_proj.weight", (c.n_heads * hd, c.dim), "normal"
+            yield f"model.layers.{i}.self_attn.k_proj.weight", (c.n_kv_heads * hd, c.dim), "normal"
+            yield f"model.layers.{i}.self_attn.v_proj.weight", (c.n_kv_heads * hd, c.dim), "normal"
+            yield f"model.layers.{i}.self_attn.o_proj.weight", (c.dim, c.n_heads * hd), "normal"
+            yield f"model.layers.{i}.mlp.gate_proj.weight", (c.ffn_dim, c.dim), "normal"
+            yield f"model.layers.{i}.mlp.up_proj.weight", (c.ffn_dim, c.dim), "normal"
+            yield f"model.layers.{i}.mlp.down_proj.weight", (c.dim, c.ffn_dim), "normal"
+            yield f"model.layers.{i}.input_layernorm.weight", (c.dim,), "ones"
+            yield f"model.layers.{i}.post_attention_layernorm.weight", (c.dim,), "ones"
+        yield "model.norm.weight", (c.dim,), "ones"
+        if not c.tie_embeddings:
+            yield "lm_head.weight", (c.vocab_size, c.dim), "normal"
+
+    rng = np.random.default_rng(seed)
+    shard: dict[str, np.ndarray] = {}
+    shard_bytes = 0
+    shard_files: list[str] = []  # temp names; renamed to -of- form at the end
+    weight_map: dict[str, int] = {}  # tensor -> shard ordinal
+    total = 0
+
+    def flush():
+        nonlocal shard, shard_bytes
+        if not shard:
+            return
+        fname = f"model-{len(shard_files) + 1:05d}.safetensors.tmp"
+        save_file(shard, os.path.join(path, fname))
+        shard_files.append(fname)
+        shard = {}
+        shard_bytes = 0
+
+    for name, shape, kind in tensor_plan():
+        if kind == "ones":
+            t = np.ones(shape, dtype=ml_dtypes.bfloat16)
+        else:
+            t = (rng.standard_normal(shape, dtype=np.float32) * 0.02).astype(
+                ml_dtypes.bfloat16
+            )
+        shard[name] = t
+        weight_map[name] = len(shard_files) + 1
+        shard_bytes += t.nbytes
+        total += t.nbytes
+        if shard_bytes >= max_shard_bytes:
+            flush()
+    flush()
+
+    # HF shard naming needs the total count, known only now; plus the
+    # index HF's own loader requires for sharded checkpoints
+    n = len(shard_files)
+    final = {
+        i + 1: f"model-{i + 1:05d}-of-{n:05d}.safetensors" for i in range(n)
+    }
+    for i, tmp in enumerate(shard_files):
+        os.replace(os.path.join(path, tmp), os.path.join(path, final[i + 1]))
+    with open(os.path.join(path, "model.safetensors.index.json"), "w") as f:
+        json.dump({
+            "metadata": {"total_size": total},
+            "weight_map": {k: final[v] for k, v in weight_map.items()},
+        }, f)
+    return total
+
+
 def sharded_init(
     config: LlamaConfig,
     key: jax.Array,

@@ -1,0 +1,41 @@
+#!/usr/bin/env python
+"""Generate a random-weight HF-format checkpoint shaped like a real one.
+
+Closes the no-egress verification gap: serve a FULL-SIZE Llama-3-8B-shaped
+checkpoint through `acp-tpu run --tpu-checkpoint` (load + int8 quantize +
+shard) without downloading weights.
+
+  python scripts/make_synthetic_checkpoint.py --preset llama3-8b --out /tmp/synth8b
+  acp-tpu run --tpu-checkpoint /tmp/synth8b --tpu-quantize int8
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--preset", default="llama3-8b")
+    ap.add_argument("--out", required=True)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--shard-bytes", type=int, default=1 << 30)
+    args = ap.parse_args()
+
+    from agentcontrolplane_tpu.engine.weights import write_synthetic_checkpoint
+    from agentcontrolplane_tpu.models.llama import PRESETS
+
+    t0 = time.monotonic()
+    total = write_synthetic_checkpoint(
+        args.out, PRESETS[args.preset], seed=args.seed,
+        max_shard_bytes=args.shard_bytes,
+    )
+    print(
+        f"wrote {total / 1e9:.2f} GB ({args.preset}-shaped) to {args.out} "
+        f"in {time.monotonic() - t0:.1f}s"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,147 @@
+"""Synthetic full-shape checkpoints (engine/weights.write_synthetic_checkpoint).
+
+The no-egress environment can never download real Llama-3 weights, so the
+load -> quantize -> shard -> serve path is exercised with generated
+checkpoints that are byte-format-identical to real ones (HF tensor names,
+bf16, multi-shard). CPU scale here; the full 16 GiB 8B run is the
+hardware-gated test below (ACP_TEST_TPU=1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from agentcontrolplane_tpu.engine.weights import (
+    load_safetensors_dir,
+    write_synthetic_checkpoint,
+)
+from agentcontrolplane_tpu.models.llama import PRESETS, LlamaConfig, forward
+
+# small but structurally honest: GQA (kv < heads), untied lm_head,
+# multi-shard at the chosen shard size
+SMALL = LlamaConfig(
+    vocab_size=512, dim=128, n_layers=3, n_heads=4, n_kv_heads=2,
+    ffn_dim=256, rope_theta=10000.0, max_seq_len=256, tie_embeddings=False,
+)
+
+
+def test_synthetic_checkpoint_round_trips(tmp_path):
+    import json
+
+    path = str(tmp_path / "synth")
+    total = write_synthetic_checkpoint(path, SMALL, max_shard_bytes=200_000)
+    shards = sorted(f for f in os.listdir(path) if f.endswith(".safetensors"))
+    assert len(shards) > 1, "must exercise the multi-shard load path"
+    # real HF wire format: -of- shard names + index with a full weight map
+    assert all(f"-of-{len(shards):05d}" in f for f in shards)
+    assert os.path.exists(os.path.join(path, "config.json"))
+    with open(os.path.join(path, "model.safetensors.index.json")) as f:
+        index = json.load(f)
+    assert index["metadata"]["total_size"] == total
+    assert set(index["weight_map"].values()) == set(shards)
+    on_disk = sum(
+        os.path.getsize(os.path.join(path, f)) for f in shards
+    )
+    assert on_disk >= total  # tensor bytes + safetensors headers
+
+    params, config = load_safetensors_dir(path)
+    assert config.dim == SMALL.dim and config.n_kv_heads == 2
+    logits = np.asarray(forward(params, jnp.ones((1, 8), dtype=jnp.int32), config))
+    assert np.all(np.isfinite(logits))
+
+
+def test_synthetic_checkpoint_refuses_variant_architectures(tmp_path):
+    import dataclasses as dc
+
+    for variant in (
+        dc.replace(SMALL, qkv_bias=True),
+        dc.replace(SMALL, n_experts=4),
+        dc.replace(SMALL, head_dim_override=64),
+    ):
+        with pytest.raises(ValueError, match="plain Llama"):
+            write_synthetic_checkpoint(str(tmp_path / "x"), variant)
+
+
+def test_synthetic_checkpoint_serves_through_engine(tmp_path):
+    """The whole CLI path minus argv: load (+int8 quantize) -> Engine ->
+    first token, exactly what `acp-tpu run --tpu-checkpoint X
+    --tpu-quantize int8` does."""
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.ops.quant import QuantizedTensor
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    path = str(tmp_path / "synth")
+    write_synthetic_checkpoint(path, SMALL, max_shard_bytes=200_000)
+    t0 = time.monotonic()
+    params, config = load_safetensors_dir(path, quantize="int8")
+    load_s = time.monotonic() - t0
+    assert isinstance(params["layers"]["wq"], QuantizedTensor)
+
+    engine = Engine(
+        config=config, params=params, tokenizer=ByteTokenizer(),
+        # tp=2: the synthetic config's 2 KV heads must divide the mesh
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=64, prefill_buckets=(32, 64),
+        decode_block_size=4, seed=0,
+    )
+    engine.start()
+    try:
+        result = engine.generate("hello", SamplingParams(temperature=0.0, max_tokens=4))
+        assert len(result.tokens) > 0
+    finally:
+        engine.stop()
+    assert load_s < 60
+
+
+@pytest.mark.skipif(
+    not os.environ.get("ACP_TEST_TPU"),
+    reason="set ACP_TEST_TPU=1 to run the full-size 8B leg on the real TPU",
+)
+def test_full_size_8b_synthetic_checkpoint_on_tpu():
+    """VERDICT r4 #7: generate a REAL-SIZE llama3-8b-shaped checkpoint
+    (~16 GiB), serve it int8-quantized on the chip, record load time and
+    first token. Cached under /tmp/tpu_runs so reruns skip the ~16 GiB
+    write."""
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+
+    path = "/tmp/tpu_runs/synth8b"
+    cfg = PRESETS["llama3-8b"]
+    if not os.path.exists(os.path.join(path, "config.json")):
+        t0 = time.monotonic()
+        total = write_synthetic_checkpoint(path, cfg)
+        print(f"synth 8B: wrote {total / 1e9:.1f} GB in {time.monotonic() - t0:.0f}s")
+
+    t0 = time.monotonic()
+    params, config = load_safetensors_dir(path, quantize="int8")
+    load_s = time.monotonic() - t0
+
+    # single chip: int8 8B (~8 GiB weights) fits a 16 GiB v5e
+    engine = Engine(
+        config=dataclasses.replace(config, max_seq_len=512),
+        params=params, tokenizer=ByteTokenizer(), quantize="int8",
+        max_slots=8, max_ctx=512, prefill_buckets=(128, 512),
+        decode_block_size=16, seed=0,
+    )
+    engine.start()
+    try:
+        t0 = time.monotonic()
+        result = engine.generate("hello", SamplingParams(temperature=0.0, max_tokens=8))
+        first_gen_s = time.monotonic() - t0
+        assert len(result.tokens) > 0
+        print(
+            f"synth 8B on TPU: load+quantize {load_s:.1f}s, "
+            f"first generate (incl. compile) {first_gen_s:.1f}s"
+        )
+    finally:
+        engine.stop()
