@@ -218,13 +218,27 @@ def write_synthetic_checkpoint(
     from safetensors.numpy import save_file
 
     c = config
-    if c.qkv_bias or c.n_experts or c.head_dim_override is not None or c.norm_plus_one:
+    if (
+        c.qkv_bias
+        or c.n_experts
+        or c.head_dim_override is not None
+        or c.norm_plus_one
+        or c.embed_scale
+        or c.hidden_act != "silu"
+    ):
         raise ValueError(
             "write_synthetic_checkpoint supports the plain Llama/Mistral "
-            "architecture only (no qkv_bias / MoE experts / Gemma variants)"
+            "architecture only (no qkv_bias / MoE experts / Gemma or "
+            "non-silu variants)"
         )
     hd = c.head_dim
     os.makedirs(path, exist_ok=True)
+    # a rerun into the same dir must not mix generations: the loader reads
+    # EVERY *.safetensors in the directory, so stale shards from a prior
+    # config/shard-size would silently blend into this checkpoint
+    for f in os.listdir(path):
+        if f.endswith(".safetensors") or f == "model.safetensors.index.json":
+            os.unlink(os.path.join(path, f))
     with open(os.path.join(path, "config.json"), "w") as f:
         json.dump({
             "model_type": "llama",
@@ -240,19 +254,28 @@ def write_synthetic_checkpoint(
             "tie_word_embeddings": c.tie_embeddings,
         }, f)
 
+    # per-key HF shapes (HF stores linear weights (out, in)); NAMES come
+    # from the loader's own _LAYER_MAP so generator/loader agreement is
+    # structural, not a coincidence of two hand-typed lists
+    hf_shape = {
+        "wq": (c.n_heads * hd, c.dim),
+        "wk": (c.n_kv_heads * hd, c.dim),
+        "wv": (c.n_kv_heads * hd, c.dim),
+        "wo": (c.dim, c.n_heads * hd),
+        "w1": (c.ffn_dim, c.dim),
+        "w3": (c.ffn_dim, c.dim),
+        "w2": (c.dim, c.ffn_dim),
+        "ln1": (c.dim,),
+        "ln2": (c.dim,),
+    }
+    assert set(hf_shape) == set(_LAYER_MAP), "shape table drifted from _LAYER_MAP"
+
     def tensor_plan():
-        # HF convention: linear weights are (out, in); norms are ones
         yield "model.embed_tokens.weight", (c.vocab_size, c.dim), "normal"
         for i in range(c.n_layers):
-            yield f"model.layers.{i}.self_attn.q_proj.weight", (c.n_heads * hd, c.dim), "normal"
-            yield f"model.layers.{i}.self_attn.k_proj.weight", (c.n_kv_heads * hd, c.dim), "normal"
-            yield f"model.layers.{i}.self_attn.v_proj.weight", (c.n_kv_heads * hd, c.dim), "normal"
-            yield f"model.layers.{i}.self_attn.o_proj.weight", (c.dim, c.n_heads * hd), "normal"
-            yield f"model.layers.{i}.mlp.gate_proj.weight", (c.ffn_dim, c.dim), "normal"
-            yield f"model.layers.{i}.mlp.up_proj.weight", (c.ffn_dim, c.dim), "normal"
-            yield f"model.layers.{i}.mlp.down_proj.weight", (c.dim, c.ffn_dim), "normal"
-            yield f"model.layers.{i}.input_layernorm.weight", (c.dim,), "ones"
-            yield f"model.layers.{i}.post_attention_layernorm.weight", (c.dim,), "ones"
+            for key, pattern in _LAYER_MAP.items():
+                kind = "ones" if key.startswith("ln") else "normal"
+                yield pattern.format(i=i), hf_shape[key], kind
         yield "model.norm.weight", (c.dim,), "ones"
         if not c.tie_embeddings:
             yield "lm_head.weight", (c.vocab_size, c.dim), "normal"

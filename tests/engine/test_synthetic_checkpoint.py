@@ -64,9 +64,31 @@ def test_synthetic_checkpoint_refuses_variant_architectures(tmp_path):
         dc.replace(SMALL, qkv_bias=True),
         dc.replace(SMALL, n_experts=4),
         dc.replace(SMALL, head_dim_override=64),
+        dc.replace(SMALL, hidden_act="gelu_tanh"),
+        dc.replace(SMALL, embed_scale=True),
     ):
         with pytest.raises(ValueError, match="plain Llama"):
             write_synthetic_checkpoint(str(tmp_path / "x"), variant)
+
+
+def test_rerun_does_not_mix_generations(tmp_path):
+    """The loader reads every *.safetensors in the dir, so a rerun with a
+    different shard size must fully replace the previous generation."""
+    import dataclasses as dc
+    import json
+
+    path = str(tmp_path / "synth")
+    write_synthetic_checkpoint(path, SMALL, max_shard_bytes=100_000)
+    many = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    # rerun: one big shard AND a smaller config — stale shards must be gone
+    smaller = dc.replace(SMALL, n_layers=2)
+    write_synthetic_checkpoint(path, smaller, max_shard_bytes=1 << 30)
+    now = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    assert len(now) == 1 and len(many) > 1
+    with open(os.path.join(path, "model.safetensors.index.json")) as f:
+        assert set(json.load(f)["weight_map"].values()) == set(now)
+    params, config = load_safetensors_dir(path)
+    assert config.n_layers == 2
 
 
 def test_synthetic_checkpoint_serves_through_engine(tmp_path):

@@ -44,6 +44,42 @@ def test_apply_create_then_configure(store):
     assert llm.status.ready  # status preserved by apply
 
 
+def test_every_example_manifest_applies(store):
+    """The examples/ gallery is a user-facing API surface: every file must
+    load and apply cleanly against the current schema."""
+    import glob
+
+    paths = sorted(glob.glob("examples/*.yaml"))
+    assert len(paths) >= 5
+    for path in paths:
+        resources = load_manifests(open(path).read())
+        assert resources, f"{path} contains no resources"
+        results = apply_resources(store, resources)
+        assert all(action in ("created", "configured") for action, _ in results), (
+            path, results,
+        )
+
+
+def test_run_refuses_tokenless_nonloopback_serve_store(monkeypatch):
+    """Security gate the release bundles rely on: serving the store
+    (Secrets + Leases read/write) on a non-loopback interface without a
+    token must refuse at startup, loudly."""
+    from agentcontrolplane_tpu.cli import main as cli_main
+
+    monkeypatch.delenv("ACP_STORE_TOKEN", raising=False)
+    with pytest.raises(SystemExit, match="store-token"):
+        cli_main(["run", "--serve-store", "tcp://0.0.0.0:8090"])
+    # loopback and unix stay token-optional — but must not be accepted by
+    # accident via the guard (they proceed past it; stop before the
+    # operator actually starts by failing fast on a bogus later flag)
+    monkeypatch.setenv("ACP_STORE_TOKEN", "s3cret")
+    # with a token the guard passes; a parse error on a later bad flag
+    # proves we got past it
+    with pytest.raises(SystemExit) as exc:
+        cli_main(["run", "--serve-store", "tcp://0.0.0.0:8090", "--no-such-flag"])
+    assert "store-token" not in str(exc.value)
+
+
 def test_manifest_validation_errors(store):
     with pytest.raises(Invalid, match="unknown kind"):
         resource_from_manifest({"kind": "Nope", "metadata": {"name": "x"}})
