@@ -48,7 +48,19 @@ def config_from_hf(config_path: str) -> LlamaConfig:
         hf = json.load(f)
     is_gemma = hf.get("model_type") == "gemma"
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
+    rs = hf.get("rope_scaling") or {}
+    rs_type = rs.get("rope_type") or rs.get("type")
+    if rs and rs_type != "llama3":
+        # linear/dynamic/yarn checkpoints would silently serve the wrong
+        # function — refuse at load, not at generation quality
+        raise ValueError(
+            f"unsupported rope_scaling type {rs_type!r} (only 'llama3')"
+        )
     return LlamaConfig(
+        rope_scaling_factor=float(rs.get("factor", 1.0)) if rs_type == "llama3" else 1.0,
+        rope_low_freq_factor=float(rs.get("low_freq_factor", 1.0)),
+        rope_high_freq_factor=float(rs.get("high_freq_factor", 4.0)),
+        rope_original_max_seq=int(rs.get("original_max_position_embeddings", 8192)),
         # Mixtral: routed experts replace the dense FFN
         n_experts=int(hf.get("num_local_experts", 0) or 0),
         experts_per_token=int(hf.get("num_experts_per_tok", 2) or 2),
@@ -239,20 +251,29 @@ def write_synthetic_checkpoint(
     for f in os.listdir(path):
         if f.endswith(".safetensors") or f == "model.safetensors.index.json":
             os.unlink(os.path.join(path, f))
+    hf_config: dict[str, Any] = {
+        "model_type": "llama",
+        "vocab_size": c.vocab_size,
+        "hidden_size": c.dim,
+        "num_hidden_layers": c.n_layers,
+        "num_attention_heads": c.n_heads,
+        "num_key_value_heads": c.n_kv_heads,
+        "intermediate_size": c.ffn_dim,
+        "rms_norm_eps": c.norm_eps,
+        "rope_theta": c.rope_theta,
+        "max_position_embeddings": c.max_seq_len,
+        "tie_word_embeddings": c.tie_embeddings,
+    }
+    if c.rope_scaling_factor != 1.0:  # llama3.1/3.2-style scaled checkpoints
+        hf_config["rope_scaling"] = {
+            "rope_type": "llama3",
+            "factor": c.rope_scaling_factor,
+            "low_freq_factor": c.rope_low_freq_factor,
+            "high_freq_factor": c.rope_high_freq_factor,
+            "original_max_position_embeddings": c.rope_original_max_seq,
+        }
     with open(os.path.join(path, "config.json"), "w") as f:
-        json.dump({
-            "model_type": "llama",
-            "vocab_size": c.vocab_size,
-            "hidden_size": c.dim,
-            "num_hidden_layers": c.n_layers,
-            "num_attention_heads": c.n_heads,
-            "num_key_value_heads": c.n_kv_heads,
-            "intermediate_size": c.ffn_dim,
-            "rms_norm_eps": c.norm_eps,
-            "rope_theta": c.rope_theta,
-            "max_position_embeddings": c.max_seq_len,
-            "tie_word_embeddings": c.tie_embeddings,
-        }, f)
+        json.dump(hf_config, f)
 
     # per-key HF shapes (HF stores linear weights (out, in)); NAMES come
     # from the loader's own _LAYER_MAP so generator/loader agreement is

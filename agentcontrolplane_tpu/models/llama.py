@@ -51,6 +51,14 @@ class LlamaConfig:
     ffn_dim: int = 14336
     norm_eps: float = 1e-5
     rope_theta: float = 500000.0
+    # Llama-3.1-style RoPE frequency rescale (HF rope_scaling.rope_type
+    # "llama3"): factor > 1 enables (8.0 for 3.1, 32.0 for 3.2); the other
+    # three follow the checkpoint config. Real 3.1/3.2 checkpoints are
+    # TRAINED with these — serving them unscaled is a different function.
+    rope_scaling_factor: float = 1.0
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
+    rope_original_max_seq: int = 8192
     max_seq_len: int = 8192
     tie_embeddings: bool = False
     qkv_bias: bool = False  # Qwen2-style attention input bias
@@ -84,6 +92,14 @@ class LlamaConfig:
 # llama3.2-1b matches meta-llama/Llama-3.2-1B(-Instruct).
 PRESETS: dict[str, LlamaConfig] = {
     "llama3-8b": LlamaConfig(),
+    # 3.1 = the 3-8B architecture + llama3 rope scaling to 128k context
+    "llama3.1-8b": LlamaConfig(
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_seq=8192,
+        max_seq_len=131072,
+    ),
     "llama3.2-1b": LlamaConfig(
         vocab_size=128256,
         dim=2048,
@@ -93,6 +109,9 @@ PRESETS: dict[str, LlamaConfig] = {
         ffn_dim=8192,
         rope_theta=500000.0,
         tie_embeddings=True,
+        rope_scaling_factor=32.0,
+        rope_original_max_seq=8192,
+        max_seq_len=131072,
     ),
     "llama3.2-3b": LlamaConfig(
         vocab_size=128256,
@@ -102,6 +121,9 @@ PRESETS: dict[str, LlamaConfig] = {
         n_kv_heads=8,
         ffn_dim=8192,
         tie_embeddings=True,
+        rope_scaling_factor=32.0,
+        rope_original_max_seq=8192,
+        max_seq_len=131072,
     ),
     # ~1.1B params — sized to fill a single v5e chip nicely at batch 64
     "bench-1b": LlamaConfig(
@@ -320,8 +342,14 @@ def _attn_mlp(
     q = q.reshape(B, T, c.n_heads, c.head_dim)
     k = k.reshape(B, T, c.n_kv_heads, c.head_dim)
     v = v.reshape(B, T, c.n_kv_heads, c.head_dim)
-    q = apply_rope(q, positions, c.rope_theta)
-    k = apply_rope(k, positions, c.rope_theta)
+    scaling = (
+        (c.rope_scaling_factor, c.rope_low_freq_factor,
+         c.rope_high_freq_factor, c.rope_original_max_seq)
+        if c.rope_scaling_factor != 1.0
+        else None
+    )
+    q = apply_rope(q, positions, c.rope_theta, scaling=scaling)
+    k = apply_rope(k, positions, c.rope_theta, scaling=scaling)
     attn = attn_fn(q, k, v)
     x = x + mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
     h = rms_norm(x, norm_w(layer["ln2"]), c.norm_eps)

@@ -21,14 +21,47 @@ def rope_frequencies(head_dim: int, theta: float) -> jax.Array:
     )
 
 
+def llama3_scale_frequencies(
+    inv_freq: jax.Array,
+    factor: float,
+    low_freq_factor: float,
+    high_freq_factor: float,
+    original_max_seq: int,
+) -> jax.Array:
+    """Llama-3.1's published RoPE frequency rescale (HF
+    ``rope_scaling.rope_type == "llama3"``): long wavelengths (beyond the
+    original context) are slowed by ``factor``, short ones kept, with a
+    smooth ramp between — how 3.1/3.2 checkpoints reach 128k context.
+    Serving those checkpoints with UNscaled frequencies computes a
+    different function than the one they were trained with."""
+    two_pi = 2.0 * jnp.pi
+    wavelen = two_pi / inv_freq
+    low_wavelen = original_max_seq / low_freq_factor
+    high_wavelen = original_max_seq / high_freq_factor
+    smooth = (original_max_seq / wavelen - low_freq_factor) / (
+        high_freq_factor - low_freq_factor
+    )
+    interpolated = (1.0 - smooth) * inv_freq / factor + smooth * inv_freq
+    return jnp.where(
+        wavelen < high_wavelen,
+        inv_freq,
+        jnp.where(wavelen > low_wavelen, inv_freq / factor, interpolated),
+    )
+
+
 def apply_rope(
     x: jax.Array,  # [..., T, H, d]
     positions: jax.Array,  # [..., T] int32
     theta: float = 500000.0,
+    scaling: "tuple[float, float, float, int] | None" = None,
 ) -> jax.Array:
-    """Rotate q or k by position. Computed in float32, cast back."""
+    """Rotate q or k by position. Computed in float32, cast back.
+    ``scaling`` = (factor, low_freq_factor, high_freq_factor,
+    original_max_seq) applies the Llama-3.1 frequency rescale."""
     d = x.shape[-1]
     inv_freq = rope_frequencies(d, theta)  # [d/2]
+    if scaling is not None:
+        inv_freq = llama3_scale_frequencies(inv_freq, *scaling)
     angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., T, d/2]
     cos = jnp.cos(angles)[..., None, :]  # [..., T, 1, d/2]
     sin = jnp.sin(angles)[..., None, :]

@@ -60,6 +60,64 @@ def test_logits_match_hf_reference(hf_model_and_params):
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_llama31_rope_scaling_matches_hf():
+    """Llama-3.1/3.2 checkpoints ship rope_scaling (rope_type 'llama3');
+    serving them with unscaled frequencies computes a different function
+    than they were trained with. Pin our scaled-rope forward against
+    transformers' implementation, with positions far enough past the
+    'original' context that all three frequency branches matter."""
+    import dataclasses
+
+    torch = pytest.importorskip("torch")
+    from transformers import LlamaConfig as HFConfig
+    from transformers import LlamaForCausalLM
+
+    scaled = dataclasses.replace(
+        TINY,
+        max_seq_len=256,
+        rope_scaling_factor=8.0,
+        rope_low_freq_factor=1.0,
+        rope_high_freq_factor=4.0,
+        rope_original_max_seq=32,  # tiny, so T=100 is deep into scaled range
+    )
+    hf_config = HFConfig(
+        vocab_size=scaled.vocab_size,
+        hidden_size=scaled.dim,
+        num_hidden_layers=scaled.n_layers,
+        num_attention_heads=scaled.n_heads,
+        num_key_value_heads=scaled.n_kv_heads,
+        intermediate_size=scaled.ffn_dim,
+        rms_norm_eps=scaled.norm_eps,
+        rope_theta=scaled.rope_theta,
+        max_position_embeddings=scaled.max_seq_len,
+        tie_word_embeddings=False,
+        attn_implementation="eager",
+        rope_scaling={
+            "rope_type": "llama3",
+            "factor": 8.0,
+            "low_freq_factor": 1.0,
+            "high_freq_factor": 4.0,
+            "original_max_position_embeddings": 32,
+        },
+    )
+    torch.manual_seed(7)
+    model = LlamaForCausalLM(hf_config).eval()
+    params = params_from_state_dict(model.state_dict(), scaled)
+    rng = np.random.default_rng(7)
+    tokens = rng.integers(0, scaled.vocab_size, size=(1, 100))
+    with torch.no_grad():
+        hf_logits = model(torch.tensor(tokens)).logits.numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, dtype=jnp.int32), scaled))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
+    # and the scaling genuinely changes the function (guards against the
+    # scaling silently not being applied on either side)
+    unscaled = dataclasses.replace(scaled, rope_scaling_factor=1.0)
+    ours_unscaled = np.asarray(
+        forward(params, jnp.asarray(tokens, dtype=jnp.int32), unscaled)
+    )
+    assert np.max(np.abs(ours - ours_unscaled)) > 1e-3
+
+
 def test_prefill_matches_forward(hf_model_and_params):
     _, params = hf_model_and_params
     rng = np.random.default_rng(1)

@@ -71,6 +71,30 @@ def test_synthetic_checkpoint_refuses_variant_architectures(tmp_path):
             write_synthetic_checkpoint(str(tmp_path / "x"), variant)
 
 
+def test_rope_scaling_round_trips_through_checkpoint(tmp_path):
+    """A llama3.1-style config (rope_scaling in config.json) must survive
+    generate -> load with the scaling intact, and non-llama3 scaling types
+    must be refused at load rather than silently mis-served."""
+    import dataclasses as dc
+    import json
+
+    scaled = dc.replace(
+        SMALL, rope_scaling_factor=8.0, rope_original_max_seq=64
+    )
+    path = str(tmp_path / "synth31")
+    write_synthetic_checkpoint(path, scaled)
+    _, config = load_safetensors_dir(path)
+    assert config.rope_scaling_factor == 8.0
+    assert config.rope_original_max_seq == 64
+
+    # YaRN / linear scaling types: refuse, don't serve the wrong function
+    cfg = json.load(open(os.path.join(path, "config.json")))
+    cfg["rope_scaling"] = {"rope_type": "yarn", "factor": 4.0}
+    json.dump(cfg, open(os.path.join(path, "config.json"), "w"))
+    with pytest.raises(ValueError, match="rope_scaling"):
+        load_safetensors_dir(path)
+
+
 def test_rerun_does_not_mix_generations(tmp_path):
     """The loader reads every *.safetensors in the dir, so a rerun with a
     different shard size must fully replace the previous generation."""
