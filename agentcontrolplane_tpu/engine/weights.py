@@ -245,13 +245,30 @@ def write_synthetic_checkpoint(
         )
     hd = c.head_dim
     os.makedirs(path, exist_ok=True)
-    # a rerun into the same dir must not mix generations: the loader reads
-    # EVERY *.safetensors in the directory, so stale shards from a prior
-    # config/shard-size would silently blend into this checkpoint
+    # A rerun into the same dir must not mix generations (the loader reads
+    # EVERY *.safetensors in the directory) — but NEVER clobber a real
+    # checkpoint: only a dir this generator marked (config.json carries
+    # "synthetic": true; unknown keys are ignored by config_from_hf) or a
+    # shard-free dir may be cleared. Deleting ~16 GiB of downloaded
+    # weights in a no-egress environment would be irreversible.
+    existing = [f for f in os.listdir(path) if f.endswith(".safetensors")]
+    if existing:
+        try:
+            with open(os.path.join(path, "config.json")) as f:
+                marked = bool(json.load(f).get("synthetic"))
+        except (OSError, json.JSONDecodeError):
+            marked = False
+        if not marked:
+            raise ValueError(
+                f"{path} contains safetensors shards not written by this "
+                "generator; refusing to overwrite a (possibly real) "
+                "checkpoint — pick an empty/new directory"
+            )
     for f in os.listdir(path):
         if f.endswith(".safetensors") or f == "model.safetensors.index.json":
             os.unlink(os.path.join(path, f))
     hf_config: dict[str, Any] = {
+        "synthetic": True,  # marks the dir as regenerable (see above)
         "model_type": "llama",
         "vocab_size": c.vocab_size,
         "hidden_size": c.dim,

@@ -537,6 +537,15 @@ class RemoteStore:
             except (OSError, ValueError) as e:
                 sock.close()
                 raise ConnectionError(f"store auth handshake failed: {e}")
+            if not line.strip():
+                # clean EOF mid-handshake = transport failure (owner
+                # restarting), NOT a rejected token — it must stay
+                # retryable or the reconnect loop aborts blaming a
+                # correct secret
+                sock.close()
+                raise ConnectionError(
+                    f"store at {self.address} closed during auth handshake"
+                )
             if reply.get("ok") != "ok":
                 sock.close()
                 raise StoreAuthError(

@@ -95,6 +95,21 @@ def test_rope_scaling_round_trips_through_checkpoint(tmp_path):
         load_safetensors_dir(path)
 
 
+def test_refuses_to_overwrite_unmarked_checkpoint(tmp_path):
+    """A dir holding shards NOT written by this generator (i.e. possibly a
+    real downloaded checkpoint) must never be cleared — that would be
+    irreversible data loss in a no-egress environment."""
+    import json
+
+    path = tmp_path / "real"
+    path.mkdir()
+    (path / "model-00001-of-00002.safetensors").write_bytes(b"precious")
+    (path / "config.json").write_text(json.dumps({"model_type": "llama"}))
+    with pytest.raises(ValueError, match="refusing to overwrite"):
+        write_synthetic_checkpoint(str(path), SMALL)
+    assert (path / "model-00001-of-00002.safetensors").read_bytes() == b"precious"
+
+
 def test_rerun_does_not_mix_generations(tmp_path):
     """The loader reads every *.safetensors in the dir, so a rerun with a
     different shard size must fully replace the previous generation."""

@@ -241,6 +241,37 @@ async def test_store_token_watch_streams(tmp_path):
         server.stop()
 
 
+def test_auth_handshake_eof_is_retryable_not_rejection(tmp_path):
+    """A server that accepts the connection but dies before replying to the
+    auth op (owner restarting — the RTO scenario) must surface as a
+    ConnectionError, NOT StoreAuthError: auth errors abort the reconnect
+    backoff, and blaming a correct token for a transport failure would
+    strand the replica."""
+    import socket as sk
+    import threading
+
+    from agentcontrolplane_tpu.kernel import StoreAuthError
+
+    lst = sk.socket(sk.AF_UNIX, sk.SOCK_STREAM)
+    path = f"{tmp_path}/eof.sock"
+    lst.bind(path)
+    lst.listen(1)
+
+    def accept_and_slam():
+        conn, _ = lst.accept()
+        conn.recv(4096)  # swallow the hello, reply with nothing
+        conn.close()
+
+    t = threading.Thread(target=accept_and_slam, daemon=True)
+    t.start()
+    try:
+        with pytest.raises(ConnectionError) as exc:
+            RemoteStore(f"unix://{path}", timeout=5.0, token="right-token")
+        assert not isinstance(exc.value, StoreAuthError)
+    finally:
+        lst.close()
+
+
 def test_tokenless_server_accepts_token_client(tmp_path):
     """Rolling a token out: a client already configured with the secret can
     still talk to a replica that has not restarted with one yet."""
