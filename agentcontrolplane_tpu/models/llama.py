@@ -381,11 +381,18 @@ def forward(
     config: LlamaConfig,
     positions: Optional[jax.Array] = None,  # [B, T]; default arange
     attn_impl=None,  # callable(q, k, v, positions) -> out; default dense causal
+    remat: bool = False,
 ) -> jax.Array:
     """Full-sequence causal forward -> logits [B, T, V] (float32).
 
     ``attn_impl`` swaps the attention op — e.g. ring attention for
-    sequence-parallel training (parallel.ring_attention)."""
+    sequence-parallel training (parallel.ring_attention). ``remat``
+    rematerializes each layer in the backward pass (``jax.checkpoint`` on
+    the scan body): activation memory drops from O(n_layers · B · T ·
+    state) to one layer's worth at ~1/3 extra FLOPs — what lets an 8B
+    train step fit HBM at real sequence lengths. Gradients are
+    numerically identical (tested); inference paths leave it off (no
+    backward = nothing to save)."""
     c = config
     B, T = tokens.shape
     if positions is None:
@@ -401,6 +408,11 @@ def forward(
             lambda q, k, v: attn(q, k, v, positions),
         )
         return out, None
+
+    if remat:
+        # prevent_cse=False: safe and faster under scan (the loop already
+        # isolates iterations; CSE prevention only matters for unrolled use)
+        body = jax.checkpoint(body, prevent_cse=False)
 
     x = _embed(params, tokens, c)
 

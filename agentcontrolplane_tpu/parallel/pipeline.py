@@ -60,7 +60,7 @@ def pipeline_shardings(mesh, config: LlamaConfig, params_like: dict) -> dict:
 
 
 def _stage_apply(local_layers: dict, x: jax.Array, positions: jax.Array,
-                 config: LlamaConfig) -> jax.Array:
+                 config: LlamaConfig, remat: bool = False) -> jax.Array:
     """Run this rank's L/pp layers (a scan over the local slice)."""
 
     def body(h, layer):
@@ -69,6 +69,13 @@ def _stage_apply(local_layers: dict, x: jax.Array, positions: jax.Array,
             lambda q, k, v: causal_attention(q, k, v, positions),
         )
         return out, None
+
+    if remat:
+        # same per-layer rematerialization the non-pipelined forward gets:
+        # GPipe microbatching bounds the NUMBER of live microbatch
+        # activations, but each stage would still save every local layer's
+        # activations per microbatch without this
+        body = jax.checkpoint(body, prevent_cse=False)
 
     out, _ = jax.lax.scan(body, x, local_layers)
     return out
@@ -80,6 +87,7 @@ def pipeline_forward(
     config: LlamaConfig,
     mesh,
     n_microbatches: int = 0,  # 0 = 2 * pp (the usual bubble/memory balance)
+    remat: bool = False,
 ) -> jax.Array:
     """Causal forward -> logits [B, T, V] f32, layers pipelined over 'pp'."""
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -87,7 +95,7 @@ def pipeline_forward(
     if pp <= 1:
         from ..models.llama import forward
 
-        return forward(params, tokens, config)
+        return forward(params, tokens, config, remat=remat)
     if config.n_layers % pp:
         raise ValueError(f"n_layers={config.n_layers} must divide over pp={pp}")
     B, T = tokens.shape
@@ -130,7 +138,7 @@ def pipeline_forward(
             inp = jnp.where(r == 0, inject, prev)
             m = step - r  # the microbatch THIS rank would process now
             valid = (m >= 0) & (m < M)
-            cur = _stage_apply(local_layers, inp, positions, c)
+            cur = _stage_apply(local_layers, inp, positions, c, remat=remat)
             # rank pp-1 completes microbatch m = step - (pp - 1)
             out_m = step - (pp - 1)
             if 0 <= out_m < M:
@@ -151,14 +159,16 @@ def pipeline_forward(
     return (x @ head.astype(c.dtype)).astype(jnp.float32)
 
 
-def pipeline_loss_fn(params, tokens, mask, config, mesh, n_microbatches=0):
+def pipeline_loss_fn(params, tokens, mask, config, mesh, n_microbatches=0,
+                     remat: bool = False):
     """Next-token cross-entropy over the pipelined forward — the SAME
     objective as train.trainer.lm_loss (roll-shifted targets, last position
     masked), so pipelined and plain training are loss-comparable. Grad-able:
     autodiff through ppermute yields the GPipe backward schedule."""
     from ..train.trainer import cross_entropy_loss
 
-    logits = pipeline_forward(params, tokens, config, mesh, n_microbatches)
+    logits = pipeline_forward(params, tokens, config, mesh, n_microbatches,
+                              remat=remat)
     targets = jnp.roll(tokens, -1, axis=1)
     m = mask.astype(jnp.float32).at[:, -1].set(0.0)
     return cross_entropy_loss(logits, targets, m)

@@ -103,6 +103,11 @@ class LoraTrainer:
     lora: LoraConfig
     mesh: Mesh
     optimizer: optax.GradientTransformation
+    # same knob as Trainer.remat: adapters usually train against BIG frozen
+    # bases, so per-layer rematerialization defaults on; small/short-seq
+    # fine-tunes that fit activations can turn it off to skip the ~1/3
+    # extra forward FLOPs
+    remat: bool = True
 
     def __post_init__(self):
         c, mesh = self.config, self.mesh
@@ -117,11 +122,13 @@ class LoraTrainer:
         self.batch_sharding = NamedSharding(mesh, P("dp" if has_dp else None))
         lora_cfg = self.lora
 
+        remat = self.remat
+
         def loss_fn(lora_params, base_params, tokens, loss_mask):
             merged = merge_lora(
                 base_params, lora_params, lora_cfg, compute_dtype=jnp.float32
             )
-            return lm_loss(merged, tokens, loss_mask, c)
+            return lm_loss(merged, tokens, loss_mask, c, remat=remat)
 
         def train_step(lora_params, opt_state, base_params, tokens, loss_mask):
             loss, grads = jax.value_and_grad(loss_fn)(

@@ -60,6 +60,36 @@ def test_logits_match_hf_reference(hf_model_and_params):
     np.testing.assert_allclose(ours, hf_logits, rtol=2e-4, atol=2e-4)
 
 
+def test_remat_gradients_match_non_remat():
+    """jax.checkpoint on the layer-scan body (Trainer remat=True default)
+    must change MEMORY, never math: loss and every gradient leaf equal to
+    the non-remat backward. Lives here (no device-count skipif) so the
+    guarantee is verified everywhere, not only under the 8-device mesh
+    harness."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.train.trainer import lm_loss
+
+    cfg = dataclasses.replace(TINY, vocab_size=128, dtype=jnp.float32)
+    params = init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(1, 128, size=(2, 16)), dtype=jnp.int32)
+    mask = jnp.ones((2, 16), dtype=jnp.float32)
+
+    def loss(remat):
+        return jax.value_and_grad(
+            lambda p: lm_loss(p, tokens, mask, cfg, remat=remat)
+        )(params)
+
+    loss_plain, grads_plain = loss(False)
+    loss_remat, grads_remat = loss(True)
+    assert float(loss_plain) == pytest.approx(float(loss_remat), rel=1e-6)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(grads_plain), jax.tree_util.tree_leaves(grads_remat)
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+
 def test_llama31_rope_scaling_matches_hf():
     """Llama-3.1/3.2 checkpoints ship rope_scaling (rope_type 'llama3');
     serving them with unscaled frequencies computes a different function
