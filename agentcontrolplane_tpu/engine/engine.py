@@ -241,6 +241,19 @@ class Engine:
                     f"max_ctx={self.max_ctx} must be divisible by the mesh's "
                     f"sp={sp} for context-parallel serving"
                 )
+        if (self.config.attn_logit_softcap or self.config.post_norms) and kv_layout == "paged":
+            raise ValueError(
+                "gemma-2-style models (attention soft-cap / post-norms) serve "
+                "with kv_layout='slot' — the paged attention kernel has no "
+                "soft-cap path"
+            )
+        if self.config.sliding_window and self.max_ctx > self.config.sliding_window:
+            raise ValueError(
+                f"max_ctx={self.max_ctx} exceeds this model's sliding window "
+                f"({self.config.sliding_window}): gemma-2's alternating local "
+                "layers make serving exact only within one window — lower "
+                "--tpu-ctx to the window size"
+            )
         self.prefill_batch_max = max(1, prefill_batch_max)
         # decode dispatch widths: smallest bucket covering the active slots
         # (each width is its own jit cache entry; keep the set small so cold

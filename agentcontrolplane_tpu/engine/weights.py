@@ -46,7 +46,8 @@ _BIAS_MAP = {
 def config_from_hf(config_path: str) -> LlamaConfig:
     with open(config_path) as f:
         hf = json.load(f)
-    is_gemma = hf.get("model_type") == "gemma"
+    is_gemma2 = hf.get("model_type") == "gemma2"
+    is_gemma = hf.get("model_type") == "gemma" or is_gemma2
     act = hf.get("hidden_activation") or hf.get("hidden_act") or "silu"
     rs = hf.get("rope_scaling") or {}
     rs_type = rs.get("rope_type") or rs.get("type")
@@ -81,6 +82,14 @@ def config_from_hf(config_path: str) -> LlamaConfig:
         norm_plus_one=is_gemma,
         embed_scale=is_gemma,
         head_dim_override=hf.get("head_dim") if is_gemma else None,
+        # Gemma-2: tanh soft-caps, four-norm blocks, explicit query scale,
+        # alternating sliding-window layers (see LlamaConfig.sliding_window
+        # for the context bound the engine enforces)
+        attn_logit_softcap=float(hf.get("attn_logit_softcapping") or 0.0) if is_gemma2 else 0.0,
+        final_logit_softcap=float(hf.get("final_logit_softcapping") or 0.0) if is_gemma2 else 0.0,
+        post_norms=is_gemma2,
+        query_pre_attn_scalar=float(hf.get("query_pre_attn_scalar") or 0.0) if is_gemma2 else 0.0,
+        sliding_window=int(hf.get("sliding_window") or 0) if is_gemma2 else 0,
     )
 
 
@@ -126,6 +135,13 @@ def params_from_state_dict(
     layer_map = dict(_LAYER_MAP)
     if c.qkv_bias:
         layer_map.update(_BIAS_MAP)
+    if c.post_norms:
+        # Gemma-2's four-norm block: HF's post_attention_layernorm norms the
+        # attention OUTPUT (unlike llama, where that name is the pre-MLP
+        # norm), and pre/post_feedforward_layernorm bracket the MLP
+        layer_map["ln1_post"] = "model.layers.{i}.post_attention_layernorm.weight"
+        layer_map["ln2"] = "model.layers.{i}.pre_feedforward_layernorm.weight"
+        layer_map["ln2_post"] = "model.layers.{i}.post_feedforward_layernorm.weight"
     if c.n_experts > 0:
         # Mixtral: the dense MLP keys are replaced by per-expert stacks
         # (HF names the expert projections literally w1/w2/w3) + the router

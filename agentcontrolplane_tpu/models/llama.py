@@ -66,6 +66,16 @@ class LlamaConfig:
     norm_plus_one: bool = False  # gemma RMSNorm multiplies by (1 + weight)
     embed_scale: bool = False  # gemma scales embeddings by sqrt(dim)
     head_dim_override: Optional[int] = None  # gemma: head_dim != dim/n_heads
+    # Gemma-2 additions (all default-off => prior families unchanged):
+    attn_logit_softcap: float = 0.0  # tanh-cap attention logits (g2: 50.0)
+    final_logit_softcap: float = 0.0  # tanh-cap lm_head logits (g2: 30.0)
+    post_norms: bool = False  # extra RMSNorms on sublayer OUTPUTS pre-residual
+    query_pre_attn_scalar: float = 0.0  # q scale denominator; 0 = head_dim
+    # Gemma-2 alternates local (sliding-window) and global layers. Within
+    # one window sliding == full causal, so serving is EXACT for contexts
+    # <= window (4096) and the engine refuses longer (models this size
+    # rarely need it; a windowed KV path is future work).
+    sliding_window: int = 0
     # Mixture-of-Experts (Mixtral architecture): n_experts > 0 replaces the
     # dense FFN with top-k routed SwiGLU experts (ops/moe.py). The expert
     # axis shards over the mesh's 'ep' axis (expert parallelism).
@@ -196,6 +206,49 @@ PRESETS: dict[str, LlamaConfig] = {
         embed_scale=True,
         head_dim_override=256,
     ),
+    # google/gemma-2-2b: four-norm blocks, tanh soft-caps, GQA,
+    # query_pre_attn_scalar = head_dim, alternating 4096-token local layers
+    # (serve with max_ctx <= 4096; see LlamaConfig.sliding_window)
+    "gemma2-2b": LlamaConfig(
+        vocab_size=256000,
+        dim=2304,
+        n_layers=26,
+        n_heads=8,
+        n_kv_heads=4,
+        ffn_dim=9216,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        hidden_act="gelu_tanh",
+        norm_plus_one=True,
+        embed_scale=True,
+        head_dim_override=256,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        post_norms=True,
+        query_pre_attn_scalar=256.0,
+        sliding_window=4096,
+    ),
+    "gemma2-9b": LlamaConfig(
+        vocab_size=256000,
+        dim=3584,
+        n_layers=42,
+        n_heads=16,
+        n_kv_heads=8,
+        ffn_dim=14336,
+        rope_theta=10000.0,
+        norm_eps=1e-6,
+        tie_embeddings=True,
+        hidden_act="gelu_tanh",
+        norm_plus_one=True,
+        embed_scale=True,
+        head_dim_override=256,
+        attn_logit_softcap=50.0,
+        final_logit_softcap=30.0,
+        post_norms=True,
+        query_pre_attn_scalar=256.0,
+        sliding_window=4096,
+    ),
     # mistralai/Mixtral-8x7B(-Instruct): Mistral block + 8 top-2 experts
     "mixtral-8x7b": LlamaConfig(
         vocab_size=32000,
@@ -292,6 +345,9 @@ def init_params(config: LlamaConfig, key: jax.Array) -> dict:
         params["layers"]["bq"] = jnp.zeros((c.n_layers, c.n_heads * hd), dtype=c.dtype)
         params["layers"]["bk"] = jnp.zeros((c.n_layers, c.n_kv_heads * hd), dtype=c.dtype)
         params["layers"]["bv"] = jnp.zeros((c.n_layers, c.n_kv_heads * hd), dtype=c.dtype)
+    if c.post_norms:  # gemma-2 sublayer-output norms
+        params["layers"]["ln1_post"] = jnp.ones((c.n_layers, d), dtype=c.dtype)
+        params["layers"]["ln2_post"] = jnp.ones((c.n_layers, d), dtype=c.dtype)
     if not c.tie_embeddings:
         params["lm_head"] = (
             jax.random.normal(k_head, (d, c.vocab_size)) * scale
@@ -309,6 +365,17 @@ def _embed(params: dict, tokens: jax.Array, c: LlamaConfig) -> jax.Array:
 
 def _final_norm_w(params: dict, c: LlamaConfig) -> jax.Array:
     return params["norm"] + 1.0 if c.norm_plus_one else params["norm"]
+
+
+def _head_logits(x: jax.Array, params: dict, c: LlamaConfig) -> jax.Array:
+    """lm_head projection -> float32 logits; applies gemma-2's final logit
+    soft-capping when configured (cap * tanh(logits / cap))."""
+    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
+    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    if c.final_logit_softcap:
+        cap = jnp.float32(c.final_logit_softcap)
+        logits = cap * jnp.tanh(logits / cap)
+    return logits
 
 
 def _attn_mlp(
@@ -350,8 +417,18 @@ def _attn_mlp(
     )
     q = apply_rope(q, positions, c.rope_theta, scaling=scaling)
     k = apply_rope(k, positions, c.rope_theta, scaling=scaling)
+    if c.query_pre_attn_scalar:
+        # gemma-2 scales attention by 1/sqrt(query_pre_attn_scalar) instead
+        # of 1/sqrt(head_dim); pre-scaling q here keeps every attention
+        # implementation's internal 1/sqrt(head_dim) untouched
+        q = q * jnp.asarray(
+            (c.head_dim ** 0.5) / (c.query_pre_attn_scalar ** 0.5), dtype=q.dtype
+        )
     attn = attn_fn(q, k, v)
-    x = x + mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
+    attn_out = mm(attn.reshape(B, T, c.n_heads * c.head_dim), layer["wo"])
+    if c.post_norms:  # gemma-2: norm the sublayer OUTPUT before residual
+        attn_out = rms_norm(attn_out, norm_w(layer["ln1_post"]), c.norm_eps)
+    x = x + attn_out
     h = rms_norm(x, norm_w(layer["ln2"]), c.norm_eps)
     if c.n_experts > 0:
         from ..ops.moe import expert_capacity, moe_ffn
@@ -371,7 +448,10 @@ def _attn_mlp(
         )
         x = x + y.reshape(B, T, D)
     else:
-        x = x + mm(act(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
+        y = mm(act(mm(h, layer["w1"])) * mm(h, layer["w3"]), layer["w2"])
+        if c.post_norms:
+            y = rms_norm(y, norm_w(layer["ln2_post"]), c.norm_eps)
+        x = x + y
     return x, k, v
 
 
@@ -397,7 +477,10 @@ def forward(
     B, T = tokens.shape
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
-    attn = attn_impl or causal_attention
+    if attn_impl is not None:
+        attn = attn_impl
+    else:
+        attn = partial(causal_attention, softcap=c.attn_logit_softcap)
 
     def body(x, layer):
         out, _, _ = _attn_mlp(
@@ -418,8 +501,7 @@ def forward(
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(c.dtype)).astype(jnp.float32)
+    return _head_logits(x, params, c)
 
 
 # ---------------------------------------------------------------------------
@@ -463,7 +545,9 @@ def prefill_batch(
             layer,
             c,
             positions,
-            lambda q, k, v: blocked_causal_attention(q, k, v, positions),
+            lambda q, k, v: blocked_causal_attention(
+                q, k, v, positions, softcap=c.attn_logit_softcap
+            ),
         )
         return out, (k, v)
 
@@ -477,8 +561,7 @@ def prefill_batch(
     # (padded tail is garbage but never read: decode masks by seq_len)
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]  # [B, D]
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(last, params, c)
     return {"k": k_all, "v": v_all}, logits
 
 
@@ -541,7 +624,10 @@ def prefill_continue(
             v_full = jnp.concatenate(
                 [v_cache_l[slots], v.astype(v_cache_l.dtype)], axis=1
             )
-            out = continue_attention(q, k_full, v_full, positions, key_pos)
+            out = continue_attention(
+                q, k_full, v_full, positions, key_pos,
+                softcap=c.attn_logit_softcap,
+            )
             attn.new_kv = (k, v)
             return out
 
@@ -560,8 +646,7 @@ def prefill_continue(
     )
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(last, params, c)
     return {"k": k_all, "v": v_all}, logits
 
 
@@ -599,7 +684,9 @@ def prefill_paged_batch(
         x = carry
         out, k, v = _attn_mlp(
             x, layer, c, positions,
-            lambda q, k, v: blocked_causal_attention(q, k, v, positions),
+            lambda q, k, v: blocked_causal_attention(
+                q, k, v, positions, softcap=c.attn_logit_softcap
+            ),
         )
         return out, (k, v)
 
@@ -615,8 +702,7 @@ def prefill_paged_batch(
     v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(last, params, c)
     return {"k": k_all, "v": v_all}, logits
 
 
@@ -687,7 +773,10 @@ def prefill_paged_continue(
             )
             k_full = jnp.concatenate([k_rows, k.astype(k_rows.dtype)], axis=1)
             v_full = jnp.concatenate([v_rows, v.astype(v_rows.dtype)], axis=1)
-            out = continue_attention(q, k_full, v_full, positions, key_pos)
+            out = continue_attention(
+                q, k_full, v_full, positions, key_pos,
+                softcap=c.attn_logit_softcap,
+            )
             attn.new_kv = (k, v)
             return out
 
@@ -705,8 +794,7 @@ def prefill_paged_continue(
     v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (last @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(last, params, c)
     return {"k": k_all, "v": v_all}, logits
 
 
@@ -791,8 +879,7 @@ def decode_step_paged(
     k_all = pages["k"].at[:, target, offset].set(new_k.astype(pages["k"].dtype))
     v_all = pages["v"].at[:, target, offset].set(new_v.astype(pages["v"].dtype))
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(x, params, c)
     return {"k": k_all, "v": v_all}, logits
 
 
@@ -828,7 +915,8 @@ def decode_step(
 
         def attn(q, k, v):
             out = decode_attention_cache_plus_new(
-                q[:, 0], k_rows[:W], v_rows[:W], k[:, 0], v[:, 0], seq_lens
+                q[:, 0], k_rows[:W], v_rows[:W], k[:, 0], v[:, 0], seq_lens,
+                softcap=c.attn_logit_softcap,
             )
             attn.new_kv = (k[:, 0], v[:, 0])
             return out[:, None]
@@ -844,6 +932,5 @@ def decode_step(
     k_all = cache["k"].at[:, slot_idx, seq_lens].set(new_k.astype(cache["k"].dtype))
     v_all = cache["v"].at[:, slot_idx, seq_lens].set(new_v.astype(cache["v"].dtype))
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)  # [S, D]
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    logits = (x @ head.astype(c.dtype)).astype(jnp.float32)
+    logits = _head_logits(x, params, c)
     return {"k": k_all, "v": v_all}, logits

@@ -24,6 +24,15 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _softcap(logits: jax.Array, cap: float) -> jax.Array:
+    """Gemma-2 attention-logit soft-capping: cap * tanh(logits / cap),
+    applied BEFORE masking (matching HF). cap == 0 disables (identity)."""
+    if not cap:
+        return logits
+    capf = jnp.float32(cap)
+    return capf * jnp.tanh(logits / capf)
+
+
 def repeat_kv(x: jax.Array, n_rep: int) -> jax.Array:
     """[..., H_kv, d] -> [..., H_kv * n_rep, d] (GQA)."""
     if n_rep == 1:
@@ -36,6 +45,7 @@ def causal_attention(
     k: jax.Array,  # [B, T, H_kv, d]
     v: jax.Array,  # [B, T, H_kv, d]
     positions: jax.Array | None = None,  # [B, T] for padded/packed inputs
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Full causal self-attention. With ``positions`` given, tokens attend
     only to tokens with position <= their own AND valid (position >= 0)."""
@@ -44,7 +54,9 @@ def causal_attention(
     k = repeat_kv(k, n_rep)
     v = repeat_kv(v, n_rep)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale
+    logits = _softcap(
+        jnp.einsum("bthd,bshd->bhts", q, k).astype(jnp.float32) * scale, softcap
+    )
     if positions is None:
         mask = jnp.tril(jnp.ones((T, T), dtype=bool))[None, None]
     else:
@@ -64,6 +76,7 @@ def decode_attention(
     k_cache: jax.Array,  # [S, C, H_kv, d]
     v_cache: jax.Array,  # [S, C, H_kv, d]
     seq_lens: jax.Array,  # [S] int32 — tokens valid in each slot (incl. new)
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Single-step attention against the slot cache."""
     S, C, H_kv, d = k_cache.shape
@@ -71,7 +84,9 @@ def decode_attention(
     k = repeat_kv(k_cache, n_rep)  # [S, C, H, d]
     v = repeat_kv(v_cache, n_rep)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) * scale
+    logits = _softcap(
+        jnp.einsum("shd,schd->shc", q, k).astype(jnp.float32) * scale, softcap
+    )
     mask = jnp.arange(C)[None, None, :] < seq_lens[:, None, None]  # [S,1,C]
     logits = jnp.where(mask, logits, NEG_INF)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
@@ -85,6 +100,7 @@ def decode_attention_cache_plus_new(
     k_new: jax.Array,  # [S, H_kv, d] — the new token's K/V (not yet written)
     v_new: jax.Array,
     seq_lens: jax.Array,  # [S] int32 — tokens valid in the CACHE (excl. new)
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Decode attention over read-only cache rows plus an explicit
     self-attention term for the not-yet-written token.
@@ -100,13 +116,15 @@ def decode_attention_cache_plus_new(
     r = H // H_kv
     q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = (
-        jnp.einsum("skrd,sckd->sckr", q4, k_cache.astype(jnp.float32)) * scale
+    logits = _softcap(
+        jnp.einsum("skrd,sckd->sckr", q4, k_cache.astype(jnp.float32)) * scale,
+        softcap,
     )  # [S, C, H_kv, r]
     mask = jnp.arange(C)[None, :, None, None] < seq_lens[:, None, None, None]
     logits = jnp.where(mask, logits, NEG_INF)
-    self_logit = (
-        jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale
+    self_logit = _softcap(
+        jnp.sum(q4 * k_new.astype(jnp.float32)[:, :, None, :], axis=-1) * scale,
+        softcap,
     )  # [S, H_kv, r]
     m = jnp.maximum(jnp.max(logits, axis=1), self_logit)
     p = jnp.exp(logits - m[:, None])
@@ -118,14 +136,14 @@ def decode_attention_cache_plus_new(
     return out.reshape(S, H, d).astype(q.dtype)
 
 
-def online_softmax_step(qf, kf, vf, mask, m, l, acc, scale):
+def online_softmax_step(qf, kf, vf, mask, m, l, acc, scale, softcap=0.0):
     """One flash-style accumulation step over a K/V block: given f32 query
     [B,Tq,H,d], block keys/values [B,Tk,H,d] (kv heads already repeated),
     and a [B,1|H,Tq,Tk] mask, fold the block into the running (m, l, acc).
     The isfinite guards keep fully-masked-so-far rows at exactly zero; a
     previously-contaminated row (finite NEG_INF) is erased by the
     correction factor underflowing to 0 once a real key appears."""
-    logits = jnp.einsum("bthd,bshd->bhts", qf, kf) * scale
+    logits = _softcap(jnp.einsum("bthd,bshd->bhts", qf, kf) * scale, softcap)
     logits = jnp.where(mask, logits, NEG_INF)
     m_blk = jnp.max(logits, axis=-1)
     m_new = jnp.maximum(m, m_blk)
@@ -150,6 +168,7 @@ def blocked_causal_attention(
     v: jax.Array,
     positions: jax.Array | None = None,  # [B, T] (-1 = padding)
     block_size: int = 512,
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Flash-style blocked causal attention (single device): query blocks
     attend only their causal KEY PREFIX (q-block i scans key blocks 0..i
@@ -162,7 +181,7 @@ def blocked_causal_attention(
     blocks (buckets are powers of two, so T > block implies divisibility)."""
     B, T, H, d = q.shape
     if T <= block_size or T % block_size:
-        return causal_attention(q, k, v, positions)
+        return causal_attention(q, k, v, positions, softcap=softcap)
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     nb = T // block_size
@@ -197,7 +216,9 @@ def blocked_causal_attention(
                 & (q_pos[:, None, :, None] >= 0)
                 & (kv_pos[:, None, None, :] >= 0)
             )
-            m, l, acc = online_softmax_step(qf, kf, vf, mask, m, l, acc, scale)
+            m, l, acc = online_softmax_step(
+                qf, kf, vf, mask, m, l, acc, scale, softcap=softcap
+            )
             return (m, l, acc), None
 
         (m, l, acc), _ = jax.lax.scan(step, (m, l, acc), (k_blocks, v_blocks, pos_blocks))
@@ -211,6 +232,7 @@ def continue_attention(
     v_rows: jax.Array,
     positions: jax.Array,  # [B, T] absolute query positions (-1 = padding)
     key_positions: jax.Array | None = None,  # [B, C]; -1 = invalid key
+    softcap: float = 0.0,
 ) -> jax.Array:
     """Suffix-over-cache attention (prefix-cache continuation): each query
     attends to every key whose absolute position is <= its own — exactly
@@ -224,7 +246,9 @@ def continue_attention(
     k = repeat_kv(k_rows, n_rep)
     v = repeat_kv(v_rows, n_rep)
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
-    logits = jnp.einsum("bthd,bchd->bhtc", q, k).astype(jnp.float32) * scale
+    logits = _softcap(
+        jnp.einsum("bthd,bchd->bhtc", q, k).astype(jnp.float32) * scale, softcap
+    )
     if key_positions is None:
         key_positions = jnp.broadcast_to(jnp.arange(C)[None, :], (B, C))
     mask = (

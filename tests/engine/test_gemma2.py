@@ -1,0 +1,153 @@
+"""Gemma-2 family: exact logits vs transformers' Gemma2ForCausalLM.
+
+Architecture deltas over Gemma-1: four-norm blocks (post-attention and
+post-feedforward norms apply to the sublayer OUTPUT before the residual
+add — HF reuses the name post_attention_layernorm with different
+semantics than llama), tanh soft-capping on attention and final logits,
+query_pre_attn_scalar replacing head_dim in the attention scale, GQA,
+and alternating local/global layers. Tests run at T <= sliding_window,
+where local attention == full causal (the engine enforces the same bound
+for serving).
+"""
+
+import dataclasses
+import json
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from agentcontrolplane_tpu.engine.weights import config_from_hf, params_from_state_dict
+from agentcontrolplane_tpu.models.llama import PRESETS, forward
+
+TINY_GEMMA2 = dict(
+    vocab_size=256,
+    hidden_size=64,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    num_key_value_heads=2,  # GQA like gemma-2-2b
+    head_dim=32,
+    intermediate_size=128,
+    rms_norm_eps=1e-6,
+    rope_theta=10000.0,
+    max_position_embeddings=128,
+    hidden_activation="gelu_pytorch_tanh",
+    attn_logit_softcapping=50.0,
+    final_logit_softcapping=30.0,
+    query_pre_attn_scalar=16,  # != head_dim (32): exercises the q scale
+    sliding_window=128,  # >= test T: local == global (the serving bound)
+)
+
+
+@pytest.fixture(scope="module")
+def gemma2_model_and_params(tmp_path_factory):
+    torch = pytest.importorskip("torch")
+    from transformers import Gemma2Config, Gemma2ForCausalLM
+
+    hf_config = Gemma2Config(**TINY_GEMMA2, attn_implementation="eager")
+    torch.manual_seed(0)
+    model = Gemma2ForCausalLM(hf_config).eval()
+
+    path = tmp_path_factory.mktemp("gemma2") / "config.json"
+    cfg_doc = dict(TINY_GEMMA2)
+    cfg_doc["model_type"] = "gemma2"
+    path.write_text(json.dumps(cfg_doc))
+    config = config_from_hf(str(path))
+    assert config.post_norms and config.attn_logit_softcap == 50.0
+    assert config.final_logit_softcap == 30.0
+    assert config.query_pre_attn_scalar == 16.0
+    assert config.sliding_window == 128
+    config = dataclasses.replace(config, dtype=jnp.float32)
+    params = params_from_state_dict(model.state_dict(), config)
+    return model, params, config
+
+
+def test_gemma2_logits_match_hf(gemma2_model_and_params):
+    torch = pytest.importorskip("torch")
+    model, params, config = gemma2_model_and_params
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(1, TINY_GEMMA2["vocab_size"], (2, 24))
+    with torch.no_grad():
+        ref = model(torch.asarray(tokens)).logits.float().numpy()
+    ours = np.asarray(forward(params, jnp.asarray(tokens, dtype=jnp.int32), config))
+    np.testing.assert_allclose(ours, ref, rtol=2e-4, atol=2e-4)
+
+
+def test_gemma2_softcaps_change_the_function(gemma2_model_and_params):
+    """Guard against the caps silently not being applied on either side.
+    Random-init logits are tiny (tanh ~ identity there), so inflate the
+    embedding (tied lm_head) to push logits well past the cap."""
+    _, params, config = gemma2_model_and_params
+    big = dict(params)
+    big["embed"] = params["embed"] * 40.0
+    rng = np.random.default_rng(1)
+    tokens = jnp.asarray(rng.integers(1, 256, (1, 16)), dtype=jnp.int32)
+    capped = np.asarray(forward(big, tokens, config))
+    uncapped = np.asarray(
+        forward(
+            big, tokens,
+            dataclasses.replace(config, attn_logit_softcap=0.0, final_logit_softcap=0.0),
+        )
+    )
+    assert np.max(np.abs(uncapped)) > 30.0, "test setup must exceed the cap"
+    assert np.max(np.abs(capped)) <= 30.0 + 1e-3  # bounded by construction
+    assert np.max(np.abs(capped - uncapped)) > 1.0
+
+
+def test_gemma2_serves_in_engine(gemma2_model_and_params):
+    """The whole serving path (prefill + continuation + decode) with the
+    gemma-2 block, greedy tokens matching HF's generate."""
+    torch = pytest.importorskip("torch")
+    model, params, config = gemma2_model_and_params
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    prompt = [5, 9, 17, 33, 2]
+    with torch.no_grad():
+        hf_tokens = model.generate(
+            torch.asarray([prompt]), max_new_tokens=6, do_sample=False,
+        )[0, len(prompt):].tolist()
+
+    engine = Engine(
+        config=config, params=params, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=64, prefill_buckets=(32, 64),
+        decode_block_size=4, seed=0,
+    )
+    engine.start()
+    try:
+        result = engine.generate(list(prompt), SamplingParams(temperature=0.0, max_tokens=6))
+        assert result.tokens == hf_tokens, (result.tokens, hf_tokens)
+    finally:
+        engine.stop()
+
+
+def test_gemma2_engine_refuses_unsupported_modes():
+    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh({"tp": 1}, devices=jax.devices()[:1])
+    cfg = dataclasses.replace(
+        PRESETS["tiny"], attn_logit_softcap=50.0, post_norms=True,
+        sliding_window=32, dtype=jnp.float32,
+    )
+    with pytest.raises(ValueError, match="slot"):
+        Engine(config=dataclasses.replace(cfg, post_norms=False),
+               tokenizer=ByteTokenizer(), mesh=mesh, max_slots=2, max_ctx=32,
+               kv_layout="paged")
+    with pytest.raises(ValueError, match="sliding window"):
+        Engine(config=cfg, tokenizer=ByteTokenizer(), mesh=mesh,
+               max_slots=2, max_ctx=64)
+
+
+def test_gemma2_presets_shapes():
+    for name in ("gemma2-2b", "gemma2-9b"):
+        c = PRESETS[name]
+        assert c.post_norms and c.attn_logit_softcap == 50.0
+        assert c.final_logit_softcap == 30.0 and c.sliding_window == 4096
+        assert c.head_dim == 256 and c.query_pre_attn_scalar == 256.0
