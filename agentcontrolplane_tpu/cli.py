@@ -65,6 +65,12 @@ def _add_tpu_flags(p) -> None:
     )
     p.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
     p.add_argument("--tpu-quantize", choices=["int8"], default=None)
+    p.add_argument(
+        "--tpu-max-queue", type=int, default=0,
+        help="admission-queue cap: submissions beyond this many waiting "
+        "requests are shed (REST 503 + Retry-After) instead of queueing "
+        "unboundedly; 0 = unbounded",
+    )
 
 
 def _build_engine(args, coordination=None):
@@ -79,6 +85,7 @@ def _build_engine(args, coordination=None):
         max_ctx=args.tpu_ctx,
         kv_layout=args.tpu_kv_layout,
         quantize=args.tpu_quantize,
+        max_queue=args.tpu_max_queue,
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:

@@ -1,0 +1,44 @@
+"""Version compatibility shims for the pinned accelerator stack.
+
+The codebase targets the modern ``jax.shard_map`` API (``check_vma``,
+``axis_names``); older jax (< 0.5, e.g. the 0.4.37 this container pins)
+only ships ``jax.experimental.shard_map.shard_map`` with the
+``check_rep``/``auto`` spelling. Installing the translation at package
+import keeps every call site on the one modern spelling instead of
+scattering try/except fallbacks through kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def install_shard_map_compat() -> None:
+    """Alias ``jax.shard_map`` on jax versions that predate it.
+
+    Translation: ``check_vma`` -> ``check_rep``; ``axis_names`` (the axes
+    the body is MANUAL over) -> ``auto`` (its complement over the mesh's
+    axes). No-op when jax already provides ``jax.shard_map``.
+    """
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, *, mesh, in_specs, out_specs, check_vma=True, axis_names=None):
+        if axis_names is None:
+            auto = frozenset()
+        else:
+            auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+        return _shard_map(
+            f,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=out_specs,
+            check_rep=check_vma,
+            auto=auto,
+        )
+
+    jax.shard_map = shard_map
+
+
+install_shard_map_compat()

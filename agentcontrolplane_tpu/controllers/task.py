@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from dataclasses import dataclass, field
 from typing import Optional
 
@@ -268,7 +269,11 @@ class TaskReconciler:
         return self._process_llm_response(task, response, tools)
 
     def _llm_request_failed(self, task: Task, err: LLMRequestError) -> Result:
-        """4xx -> terminal Failed; else keep phase and retry (733-790)."""
+        """4xx -> terminal Failed; else keep phase and retry (733-790).
+        Overload responses (503 shed by the engine's bounded admission
+        queue, 429 rate limits) retry with JITTERED backoff so a fleet of
+        shed tasks doesn't re-converge on the engine in one synchronized
+        wave and get shed again."""
         self.recorder.event(task, "Warning", "LLMRequestFailed", str(err))
         if err.terminal:
             task.status.phase = TASK_PHASE_FAILED
@@ -282,6 +287,8 @@ class TaskReconciler:
         task.status.status_detail = f"LLM request failed (will retry): {err}"
         task.status.error = str(err)
         self._update_status(task)
+        if err.status_code in (429, 503):
+            return Result.after(self.requeue_delay * (1.0 + random.random()))
         return Result.after(self.requeue_delay)
 
     # -- tool collection (540-583; task_controller.go:94-117) ------------

@@ -1,4 +1,10 @@
-from .engine import Engine, GenerationResult, SamplingParams
+from .engine import (
+    DeadlineExceededError,
+    Engine,
+    EngineOverloadedError,
+    GenerationResult,
+    SamplingParams,
+)
 from .tokenizer import ByteTokenizer, HFTokenizer, render_prompt, render_system
 from .toolparse import parse_tool_calls, to_message
 from .client import TPUEngineClient
@@ -6,5 +12,6 @@ from .client import TPUEngineClient
 __all__ = [
     "Engine", "GenerationResult", "SamplingParams", "ByteTokenizer",
     "HFTokenizer", "render_prompt", "render_system", "parse_tool_calls",
-    "to_message", "TPUEngineClient",
+    "to_message", "TPUEngineClient", "EngineOverloadedError",
+    "DeadlineExceededError",
 ]

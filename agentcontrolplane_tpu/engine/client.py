@@ -15,7 +15,12 @@ import asyncio
 
 from ..api.resources import BaseConfig, Message
 from ..llmclient.base import LLMClient, LLMRequestError, Tool
-from .engine import Engine, SamplingParams
+from .engine import (
+    DeadlineExceededError,
+    Engine,
+    EngineOverloadedError,
+    SamplingParams,
+)
 from .tokenizer import render_prompt
 from .toolparse import to_message
 
@@ -102,7 +107,9 @@ class TPUEngineClient(LLMClient):
             json_only=bool((self.force_json_tools or forced or json_required) and tools),
             forced_prefix=forced,
         )
-        future = self.engine.submit(prompt, sampling)
+        # the queue deadline rides INTO the engine: if the request would
+        # outwait its queue budget it is failed engine-side without prefill
+        future = self.engine.submit(prompt, sampling, timeout_s=self.queue_timeout_s)
         try:
             result = await self._await_result(future)
         except asyncio.TimeoutError as e:
@@ -113,6 +120,12 @@ class TPUEngineClient(LLMClient):
             # free the slot instead of decoding to max_tokens for a dead caller
             self.engine.cancel(future)
             raise
+        except EngineOverloadedError as e:
+            # 503: non-terminal — the task controller retries with jittered
+            # backoff instead of failing the Task
+            raise LLMRequestError(503, f"TPU engine overloaded: {e}")
+        except DeadlineExceededError as e:
+            raise LLMRequestError(504, f"TPU engine queue deadline: {e}")
         except Exception as e:
             raise LLMRequestError(500, f"TPU engine failure: {e}")
         allowed = {t.function.name for t in tools} if tools else None

@@ -46,6 +46,7 @@ from __future__ import annotations
 import dataclasses
 import json
 import logging
+import select
 import socket
 import struct
 import threading
@@ -123,6 +124,38 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return buf
 
 
+def _peer_hung_up(sock: socket.socket) -> bool:
+    """True iff the peer has closed, detected without consuming data.
+    Followers never send after HELLO, so the leader-side socket being
+    READABLE already means FIN/RST/close_notify (or a protocol violation
+    that makes the conn unusable as a rank holder either way). Plain
+    sockets confirm without consuming via MSG_PEEK; SSLSocket rejects
+    recv flags, so for TLS readability itself is the verdict — without
+    that, a follower SIGKILLed after HELLO but before the first publish
+    (whose send-failure sweep is the normal reaper) would hold its rank
+    forever and deadlock the relaunched follower at the startup barrier.
+    Half-open peers (host vanished, no FIN) are still only caught by the
+    publish-time sweep."""
+    try:
+        readable = bool(select.select([sock], [], [], 0)[0])
+    except (OSError, ValueError):
+        return True
+    if not readable:
+        return False
+    try:
+        sock.setblocking(False)
+        try:
+            return sock.recv(1, socket.MSG_PEEK) == b""
+        finally:
+            sock.setblocking(True)
+    except (BlockingIOError, InterruptedError):
+        return False
+    except ValueError:  # TLS: readable + unpeekable -> hung up
+        return True
+    except OSError:
+        return True
+
+
 class CoordinationLeader:
     """Rank 0's side: accepts follower connections and publishes frames."""
 
@@ -144,6 +177,12 @@ class CoordinationLeader:
         self._sock.listen(64)
         self.address = "%s:%d" % self._sock.getsockname()[:2]
         self._followers: list[socket.socket] = []
+        # rank -> conn for every admitted follower: HELLO rejects duplicate
+        # ranks, so wait_for_followers counts DISTINCT ranks and a client
+        # that double-connects (retry after a half-open TCP setup, operator
+        # misconfiguration giving two processes the same rank) can't
+        # satisfy the barrier early and hang/diverge lockstep
+        self._ranks: dict[int, socket.socket] = {}
         self._lock = threading.Lock()
         self._seq = 0
         self._stopped = False
@@ -167,6 +206,7 @@ class CoordinationLeader:
         """Verify the HELLO frame; only then does the connection count as a
         follower (wait_for_followers tallies authenticated peers ONLY)."""
         rank = None
+        reserved = False
         try:
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             # timeout BEFORE the TLS wrap: wrap_socket performs the whole
@@ -187,10 +227,42 @@ class CoordinationLeader:
             rank = hello.get("rank")
             if not isinstance(rank, int) or rank < 1:
                 raise ConnectionError(f"invalid follower rank {rank!r}")
+            with self._lock:
+                existing = self._ranks.get(rank)
+                if existing is not None and _peer_hung_up(existing):
+                    # the previous holder died before the first publish
+                    # (whose send-failure sweep is the normal reaper) —
+                    # common at the startup barrier, where a crashed-and-
+                    # relaunched follower must be able to reclaim its rank
+                    # instead of being refused as a duplicate forever
+                    try:
+                        existing.close()
+                    except OSError:
+                        pass
+                    if existing in self._followers:
+                        self._followers.remove(existing)
+                    del self._ranks[rank]
+                    existing = None
+                if existing is not None:
+                    # a duplicate must NOT count toward wait_for_followers —
+                    # two connections claiming one rank means the real rank
+                    # set is incomplete and lockstep would hang or diverge
+                    raise ConnectionError(f"duplicate follower rank {rank}")
+                # reserve the rank ATOMICALLY with the check, BEFORE
+                # hello_ok: two simultaneous HELLOs for one rank must not
+                # both pass the check and both be told they joined — the
+                # raced loser would otherwise die later on recv() with an
+                # opaque error instead of this explicit refusal
+                self._ranks[rank] = conn
+            reserved = True
             _send_frame(conn, json.dumps({"hello_ok": True}).encode())
             conn.settimeout(None)
         except (OSError, ValueError, ConnectionError) as e:
             log.warning("coordination connection rejected: %s", e)
+            if reserved:
+                with self._lock:
+                    if self._ranks.get(rank) is conn:
+                        del self._ranks[rank]
             try:
                 conn.close()
             except OSError:
@@ -198,6 +270,8 @@ class CoordinationLeader:
             return
         with self._lock:
             if self._stopped:
+                if self._ranks.get(rank) is conn:
+                    del self._ranks[rank]
                 try:
                     conn.close()
                 except OSError:
@@ -248,6 +322,9 @@ class CoordinationLeader:
                     dead.append(conn)
             for conn in dead:
                 self._followers.remove(conn)
+                for rank, c in list(self._ranks.items()):
+                    if c is conn:  # free the rank for a reconnect
+                        del self._ranks[rank]
                 log.warning("coordination follower dropped")
             self._seq += 1
             return frame["seq"]
@@ -265,6 +342,7 @@ class CoordinationLeader:
                 except OSError:
                     pass
             self._followers.clear()
+            self._ranks.clear()
 
 
 class CoordinationFollower:

@@ -62,6 +62,21 @@ from .tokenizer import ByteTokenizer, Tokenizer
 log = logging.getLogger("acp_tpu.engine")
 
 
+class EngineOverloadedError(RuntimeError):
+    """The admission queue is at its configured cap: the request was shed,
+    not queued. Callers should retry after ``retry_after_s`` (the REST
+    layer maps this to 503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = retry_after_s
+
+
+class DeadlineExceededError(RuntimeError):
+    """The request's ``timeout_s`` deadline expired while it was still
+    queued — it was failed fast without spending any prefill compute."""
+
+
 @dataclass(frozen=True)
 class SamplingParams:
     temperature: float = 0.0
@@ -87,6 +102,9 @@ class GenerationResult:
     prompt_tokens: int
     ttft_ms: float  # time to first token
     latency_ms: float
+    # times this request was preempted (KV pool pressure) and resumed;
+    # preemption is invisible in the output — this is the only trace
+    preempt_count: int = 0
 
 
 @dataclass
@@ -102,6 +120,18 @@ class _Request:
     # neither hit nor usefully seed the prefix cache
     truncated: bool = False
     enqueued: float = field(default_factory=time.monotonic)
+    # preempt-and-resume state: tokens this request already SAMPLED (beyond
+    # any forced prefix) before a preemption freed its slot. On re-admission
+    # the prefill row is prompt + forced_prefix + resume_tokens, so decode
+    # continues exactly where it left off — callers never see truncation.
+    resume_tokens: list[int] = field(default_factory=list)
+    preempt_count: int = 0
+    # absolute monotonic deadline (submit's timeout_s): a request still
+    # QUEUED past it is failed fast instead of wasting prefill compute
+    deadline: Optional[float] = None
+    # wall-clock of the FIRST first-token (survives preemption: TTFT and
+    # the ttft metric are observed once per request, not once per resume)
+    first_token_at: float = 0.0
     # completed (True) when the request takes a slot (prefill starts).
     # Clients key their generation timeout off this, so queue wait under
     # saturation doesn't eat the per-request budget (mirrored onto
@@ -126,6 +156,7 @@ class _Slot:
     prompt_len: int = 0
     prefix_len: int = 0  # leading forced tokens in ``generated``
     first_token_at: float = 0.0
+    admit_seq: int = 0  # admission order (victim policy tie-break)
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -170,6 +201,11 @@ class Engine:
         # paged: how many decode blocks of pages to reserve per slot ahead of
         # need, so the block table isn't dirtied (re-uploaded) every dispatch
         page_lookahead_blocks: int = 8,
+        # admission-queue cap: a submission arriving with max_queue requests
+        # already waiting (submit queue + admission deque) is SHED
+        # (EngineOverloadedError -> REST 503 + Retry-After) instead of
+        # queueing unboundedly. 0 = unbounded (tests, embedded use).
+        max_queue: int = 0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
         # Multi-host lockstep serving (engine/coordination.py): rank 0
@@ -215,6 +251,14 @@ class Engine:
         # make a process-local array that cannot mix with the mesh-global
         # cache/params in one dispatch)
         self._replicated = NamedSharding(self.mesh, _P())
+        # upload guard for _put (see its docstring): identity copy that
+        # breaks CPU zero-copy aliasing between numpy and XLA buffers.
+        # CPU-only — TPU/GPU device_put never aliases the host buffer, and
+        # the copy would transiently double device memory for the largest
+        # array. Assigned before ANY _put call — __init__ uploads state.
+        self._jit_upload_copy = (
+            jax.jit(jnp.copy) if jax.default_backend() == "cpu" else None
+        )
         tp = dict(self.mesh.shape).get("tp", 1)
         sp = dict(self.mesh.shape).get("sp", 1)
         if tp > 1 and self.config.n_kv_heads % tp:
@@ -422,6 +466,14 @@ class Engine:
         self.decode_steps = 0
         self.tokens_generated = 0
         self.table_uploads = 0  # paged: block-table host->device re-uploads
+        self.max_queue = max(0, max_queue)
+        self.preemptions = 0  # pool-pressure preempt-and-resume events
+        self._admit_seq = 0  # monotonically increasing admission stamp
+        # fault-injection seam (faults.FAULTS): near-free when disabled —
+        # every hook is guarded by the plain-bool ``enabled`` attribute
+        from ..faults import FAULTS as _faults
+
+        self._faults = _faults
 
         self._build_jitted()
 
@@ -431,10 +483,20 @@ class Engine:
             # every process supplies its local shards of the same replicated
             # value (the coordination layer guarantees the values match)
             arr = np.asarray(x)
-            return jax.make_array_from_callback(
+            out = jax.make_array_from_callback(
                 arr.shape, self._replicated, lambda idx: arr[idx]
             )
-        return jax.device_put(x, self._replicated)
+        else:
+            out = jax.device_put(x, self._replicated)
+        # CPU backend: device_put may ZERO-COPY alias the host numpy buffer.
+        # Feeding that alias into the donation-heavy dispatch pipeline lets
+        # XLA reuse memory the Python heap also owns — observed as
+        # nondeterministic greedy outputs / host-mirror corruption under
+        # timing jitter. A jitted identity copy forces an XLA-owned buffer
+        # (one compile per shape/dtype; shapes are bucketed and bounded).
+        if self._jit_upload_copy is not None:
+            return self._jit_upload_copy(out)
+        return out
 
     # -- jitted programs -------------------------------------------------
 
@@ -692,12 +754,16 @@ class Engine:
         prompt: str | list[int],
         sampling: Optional[SamplingParams] = None,
         on_tokens=None,
+        timeout_s: Optional[float] = None,
         _prewarm: bool = False,
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
         (optional) streams newly sampled token ids per decode block from the
-        engine thread — keep it non-blocking. ``_prewarm`` requests bypass
-        the prefix cache entirely (no entries, no counters)."""
+        engine thread — keep it non-blocking. ``timeout_s`` propagates the
+        caller's deadline into the admission queue: a request still queued
+        when it expires fails fast (DeadlineExceededError) without wasting
+        prefill. ``_prewarm`` requests bypass the prefix cache entirely (no
+        entries, no counters) and are exempt from the queue cap."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         s = sampling or SamplingParams()
         prefix_len = len(s.forced_prefix)
@@ -716,6 +782,7 @@ class Engine:
             future=Future(),
             on_tokens=on_tokens,
             truncated=truncated,
+            deadline=(time.monotonic() + timeout_s) if timeout_s else None,
         )
         if self._coord_follower:
             # any locally-originated request (prewarm included) would break
@@ -728,6 +795,24 @@ class Engine:
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
             return req.future
+        # bounded admission: shed instead of queueing unboundedly. Depth is
+        # a racy-but-safe over/under-count by at most the in-flight burst;
+        # the cap is an overload valve, not an exact semaphore.
+        if not _prewarm:
+            forced_full = self._faults.enabled and self._faults.pop(
+                "engine.queue_full"
+            ) is not None
+            depth = self._queue.qsize() + len(self._waiting)
+            if forced_full or (self.max_queue and depth >= self.max_queue):
+                REGISTRY.counter_add("acp_engine_shed_requests_total", 1.0)
+                req.future.set_exception(EngineOverloadedError(
+                    f"admission queue full ({depth} waiting, cap "
+                    f"{self.max_queue}); retry later",
+                    # rough drain estimate: a slot-time per queued request,
+                    # floored at 1s — advisory, clients may back off harder
+                    retry_after_s=max(1.0, min(30.0, depth * 0.25)),
+                ))
+                return req.future
         self._outstanding.add(req.future)
         req.future.add_done_callback(self._outstanding.discard)
         req.future.rid = req.rid  # type: ignore[attr-defined]  # cancel() handle
@@ -843,38 +928,45 @@ class Engine:
             # (token-1/2 keys) and their exact hit/miss deltas are removed
             # right after.
             if self._prefix_enabled:
-                seed_len = self.prefill_buckets[0] + 1
-                one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
-                self.submit([1] * seed_len, one).result(timeout=1800)
-                d_hits = 0
-                b = 1
-                while b <= min(self.prefill_batch_max, self.max_slots):
-                    # burst formation depends on queue-drain timing: verify
-                    # the batch size actually DISPATCHED and retry, rather
-                    # than assuming the b submits landed in one group
-                    for attempt in range(5):
-                        with self.hold_admission():
-                            futs = [
-                                self.submit([1] * seed_len + [2] * (8 + i), one)
-                                for i in range(b)
-                            ]
-                        for f in futs:
-                            f.result(timeout=1800)
-                        d_hits += b
-                        if b in self._cont_batch_sizes:
-                            break
-                    else:
-                        log.warning("prewarm: continuation batch B=%d never formed", b)
-                    b *= 2
-                with self._prefix_lock:
-                    for key in [
-                        k for k in self._prefix_cache if set(k) <= {1, 2}
-                    ]:
-                        old = self._prefix_cache.pop(key)
-                        if "pages" in old:
-                            self._allocator.free(old["pages"])
-                    self._prefix_hits = max(0, self._prefix_hits - d_hits)
-                    self._prefix_misses = max(0, self._prefix_misses - 1)
+                # phase-d requests ride the REAL submit path (non-
+                # _prewarm, to exercise the cache) — lift the admission
+                # cap so a small max_queue can't shed prewarm's own burst
+                cap, self.max_queue = self.max_queue, 0
+                try:
+                    seed_len = self.prefill_buckets[0] + 1
+                    one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
+                    self.submit([1] * seed_len, one).result(timeout=1800)
+                    d_hits = 0
+                    b = 1
+                    while b <= min(self.prefill_batch_max, self.max_slots):
+                        # burst formation depends on queue-drain timing: verify
+                        # the batch size actually DISPATCHED and retry, rather
+                        # than assuming the b submits landed in one group
+                        for attempt in range(5):
+                            with self.hold_admission():
+                                futs = [
+                                    self.submit([1] * seed_len + [2] * (8 + i), one)
+                                    for i in range(b)
+                                ]
+                            for f in futs:
+                                f.result(timeout=1800)
+                            d_hits += b
+                            if b in self._cont_batch_sizes:
+                                break
+                        else:
+                            log.warning("prewarm: continuation batch B=%d never formed", b)
+                        b *= 2
+                    with self._prefix_lock:
+                        for key in [
+                            k for k in self._prefix_cache if set(k) <= {1, 2}
+                        ]:
+                            old = self._prefix_cache.pop(key)
+                            if "pages" in old:
+                                self._allocator.free(old["pages"])
+                        self._prefix_hits = max(0, self._prefix_hits - d_hits)
+                        self._prefix_misses = max(0, self._prefix_misses - 1)
+                finally:
+                    self.max_queue = cap
             # phase e: chunked-prefill SPILL shapes (configs whose largest
             # bucket is below max_ctx): long prompts at every power-of-two
             # batch size, with the same verified-dispatch retry as phase d
@@ -930,6 +1022,9 @@ class Engine:
             "max_ctx": self.max_ctx,
             "active_slots": len(self._slots),
             "waiting": len(self._waiting),
+            "max_queue": self.max_queue,
+            "preemptions": self.preemptions,
+            "preempted_waiting": self._preempted_waiting(),
             "decode_block_size": self.decode_block_size,
             "decode_steps": self.decode_steps,
             "tokens_generated": self.tokens_generated,
@@ -956,6 +1051,23 @@ class Engine:
                 }
         return out
 
+    def _preempted_waiting(self) -> int:
+        """Requeued-after-preemption count; tolerant of cross-thread reads
+        (the engine thread mutates the deque while stats() iterates).
+        Preempted requests are only ever requeued at the FRONT and fresh
+        arrivals only append at the back, so they form a contiguous prefix
+        — the scan stops at the first non-preempted request instead of
+        walking a potentially deep backlog every decode block."""
+        n = 0
+        try:
+            for r in self._waiting:
+                if not r.preempt_count:
+                    break
+                n += 1
+        except RuntimeError:  # deque mutated during iteration: racy read
+            pass
+        return n
+
     # -- engine loop -----------------------------------------------------
 
     def _run(self) -> None:
@@ -964,6 +1076,13 @@ class Engine:
                 admitted = self._admit(block=not self._slots)
                 if self._stopping:
                     break
+                # after _admit, not before: the loop parks in _admit while
+                # idle, so a crash armed then would otherwise fire only
+                # AFTER the next request completed a full loop iteration —
+                # here it fires with that request admitted but unresolved,
+                # which is the recovery path worth testing
+                if self._faults.enabled and self._faults.pop("engine.crash") is not None:
+                    raise RuntimeError("fault injection: engine crash")
                 if not self._slots:
                     if not admitted:
                         continue
@@ -1068,6 +1187,12 @@ class Engine:
                 # landed after the snapshots for a request submitted after
                 # the transit peek — that request then decoded to max_tokens
                 # uncancellable.
+                # Expire BEFORE the snapshot: an expired-while-queued rid
+                # then rides THIS frame's cancel list and is dropped from
+                # every rank's waiting deque before _fill_slots — otherwise
+                # the dead request would be prefilled once while its cancel
+                # waited for the next frame.
+                self._expire_deadlines()
                 snapshot = set(self._cancelled)
                 published_live = {r.rid for r in self._waiting}
                 published_live.update(
@@ -1129,12 +1254,62 @@ class Engine:
                     live.update(r.rid for r in self._queue.queue if r is not None)
             self._applied_cancels -= snapshot - live
 
+        self._expire_deadlines()
         if held:
             if not self._slots:
                 # idle hold: don't busy-spin against the submitting thread
                 time.sleep(0.002)
             return False
         return self._fill_slots()
+
+    def _expire_deadlines(self) -> None:
+        """Fail queued requests whose deadline passed — fast, before any
+        prefill is spent on them. Single-host: fail in place. Coordinated
+        leader: route through the replicated cancel stream (wall-clock
+        decisions must not fork lockstep); followers never expire locally."""
+        if self._coord_follower:
+            return
+        expired = [
+            r for r in self._waiting
+            if r.deadline is not None
+            and time.monotonic() > r.deadline
+            and not r.future.done()
+        ]
+        if not expired:
+            return
+        if self._coordination is not None:
+            for r in expired:
+                # the future lives only on the leader (followers reject
+                # local submissions): resolving it here is host-local and
+                # cannot fork lockstep, while the rid rides the replicated
+                # cancel stream so every rank drops the request from its
+                # waiting deque in the same frame. The stream's later
+                # future.cancel() is a no-op on the already-failed future —
+                # without this the client would see a spurious
+                # CancelledError instead of the deadline 504.
+                r.future.set_exception(DeadlineExceededError(
+                    self._expiry_message(r)
+                ))
+                REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+                self._cancelled.add(r.rid)  # rides the next published frame
+            return
+        gone = {id(r) for r in expired}
+        kept = type(self._waiting)(r for r in self._waiting if id(r) not in gone)
+        self._waiting = kept
+        for r in expired:
+            r.future.set_exception(DeadlineExceededError(self._expiry_message(r)))
+            REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+
+    @staticmethod
+    def _expiry_message(req: _Request) -> str:
+        """Distinguish never-admitted expiry from expiry while requeued
+        after a preemption — the latter DID spend compute and stream
+        tokens, and conflating them misleads capacity debugging."""
+        return (
+            "deadline expired while queued (never admitted)"
+            if req.first_token_at == 0.0
+            else "deadline expired while requeued after preemption"
+        )
 
     def _fill_slots(self) -> bool:
         """Admit from the waiting deque into free slots (the prefill side
@@ -1263,8 +1438,14 @@ class Engine:
 
     @staticmethod
     def _full_row(req: _Request) -> list[int]:
-        """The tokens a request prefills: prompt + teacher-forced prefix."""
-        return list(req.prompt) + list(req.sampling.forced_prefix)
+        """The tokens a request prefills: prompt + teacher-forced prefix,
+        plus — after a preemption — everything it had already sampled, so
+        the resumed decode continues exactly where it left off."""
+        return (
+            list(req.prompt)
+            + list(req.sampling.forced_prefix)
+            + list(req.resume_tokens)
+        )
 
     def _match_prefix(self, req: _Request) -> Optional[tuple]:
         """Longest cached entry whose key is a strict prefix of the row
@@ -1402,6 +1583,9 @@ class Engine:
         while self._waiting and self._free and len(group) < self.prefill_batch_max:
             req = self._waiting[0]
             s = req.sampling
+            # queued-deadline expiry happens in _expire_deadlines, which
+            # _admit runs (and the leader publishes) before every
+            # _fill_slots — by here the head of the deque is live
             if s.json_only and s.forced_prefix:
                 # seed the automaton past the forced prefix; an illegal
                 # prefix can never complete, so fail it up front
@@ -1416,7 +1600,7 @@ class Engine:
                 match = self._match_prefix(req)
             pages: Optional[list[int]] = None
             if self.kv_layout == "paged":
-                total_pages = -(-(len(req.prompt) + len(s.forced_prefix)) // self.page_size)
+                total_pages = -(-len(self._full_row(req)) // self.page_size)
                 if total_pages > self._allocator.num_pages - 1:
                     # bigger than the entire pool: waiting would spin forever
                     self._waiting.popleft()
@@ -1547,14 +1731,15 @@ class Engine:
             top_ks[i] = s.top_k
             top_ps[i] = s.top_p
             # ctx-bounded: 1 token now + decode capacity to the ctx edge
-            # (the decode block deactivates the slot device-side at max_ctx-1)
-            budgets[i] = min(s.max_tokens, 1 + max(0, self.max_ctx - 1 - plen))
+            # (the decode block deactivates the slot device-side at max_ctx-1);
+            # a resumed request's budget excludes what it already sampled
+            budgets[i] = min(
+                s.max_tokens - len(req.resume_tokens),
+                1 + max(0, self.max_ctx - 1 - plen),
+            )
             if s.json_only:
-                con_states0[i] = (
-                    self._seed_con_state(s.forced_prefix)
-                    if s.forced_prefix
-                    else self._table_start
-                )
+                seed = tuple(s.forced_prefix) + tuple(req.resume_tokens)
+                con_states0[i] = self._seed_con_state(seed) if seed else self._table_start
                 constrained0[i] = True
         self._rng, step_rng = jax.random.split(self._rng)
         common = (
@@ -1625,17 +1810,31 @@ class Engine:
             first_tok = int(firsts[i])
             self._con_states[slot] = int(con_states[i])
             self._constrained[slot] = bool(s.json_only)
+            if req.first_token_at == 0.0:
+                req.first_token_at = now
+                REGISTRY.observe(
+                    "acp_engine_ttft_seconds", now - req.enqueued,
+                    help="time to first token",
+                )
+            self._admit_seq += 1
             sl = _Slot(
                 request=req,
                 prompt_len=len(req.prompt),
                 prefix_len=len(s.forced_prefix),
-                first_token_at=now,
+                first_token_at=req.first_token_at,
+                admit_seq=self._admit_seq,
             )
             sl.generated.extend(s.forced_prefix)
+            sl.generated.extend(req.resume_tokens)
             sl.generated.append(first_tok)
             if first_tok not in self.tokenizer.stop_tokens:
-                req.emit(list(s.forced_prefix) + [first_tok])
-            elif s.forced_prefix:
+                # resumed requests already emitted prefix + resume tokens
+                # before preemption — only the fresh token streams out
+                req.emit(
+                    [first_tok] if req.resume_tokens
+                    else list(s.forced_prefix) + [first_tok]
+                )
+            elif s.forced_prefix and not req.resume_tokens:
                 req.emit(list(s.forced_prefix))
             self._slots[slot] = sl
             self._seq_lens[slot] = full_lens[i]  # cached prefix + suffix
@@ -1643,24 +1842,31 @@ class Engine:
             self._temps[slot] = s.temperature
             self._top_ks[slot] = s.top_k
             self._top_ps[slot] = s.top_p
-            REGISTRY.observe(
-                "acp_engine_ttft_seconds", now - req.enqueued, help="time to first token"
-            )
-            if first_tok in self.tokenizer.stop_tokens or s.max_tokens <= 1:
+            if (
+                first_tok in self.tokenizer.stop_tokens
+                or len(sl.generated) - sl.prefix_len >= s.max_tokens
+            ):
                 self._finish(
                     slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length"
                 )
 
     def _ensure_pages_for_block(self) -> None:
         """Paged mode: every active slot's table must cover the next K
-        tokens before dispatch; slots we can't cover are preempted (finished
-        at current length) — admission backpressure frees their pages."""
+        tokens before dispatch. A slot the pool can't cover triggers
+        PREEMPT-AND-RESUME (never a silent truncation): prefix-cache
+        entries yield first, then a policy victim is preempted — its
+        generated-so-far tokens are saved on the request, its pages freed,
+        and it is requeued at the FRONT of the admission queue to resume
+        later via a prompt+partial prefill."""
+        if self._faults.enabled:
+            self._faults.apply_page_pressure(self._allocator)
         K = self.decode_block_size
-        # Pass 1 — strict coverage, identical preemption semantics to the
-        # pre-lookahead code: every slot gets exactly the pages this block
-        # needs; lookahead can never starve a slot that strictly fits.
+        # Pass 1 — strict coverage: every slot gets exactly the pages this
+        # block needs; lookahead can never starve a slot that strictly fits.
         crossed: list[int] = []
         for slot in list(self._slots):
+            if slot not in self._slots:
+                continue  # preempted as a victim for an earlier slot
             needed = -(-(int(self._seq_lens[slot]) + K) // self.page_size)
             # ctx edge: the decode block deactivates the slot on device at
             # max_ctx-1, so a fully-populated table is always enough — clamp
@@ -1671,10 +1877,9 @@ class Engine:
             have = len(self._slot_pages.get(slot, []))
             if needed <= have:
                 continue
-            new_pages = self._alloc_reclaiming_lookahead(needed - have, slot)
+            new_pages = self._alloc_with_preemption(needed - have, slot)
             if new_pages is None:
-                self._finish(slot, "length")  # preempted: KV pool exhausted
-                continue
+                continue  # slot itself was preempted (requeued or finished)
             self._append_pages(slot, new_pages)
             crossed.append(slot)
         # Pass 2 — opportunistic lookahead top-up, only for slots whose
@@ -1736,6 +1941,115 @@ class Engine:
         except MemoryError:
             return None
 
+    def _alloc_with_preemption(self, n: int, requester: int) -> list[int] | None:
+        """Alloc ``n`` pages for an active slot, escalating on exhaustion:
+        (1) claw back other slots' unused lookahead pages, (2) evict prefix
+        -cache entries (cache must never starve live work), (3) preempt
+        policy victims until the allocation fits or the requester itself is
+        the victim. Returns None iff the requester was preempted."""
+        while True:
+            pages = self._alloc_reclaiming_lookahead(n, requester)
+            if pages is not None:
+                return pages
+            if self._evict_one_prefix_entry():
+                continue
+            victim = self._pick_victim()
+            if victim is None:
+                # no active slots left to yield (shouldn't happen — the
+                # requester is active); preempt the requester defensively
+                victim = requester
+            self._preempt(victim)
+            if victim == requester:
+                return None
+
+    def _pick_victim(self) -> Optional[int]:
+        """Preemption victim policy (documented in docs/serving-engine.md):
+        fewest sampled tokens first (least work lost / cheapest resume
+        prefill), ties broken by MOST recently admitted (LIFO — the oldest
+        requests keep their progress, mirroring the front-of-queue resume
+        order so the engine converges instead of thrashing)."""
+        if not self._slots:
+            return None
+        return min(
+            self._slots,
+            key=lambda s: (
+                len(self._slots[s].generated) - self._slots[s].prefix_len,
+                -self._slots[s].admit_seq,
+            ),
+        )
+
+    def _preempt(self, slot: int) -> None:
+        """Evacuate an active slot under pool pressure WITHOUT finishing
+        it: save its sampled-so-far tokens and scheduling state on the
+        request, free its pages, and requeue it at the front of the
+        admission queue. On re-admission it prefills prompt+partial and
+        decode continues — the caller's result is byte-identical (greedy)
+        to an uncontended run, with only ``preempt_count`` as evidence."""
+        sl = self._slots.pop(slot)
+        req = sl.request
+        req.resume_tokens = list(sl.generated[sl.prefix_len:])
+        req.preempt_count += 1
+        self.preemptions += 1
+        self._state_dirty = True
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        self._con_states[slot] = 0
+        self._constrained[slot] = False
+        heapq.heappush(self._free, slot)
+        if self.kv_layout == "paged":
+            self._allocator.free(self._slot_pages.pop(slot, []))
+            self._block_tables[slot, :] = TRASH_PAGE
+            self._tables_dirty = True
+        REGISTRY.counter_add(
+            "acp_engine_preemptions_total", 1.0,
+            help="slots preempted (and requeued) under KV pool pressure",
+        )
+        # a request too big for the WHOLE pool can never be resumed — the
+        # resume prefill itself would not fit. Finish honestly at current
+        # length (this is real memory exhaustion, not contention; the old
+        # force-finish behavior, now reserved for the impossible case).
+        if self.kv_layout == "paged":
+            K = self.decode_block_size
+            ever_needed = min(
+                -(-(len(self._full_row(req)) + K) // self.page_size),
+                self.max_pages_per_seq,
+            )
+            if ever_needed > self._allocator.num_pages - 1:
+                log.warning(
+                    "rid %s needs %d pages to resume but the pool has %d; "
+                    "finishing at current length", req.rid, ever_needed,
+                    self._allocator.num_pages - 1,
+                )
+                self._resolve_preempted_as_length(req)
+                return
+        self._waiting.appendleft(req)
+        log.info(
+            "preempted rid %s (slot %d, %d tokens sampled, preempt #%d); "
+            "requeued at front", req.rid, slot, len(req.resume_tokens),
+            req.preempt_count,
+        )
+
+    def _resolve_preempted_as_length(self, req: _Request) -> None:
+        """Terminal path for a preempted request that can never fit the
+        pool again: resolve with what it generated (finish_reason length)."""
+        gen = list(req.sampling.forced_prefix) + list(req.resume_tokens)
+        if gen and gen[-1] in self.tokenizer.stop_tokens:
+            gen = gen[:-1]
+        now = time.monotonic()
+        result = GenerationResult(
+            text=self.tokenizer.decode(gen),
+            tokens=gen,
+            finish_reason="length",
+            prompt_tokens=len(req.prompt),
+            ttft_ms=(req.first_token_at - req.enqueued) * 1e3,
+            latency_ms=(now - req.enqueued) * 1e3,
+            preempt_count=req.preempt_count,
+        )
+        if not req.future.done():
+            req.future.set_result(result)
+        REGISTRY.counter_add("acp_engine_requests_total", 1.0)
+        REGISTRY.counter_add("acp_engine_tokens_total", float(len(gen)))
+
     def _append_pages(self, slot: int, new_pages: list[int]) -> None:
         table = self._slot_pages[slot]
         have = len(table)
@@ -1748,6 +2062,14 @@ class Engine:
             for slot, sl in list(self._slots.items()):
                 if sl.request.rid in self._applied_cancels:
                     self._finish(slot, "cancelled")
+        if not self._slots:
+            return
+        if self._faults.enabled:
+            spec = self._faults.pop("engine.force_preempt", steps=self.decode_steps)
+            if spec is not None:
+                victim = self._pick_victim()
+                if victim is not None:
+                    self._preempt(victim)
         if not self._slots:
             return
         K = self.decode_block_size
@@ -1870,6 +2192,11 @@ class Engine:
             "acp_engine_waiting_requests", len(self._waiting),
             help="admission queue depth",
         )
+        REGISTRY.gauge_set(
+            "acp_engine_preempted_waiting",
+            self._preempted_waiting(),
+            help="preempted requests requeued and awaiting resume",
+        )
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.pop(slot)
@@ -1895,6 +2222,7 @@ class Engine:
             prompt_tokens=sl.prompt_len,
             ttft_ms=(sl.first_token_at - sl.request.enqueued) * 1e3,
             latency_ms=(now - sl.request.enqueued) * 1e3,
+            preempt_count=sl.request.preempt_count,
         )
         if not sl.request.future.done():
             sl.request.future.set_result(result)

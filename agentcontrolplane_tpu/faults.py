@@ -1,0 +1,111 @@
+"""Deterministic fault injection (:data:`FAULTS`).
+
+``FAULTS`` is a process-wide :class:`FaultInjector`. Production code keeps
+the hooks near-free: every site is guarded by ``FAULTS.enabled`` (a plain
+bool, False unless ``$ACP_FAULTS`` is truthy or a test calls
+``FAULTS.enable()``). Faults are **deterministic**: they arm by site name
+with explicit trigger conditions (fire-count budgets, step thresholds),
+never randomness — a stress test that injects page pressure or a forced
+preemption at decode step N reproduces byte-identically.
+
+Engine sites (see ``engine/engine.py``):
+
+- ``engine.crash``         — raise inside the engine loop (crash recovery).
+- ``engine.queue_full``    — ``submit()`` sheds as if the admission queue
+  were at its cap (503 end to end).
+- ``engine.force_preempt`` — preempt the policy victim at the first decode
+  block where ``decode_steps >= after_steps``.
+- ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
+  (released when disarmed/reset), shrinking the pool mid-serve.
+
+This module is deliberately dependency-free (stdlib only) so the engine
+can import it without pulling in the control-plane kernel or the test
+fixtures in :mod:`agentcontrolplane_tpu.testing`, which re-exports
+``FAULTS`` for test convenience.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+
+
+class FaultInjector:
+    """Deterministic, site-keyed fault injection.
+
+    Thread-safe: arm/disarm happen on test threads while ``pop`` /
+    ``apply_page_pressure`` run on the engine thread. A site armed with
+    ``times=N`` fires at most N times; ``after_steps`` gates firing until
+    the caller-supplied ``steps`` context reaches the threshold.
+    """
+
+    def __init__(self) -> None:
+        self.enabled = bool(os.environ.get("ACP_FAULTS", ""))
+        self._lock = threading.Lock()
+        self._armed: dict[str, dict] = {}
+        # site "engine.page_pressure": pages held per allocator (by id);
+        # the allocator reference is kept so reset() can release them
+        self._held: dict[int, tuple[object, list[int]]] = {}
+
+    def enable(self) -> None:
+        self.enabled = True
+
+    def arm(self, site: str, *, times: int = 1, after_steps: int = 0, **spec) -> None:
+        """Arm ``site`` to fire ``times`` times once ``steps >= after_steps``.
+        Extra keywords ride along in the spec the call site receives."""
+        self.enable()
+        with self._lock:
+            self._armed[site] = {"times": times, "after_steps": after_steps, **spec}
+
+    def disarm(self, site: str) -> None:
+        with self._lock:
+            self._armed.pop(site, None)
+
+    def armed(self, site: str) -> bool:
+        with self._lock:
+            return site in self._armed
+
+    def pop(self, site: str, steps: int = 0):
+        """Consume one firing of ``site`` if armed and due; returns the spec
+        dict (or None). Call sites guard with ``FAULTS.enabled`` first so
+        the disabled path costs one attribute read."""
+        with self._lock:
+            spec = self._armed.get(site)
+            if spec is None or steps < spec["after_steps"]:
+                return None
+            spec["times"] -= 1
+            if spec["times"] <= 0:
+                del self._armed[site]
+            return dict(spec)
+
+    def apply_page_pressure(self, allocator) -> None:
+        """Converge the pages held from ``allocator`` toward the armed
+        ``engine.page_pressure`` spec (``pages=N``; 0/disarmed releases).
+        Engine-thread only — the allocator is engine-thread-owned."""
+        with self._lock:
+            spec = self._armed.get("engine.page_pressure")
+            want = int(spec["pages"]) if spec else 0
+            _, held = self._held.setdefault(id(allocator), (allocator, []))
+            if len(held) < want:
+                take = min(want - len(held), allocator.free_count)
+                if take:
+                    held.extend(allocator.alloc(take))
+            elif len(held) > want:
+                allocator.free(held[want:])
+                del held[want:]
+
+    def reset(self) -> None:
+        """Disarm everything and release held pages. Tests call this in
+        teardown; safe while engines still run (page release is the same
+        allocator mutation the engine thread performs, so only call after
+        the engine is stopped or idle)."""
+        with self._lock:
+            self._armed.clear()
+            held, self._held = self._held, {}
+        for allocator, pages in held.values():
+            if pages:
+                allocator.free(pages)
+        self.enabled = bool(os.environ.get("ACP_FAULTS", ""))
+
+
+FAULTS = FaultInjector()

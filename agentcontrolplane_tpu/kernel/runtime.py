@@ -336,10 +336,24 @@ class Manager:
         for t in self._tasks:
             t.cancel()
         for t in self._tasks:
-            try:
-                await t
-            except (asyncio.CancelledError, Exception):
-                pass
+            # Grace first: a well-behaved worker's cancellation cleanup
+            # (closing connections, flushing a watch) may legitimately
+            # await — give it 2s to finish before escalating.
+            if not t.done():
+                await asyncio.wait([t], timeout=2.0)
+            # A worker may absorb the first CancelledError inside a cleanup
+            # path (e.g. awaiting a handler that swallows it); re-deliver
+            # cancellation until the task actually dies, bounded so stop()
+            # can never hang the process on a misbehaving worker.
+            for _ in range(50):
+                if t.done():
+                    break
+                t.cancel()
+                await asyncio.wait([t], timeout=0.2)
+            if not t.done():
+                log.error("manager task ignored repeated cancellation; detaching: %r", t)
+            elif not t.cancelled() and t.exception() is not None:
+                log.debug("manager task exited with error during stop: %r", t.exception())
         self._tasks.clear()
         self._watches.clear()
         self._started = False

@@ -336,10 +336,18 @@ class StoreServer:
             except FileNotFoundError:
                 pass
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-            sock.bind(path)
             # owner-only: the socket grants full control-plane read/write
-            # (Secrets included), so default umask perms are too broad
-            os.chmod(path, 0o600)
+            # (Secrets included), so default umask perms are too broad.
+            # The umask is narrowed ACROSS bind() — chmod-after-bind alone
+            # leaves a window where the inode exists with umask-default
+            # (usually world-connectable) permissions that a racing
+            # connect() could latch onto; umask 0o177 makes it be born 0600.
+            old_umask = os.umask(0o177)
+            try:
+                sock.bind(path)
+            finally:
+                os.umask(old_umask)
+            os.chmod(path, 0o600)  # belt-and-braces; also normalizes mode
             self.address = f"unix://{path}"
         else:
             sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)

@@ -478,6 +478,15 @@ def forward(
     if positions is None:
         positions = jnp.broadcast_to(jnp.arange(T, dtype=jnp.int32), (B, T))
     if attn_impl is not None:
+        if c.attn_logit_softcap:
+            # refuse, don't mis-serve: a swapped-in attention op (ring
+            # attention etc.) has no soft-cap path, and silently dropping
+            # the cap trains/evaluates a DIFFERENT model than configured
+            raise ValueError(
+                "attn_logit_softcap is configured but a custom attn_impl "
+                "cannot apply it — use the default dense attention (or a "
+                "soft-cap-aware implementation) for gemma-2-style models"
+            )
         attn = attn_impl
     else:
         attn = partial(causal_attention, softcap=c.attn_logit_softcap)

@@ -39,6 +39,8 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ... import compat as _compat  # noqa: F401  (installs jax.shard_map on old jax)
+
 NEG_INF = -1e30
 NBUF = 4  # DMA pipeline depth: NBUF-1 page fetches kept in flight per walk
 

@@ -34,7 +34,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..models.llama import LlamaConfig, _attn_mlp, _embed, _final_norm_w
+from .. import compat as _compat  # noqa: F401  (installs jax.shard_map on old jax)
+from ..models.llama import LlamaConfig, _attn_mlp, _embed, _final_norm_w, _head_logits
 from ..ops.attention import causal_attention
 from ..ops.norms import rms_norm
 from .mesh import param_specs
@@ -61,12 +62,17 @@ def pipeline_shardings(mesh, config: LlamaConfig, params_like: dict) -> dict:
 
 def _stage_apply(local_layers: dict, x: jax.Array, positions: jax.Array,
                  config: LlamaConfig, remat: bool = False) -> jax.Array:
-    """Run this rank's L/pp layers (a scan over the local slice)."""
+    """Run this rank's L/pp layers (a scan over the local slice). The
+    attention-logit soft-cap (gemma-2) threads through exactly like the
+    non-pipelined forward — dropping it would silently mis-train capped
+    models."""
 
     def body(h, layer):
         out, _, _ = _attn_mlp(
             h, layer, config, positions,
-            lambda q, k, v: causal_attention(q, k, v, positions),
+            lambda q, k, v: causal_attention(
+                q, k, v, positions, softcap=config.attn_logit_softcap
+            ),
         )
         return out, None
 
@@ -155,8 +161,9 @@ def pipeline_forward(
     outs = run(params["layers"], xs)
     x = outs.reshape(B, T, c.dim)
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
-    head = params["embed"].T if c.tie_embeddings else params["lm_head"]
-    return (x @ head.astype(c.dtype)).astype(jnp.float32)
+    # _head_logits, not a bare x @ head: gemma-2's FINAL logit soft-cap
+    # must apply here exactly as in the non-pipelined forward
+    return _head_logits(x, params, c)
 
 
 def pipeline_loss_fn(params, tokens, mask, config, mesh, n_microbatches=0,

@@ -63,6 +63,17 @@ def _json_error(status: int, message: str) -> web.Response:
     return web.json_response({"error": message}, status=status)
 
 
+def _overloaded_response(e) -> web.Response:
+    """503 for an EngineOverloadedError shed: tell the client when to come
+    back instead of parking its connection (stream and non-stream paths
+    share this so the shed contract can't diverge)."""
+    return web.json_response(
+        {"error": str(e)},
+        status=503,
+        headers={"Retry-After": str(max(1, int(e.retry_after_s)))},
+    )
+
+
 # health probes stay open (the reference likewise exempts healthz/readyz from
 # its metrics authn filter, acp/cmd/main.go:306-313)
 _UNAUTHENTICATED_PATHS = {"/healthz", "/readyz"}
@@ -787,6 +798,10 @@ class RestServer:
                 max_tokens=int(body.get("max_tokens") or 512),
                 json_only=json_only,
             )
+            # per-request generation deadline (replaces the old hard-coded
+            # 600s): propagated into the engine's admission queue, so a
+            # request that expires while QUEUED fails fast without prefill
+            timeout_s = min(3600.0, max(1.0, float(body.get("timeout_s") or 600.0)))
             # render here too: a client-supplied assistant history message
             # with unparseable tool_calls[].function.arguments is malformed
             # *client* input and must 400, not 500
@@ -800,17 +815,28 @@ class RestServer:
         if not await asyncio.to_thread(engine.ensure_running):
             return _json_error(503, "TPU engine is stopped")
         if stream:
-            return await self._stream_chat(request, engine, prompt, sampling, tools, body)
+            return await self._stream_chat(
+                request, engine, prompt, sampling, tools, body, timeout_s
+            )
 
-        fut = engine.submit(prompt, sampling)
+        from ..engine.engine import DeadlineExceededError, EngineOverloadedError
+
+        fut = engine.submit(prompt, sampling, timeout_s=timeout_s)
         try:
-            result = await _asyncio.wait_for(_asyncio.wrap_future(fut), timeout=600)
+            result = await _asyncio.wait_for(
+                _asyncio.wrap_future(fut), timeout=timeout_s
+            )
         except _asyncio.TimeoutError:
             engine.cancel(fut)  # free the slot; don't decode for a gone caller
             return _json_error(504, "generation timed out")
         except _asyncio.CancelledError:
             engine.cancel(fut)  # client disconnected mid-generation
             raise
+        except EngineOverloadedError as e:
+            # load shedding, never an unbounded queue wait
+            return _overloaded_response(e)
+        except DeadlineExceededError as e:
+            return _json_error(504, str(e))
         except Exception as e:
             return _json_error(500, f"generation failed: {e}")
 
@@ -852,7 +878,8 @@ class RestServer:
             }
         )
 
-    async def _stream_chat(self, request, engine, prompt, sampling, tools, body):
+    async def _stream_chat(self, request, engine, prompt, sampling, tools, body,
+                           timeout_s: float = 600.0):
         """SSE streaming (OpenAI chat.completion.chunk wire format): token
         deltas flow from the engine thread per decode block. With tools, the
         streamed content is the raw (grammar-constrained) JSON text; if the
@@ -862,6 +889,7 @@ class RestServer:
         import time as _time
         import uuid as _uuid
 
+        from ..engine.engine import EngineOverloadedError
         from ..engine.toolparse import to_message
 
         loop = _asyncio.get_running_loop()
@@ -869,7 +897,12 @@ class RestServer:
         fut = engine.submit(
             prompt, sampling,
             on_tokens=lambda ids: loop.call_soon_threadsafe(q.put_nowait, list(ids)),
+            timeout_s=timeout_s,
         )
+        if fut.done() and isinstance(fut.exception(), EngineOverloadedError):
+            # shed before the stream opened: a plain 503 the client can
+            # retry (no SSE preamble has been written yet)
+            return _overloaded_response(fut.exception())
         resp = web.StreamResponse(
             headers={
                 "Content-Type": "text/event-stream",
@@ -895,7 +928,7 @@ class RestServer:
         pending: list[int] = []  # ids not yet emitted (decode is O(block))
         sent = 0  # chars already streamed
         timed_out = False
-        deadline = _time.monotonic() + 600
+        deadline = _time.monotonic() + timeout_s
         # with tools offered the final message is EITHER content OR
         # tool_calls (matching the non-streamed path): buffer instead of
         # streaming raw tool-call JSON as content deltas
@@ -933,7 +966,12 @@ class RestServer:
                 await resp.write_eof()
                 return resp
             try:
-                result = fut.result(timeout=30)
+                # the loop exits when fut is done (or on timeout, handled
+                # above); the residual wait only covers the done-callback
+                # race, bounded by what's left of the request's own budget
+                result = fut.result(
+                    timeout=max(1.0, min(30.0, deadline - _time.monotonic()))
+                )
             except Exception as e:
                 await error_event(f"generation failed: {e}", "server_error")
                 await resp.write_eof()

@@ -1,8 +1,17 @@
-"""Builder fixtures per kind, with setup / setup_with_status / teardown.
+"""Builder fixtures per kind, with setup / setup_with_status / teardown —
+plus the deterministic fault-injection seam (:data:`FAULTS`).
 
 Mirrors the reference's ``test/utils/*.go`` (SURVEY.md §4): the universal
 trick is ``setup_with_status`` — write status directly through the status
 subresource so a test can fabricate "LLM is Ready" without live API keys.
+
+Fault injection
+---------------
+
+``FAULTS`` lives in :mod:`agentcontrolplane_tpu.faults` (a dependency-free
+module so the engine can import it without this fixture surface) and is
+re-exported here for test convenience — see that module's docstring for
+the site catalogue and determinism contract.
 """
 
 from __future__ import annotations
@@ -198,3 +207,10 @@ def make_contactchannel(store: Store, name="approval-channel", ready=True) -> Co
         ),
         mark_ready if ready else None,
     )
+
+
+# ---------------------------------------------------------------------------
+# Fault injection — re-exported from the dependency-free faults module
+# ---------------------------------------------------------------------------
+
+from agentcontrolplane_tpu.faults import FAULTS, FaultInjector  # noqa: E402,F401

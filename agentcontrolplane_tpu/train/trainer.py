@@ -87,6 +87,16 @@ class Trainer:
 
         attn_impl = None
         if self.sequence_parallel:
+            if c.attn_logit_softcap:
+                # eager refusal (forward() would also raise, but only at
+                # trace time deep inside jit): ring attention has no
+                # soft-cap path, and training a gemma-2-style model
+                # without its cap silently optimizes a different model
+                raise ValueError(
+                    "sequence_parallel (ring attention) cannot apply "
+                    "attn_logit_softcap — train gemma-2-style models "
+                    "without sequence_parallel"
+                )
             attn_impl = lambda q, k, v, positions: ring_causal_attention(
                 mesh, q, k, v, positions
             )

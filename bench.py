@@ -603,8 +603,18 @@ def _burst_model_flops(
     """Model FLOPs for one measured burst. The headline window includes the
     prefill work (elapsed spans submit -> last token), so MFU must count it:
     each prefill processes prompt_len tokens at mean attention context
-    prompt_len/2; each generated token is one decode step at mean_ctx."""
-    prefill = prefills * prompt_len * _flops_per_token(c, prompt_len / 2.0)
+    prompt_len/2; each generated token is one decode step at mean_ctx.
+
+    The lm_head matmul is counted ONCE per prefill, not per prefill token:
+    the engine's prefill computes logits only at the LAST position
+    (prefill_batch returns [B, V]), so charging every prompt token with the
+    2*dim*vocab head FLOPs overstates prefill work — and thus MFU — by up
+    to the head's share of the model (large for small-dim/big-vocab
+    configs)."""
+    head = 2.0 * c.dim * c.vocab_size
+    prefill = prefills * (
+        prompt_len * (_flops_per_token(c, prompt_len / 2.0) - head) + head
+    )
     decode = gen_tokens * _flops_per_token(c, mean_ctx)
     return prefill + decode
 

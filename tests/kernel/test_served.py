@@ -488,3 +488,44 @@ async def test_manager_watch_loop_resyncs_after_server_restart(tmp_path):
         await mgr.stop()
         remote.close()
         server.stop()
+
+
+def test_unix_socket_born_owner_only(tmp_path, monkeypatch):
+    """The store socket grants full control-plane read/write (Secrets
+    included): it must never exist with umask-default permissions, even
+    for the instant between bind() and the post-bind chmod. The bind runs
+    under umask 0o177 so the inode is BORN 0600 — asserted by capturing
+    the effective umask inside bind itself."""
+    import os
+    import socket as socket_mod
+    import stat
+
+    seen: dict = {}
+    real_bind = socket_mod.socket.bind
+
+    def spying_bind(self, addr):
+        if isinstance(addr, str):  # the unix path bind, not TCP
+            cur = os.umask(0)
+            os.umask(cur)
+            seen["umask"] = cur
+        return real_bind(self, addr)
+
+    monkeypatch.setattr(socket_mod.socket, "bind", spying_bind)
+    # a permissive ambient umask must not leak into the socket's birth mode
+    old = os.umask(0o000)
+    try:
+        store = Store()
+        path = f"{tmp_path}/born.sock"
+        server = StoreServer(store, f"unix://{path}").start()
+        try:
+            assert seen["umask"] == 0o177
+            mode = stat.S_IMODE(os.stat(path).st_mode)
+            assert mode == 0o600
+            # the narrowed umask was scoped to the bind, not left installed
+            cur = os.umask(0)
+            os.umask(cur)
+            assert cur == 0o000
+        finally:
+            server.stop()
+    finally:
+        os.umask(old)

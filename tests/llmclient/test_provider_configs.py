@@ -45,6 +45,7 @@ ANTHROPIC_RESPONSE = {
 
 def make_sa_credential(token_uri: str) -> str:
     """A real RSA keypair in a service_account JSON document."""
+    pytest.importorskip("cryptography")  # needed only to mint the test key
     from cryptography.hazmat.primitives import serialization
     from cryptography.hazmat.primitives.asymmetric import rsa
 

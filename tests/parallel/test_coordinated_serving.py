@@ -156,9 +156,36 @@ def test_follower_handshake_gates_admission():
         leader.close()
 
 
+def test_duplicate_follower_rank_rejected():
+    """Two connections claiming one rank means the real rank set is
+    incomplete: the duplicate HELLO is refused, wait_for_followers counts
+    DISTINCT ranks (lockstep can't be satisfied early by a double-connect),
+    and the original connection keeps receiving frames."""
+    leader = CoordinationLeader(bind="127.0.0.1:0")
+    try:
+        first = CoordinationFollower(leader.address, rank=1)
+        leader.wait_for_followers(1, timeout=10.0)
+        with pytest.raises(ConnectionError):
+            CoordinationFollower(
+                leader.address, rank=1, connect_timeout=5.0, recv_timeout=5.0
+            )
+        with pytest.raises(TimeoutError):
+            leader.wait_for_followers(2, timeout=0.5)
+        second = CoordinationFollower(leader.address, rank=2)
+        leader.wait_for_followers(2, timeout=10.0)
+        leader.publish([], [])
+        assert first.recv()["seq"] == 0
+        assert second.recv()["seq"] == 0
+        first.close()
+        second.close()
+    finally:
+        leader.close()
+
+
 def test_coordination_over_tls(tmp_path):
     """The frame channel with the REST surface's encryption posture: TLS +
     token; a plaintext client cannot join a TLS leader."""
+    pytest.importorskip("cryptography")  # needed only to mint the test cert
     from agentcontrolplane_tpu.engine.coordination import (
         client_ssl_context,
         server_ssl_context,

@@ -144,3 +144,66 @@ def test_trainer_pipeline_parallel_step_matches_plain():
     ref_loss = one_step({"dp": 1, "tp": 1})
     assert np.isfinite(pp_loss)
     np.testing.assert_allclose(pp_loss, ref_loss, rtol=2e-3)
+
+
+# -- gemma-2 soft-caps through the pipelined training path ------------------
+# (pure per-stage math — no shard_map, so these run on any device count)
+
+G2ISH = dataclasses.replace(
+    PRESETS["tiny"], n_layers=4, attn_logit_softcap=5.0, final_logit_softcap=3.0
+)
+
+
+def test_stage_apply_and_head_match_plain_forward_with_softcaps():
+    """The pipeline's per-stage body must thread the attention-logit
+    soft-cap and its head must apply the final-logit soft-cap: one stage
+    holding ALL layers, composed with the shared embed/norm/head, must
+    reproduce the plain forward exactly. Before the fix, _stage_apply
+    dropped the attention cap and pipeline_forward skipped the final cap —
+    silently training a different model than configured."""
+    from agentcontrolplane_tpu.models.llama import _embed, _final_norm_w, _head_logits
+    from agentcontrolplane_tpu.ops.norms import rms_norm
+    from agentcontrolplane_tpu.parallel.pipeline import _stage_apply
+
+    c = G2ISH
+    params = init_params(c, jax.random.key(1))
+    tokens = jnp.asarray(
+        np.random.default_rng(1).integers(1, c.vocab_size, size=(2, 16)),
+        dtype=jnp.int32,
+    )
+    positions = jnp.broadcast_to(jnp.arange(16, dtype=jnp.int32), (2, 16))
+    x = _embed(params, tokens, c)
+    x = _stage_apply(params["layers"], x, positions, c)
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
+    logits = _head_logits(x, params, c)
+    ref = forward(params, tokens, c)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(ref), rtol=2e-4, atol=2e-4)
+    # and the caps genuinely bite on this config (the comparison above is
+    # not vacuously equal to the uncapped model)
+    uncapped = forward(
+        params, tokens, dataclasses.replace(c, attn_logit_softcap=0.0, final_logit_softcap=0.0)
+    )
+    assert not np.allclose(np.asarray(ref), np.asarray(uncapped), rtol=2e-4, atol=2e-4)
+
+
+def test_forward_refuses_custom_attn_impl_with_softcap():
+    """refuse-don't-mis-serve: a swapped-in attention op can't apply the
+    configured attention soft-cap, so forward must raise instead of
+    silently computing the uncapped model."""
+    params = init_params(G2ISH, jax.random.key(0))
+    tokens = jnp.zeros((1, 8), dtype=jnp.int32)
+    with pytest.raises(ValueError, match="softcap"):
+        forward(params, tokens, G2ISH, attn_impl=lambda q, k, v, positions: q)
+
+
+def test_trainer_refuses_ring_attention_with_softcap():
+    import optax
+
+    from agentcontrolplane_tpu.train.trainer import Trainer
+
+    mesh = make_mesh({"sp": 2}, devices=jax.devices()[:2])
+    with pytest.raises(ValueError, match="soft"):
+        Trainer(
+            config=G2ISH, mesh=mesh, optimizer=optax.sgd(1e-3),
+            sequence_parallel=True,
+        )

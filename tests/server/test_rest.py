@@ -504,3 +504,92 @@ async def test_list_models_endpoint():
         assert resp.status == 200 and body["object"] == "list"
         ids = [m["id"] for m in body["data"]]
         assert "test-llm" in ids  # no engine configured in this harness
+
+
+async def test_chat_completions_sheds_503_with_retry_after_at_queue_cap():
+    """Bounded admission end to end: with the engine's admission queue at
+    its cap, the generate endpoint answers 503 + Retry-After immediately —
+    a client is never parked on an unbounded queue wait. An expired
+    queued-deadline (timeout_s) likewise fails fast with 504."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+        max_queue=1,
+    )
+    eng.start()
+    try:
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            body = {
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 4, "temperature": 0,
+            }
+            with eng.hold_admission():
+                # filler occupies the whole queue (cap 1) while held
+                filler = eng.submit("filler", SamplingParams(max_tokens=4))
+                resp = await h.http.post(f"{h.base}/v1/chat/completions", json=body)
+                assert resp.status == 503
+                assert int(resp.headers["Retry-After"]) >= 1
+                # streaming sheds the same way, BEFORE the SSE preamble
+                resp = await h.http.post(
+                    f"{h.base}/v1/chat/completions", json={**body, "stream": True}
+                )
+                assert resp.status == 503
+                assert int(resp.headers["Retry-After"]) >= 1
+            assert filler.result(timeout=120).finish_reason in ("stop", "length")
+            # released: the endpoint serves normally again
+            resp = await h.http.post(f"{h.base}/v1/chat/completions", json=body)
+            assert resp.status == 200
+    finally:
+        eng.stop()
+
+
+async def test_chat_completions_timeout_s_expires_queued_request_fast():
+    import dataclasses
+    import time
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    try:
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            with eng.hold_admission():
+                t0 = time.monotonic()
+                resp = await h.http.post(
+                    f"{h.base}/v1/chat/completions",
+                    json={
+                        "messages": [{"role": "user", "content": "hi"}],
+                        "max_tokens": 4, "temperature": 0, "timeout_s": 1,
+                    },
+                )
+                # expired while queued (held admission): fail fast — the
+                # per-request deadline, not the old hard-coded 600s
+                assert resp.status == 504
+                assert time.monotonic() - t0 < 30
+    finally:
+        eng.stop()

@@ -24,6 +24,7 @@ def _make_cert(tmp_path, name: str, cn: str, issuer_key=None, issuer_cert=None,
                is_ca: bool = False):
     """Self-signed (or CA-signed) cert + key PEM files; returns paths and
     the (cert, key) objects for chaining."""
+    pytest.importorskip("cryptography")  # needed only to mint the test certs
     from cryptography import x509
     from cryptography.hazmat.primitives import hashes, serialization
     from cryptography.hazmat.primitives.asymmetric import rsa

@@ -375,3 +375,25 @@ def test_parent_emits_json_line_even_when_run_raises(monkeypatch, capsys):
     out = capsys.readouterr().out.strip().splitlines()
     doc = json.loads(out[-1])
     assert doc["metric"] == "decode_tok_s_per_chip"
+
+
+def test_burst_flops_counts_lm_head_once_per_prefill():
+    """The engine's prefill computes logits only at the LAST prompt
+    position, so the lm_head matmul must be charged once per prefill —
+    charging it per prompt token overstates prefill FLOPs (and MFU)."""
+    from types import SimpleNamespace
+
+    c = SimpleNamespace(
+        dim=8, n_layers=2, n_heads=2, n_kv_heads=1, head_dim=4,
+        ffn_dim=16, vocab_size=32, n_experts=0, experts_per_token=0,
+    )
+    head = 2.0 * c.dim * c.vocab_size  # 512
+    P = 10  # prompt_len
+    per_tok = bench._flops_per_token(c, P / 2.0)
+    # one prefill, no decode: P layer-tokens + ONE head matmul
+    got = bench._burst_model_flops(c, P, prefills=1, gen_tokens=0, mean_ctx=0.0)
+    assert got == P * (per_tok - head) + head
+    assert got < P * per_tok  # strictly below the old per-token-head count
+    # decode tokens still pay the head every step (they each sample)
+    got2 = bench._burst_model_flops(c, P, prefills=1, gen_tokens=3, mean_ctx=12.0)
+    assert got2 == got + 3 * bench._flops_per_token(c, 12.0)
