@@ -71,6 +71,16 @@ def _add_tpu_flags(p) -> None:
         "requests are shed (REST 503 + Retry-After) instead of queueing "
         "unboundedly; 0 = unbounded",
     )
+    p.add_argument(
+        "--tpu-spec-len", type=int, default=0,
+        help="speculative decoding: max draft tokens verified per decode "
+        "dispatch via n-gram prompt lookup (greedy outputs stay "
+        "byte-identical; see docs/serving-engine.md); 0 = off",
+    )
+    p.add_argument(
+        "--tpu-spec-ngram", type=int, default=3,
+        help="longest n-gram the prompt-lookup drafter matches on",
+    )
 
 
 def _build_engine(args, coordination=None):
@@ -86,6 +96,8 @@ def _build_engine(args, coordination=None):
         kv_layout=args.tpu_kv_layout,
         quantize=args.tpu_quantize,
         max_queue=args.tpu_max_queue,
+        spec_len=args.tpu_spec_len,
+        spec_ngram=args.tpu_spec_ngram,
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:

@@ -157,6 +157,16 @@ class _Slot:
     prefix_len: int = 0  # leading forced tokens in ``generated``
     first_token_at: float = 0.0
     admit_seq: int = 0  # admission order (victim policy tie-break)
+    # speculative decoding: per-slot adaptive draft-length controller
+    # (engine/spec.py). Host-only — preemption saves nothing, re-admission
+    # rebuilds it fresh. None when the engine runs with spec_len == 0.
+    spec: Optional[object] = None
+    # prompt+generated as one int32 array for the drafter, appended
+    # incrementally (``generated`` only grows within a slot's lifetime;
+    # re-admission builds a fresh slot). Reboxing the whole context every
+    # verify dispatch would be O(ctx) host work in the decode hot loop.
+    ctx_buf: Optional[np.ndarray] = None
+    ctx_len: int = 0
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -206,6 +216,16 @@ class Engine:
         # (EngineOverloadedError -> REST 503 + Retry-After) instead of
         # queueing unboundedly. 0 = unbounded (tests, embedded use).
         max_queue: int = 0,
+        # model-free speculative decoding (prompt lookup): per slot, an
+        # n-gram drafter proposes up to spec_len tokens from earlier
+        # occurrences in prompt + generated-so-far, and ONE batched verify
+        # dispatch scores every position — accepted prefix + one corrected
+        # token land per dispatch instead of one token per model step.
+        # Greedy outputs are byte-identical to spec_len=0 (the accept op
+        # emits the VERIFIED argmax at every position; drafts only decide
+        # how many positions commit). 0 disables (the default).
+        spec_len: int = 0,
+        spec_ngram: int = 3,  # longest n-gram the drafter matches on
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
         # Multi-host lockstep serving (engine/coordination.py): rank 0
@@ -468,6 +488,12 @@ class Engine:
         self.table_uploads = 0  # paged: block-table host->device re-uploads
         self.max_queue = max(0, max_queue)
         self.preemptions = 0  # pool-pressure preempt-and-resume events
+        # speculative decoding state/counters (see _decode_spec)
+        self.spec_len = max(0, int(spec_len))
+        self.spec_ngram = max(1, int(spec_ngram))
+        self.spec_proposed = 0  # draft tokens sent to verification
+        self.spec_accepted = 0  # draft tokens the model agreed with
+        self.spec_dispatches = 0  # verify dispatches issued
         self._admit_seq = 0  # monotonically increasing admission stamp
         # fault-injection seam (faults.FAULTS): near-free when disabled —
         # every hook is guarded by the plain-bool ``enabled`` attribute
@@ -580,6 +606,39 @@ class Engine:
 
             return jax.jit(decode_block, donate_argnums=(1, 2, 3, 4, 5, 10, 13))
 
+        def make_verify(verify_fn):
+            """Speculative verify + on-device accept in one dispatch: the
+            multi-token continuation machinery scores every draft position,
+            then ``speculative_accept`` walks them with the SAME constraint
+            masking / stop / budget semantics as the decode block — greedy
+            emission at every position is the verified argmax, so spec-on
+            greedy output is byte-identical to spec-off. One fetch returns
+            (tokens, emitted counts, constraint states)."""
+            from ..ops.sampling import speculative_accept
+
+            stop_toks = tuple(sorted({int(t) for t in self.tokenizer.stop_tokens}))
+
+            def verify_block(
+                params, cache, inputs, n_input, starts, active, rng, temps,
+                top_ks, top_ps, table, con_states, constrained, min_close,
+                budgets, force_reject, *extra,
+            ):
+                cache, logits = verify_fn(params, cache, inputs, n_input, starts, *extra)
+                out_toks, n_emit, new_states = speculative_accept(
+                    logits, inputs, n_input, active, rng, temps, top_ks,
+                    top_ps, stop_toks, budgets, force_reject,
+                    constrain_fn=lambda l, s, b: constrain_logits(
+                        l, table, s, constrained, min_close, b
+                    ),
+                    advance_fn=lambda s, t, take: jnp.where(
+                        take, advance_constraint(table, s, constrained, t), s
+                    ),
+                    con_states=con_states,
+                )
+                return cache, out_toks, n_emit, new_states
+
+            return jax.jit(verify_block, donate_argnums=(1,))
+
         if self.kv_layout == "paged":
             from ..models.llama import (
                 decode_step_paged,
@@ -613,6 +672,13 @@ class Engine:
                     use_pallas=use_pallas, mesh=mesh,
                 )
             )
+            from ..models.llama import verify_paged_continue
+
+            self._jit_verify = make_verify(
+                lambda params, pages, inputs, n_input, starts, block_tables: verify_paged_continue(
+                    params, pages, inputs, n_input, starts, block_tables, config
+                )
+            )
         else:
 
             def prefill_and_sample(params, cache, tokens, lengths, slots, rng, temps, top_ks, top_ps, table, con_states, constrained, min_close, budgets):
@@ -635,6 +701,13 @@ class Engine:
             self._jit_decode = make_decode_block(
                 lambda params, cache, tokens, seq_lens, active: decode_step(
                     params, cache, tokens, seq_lens, config
+                )
+            )
+            from ..models.llama import verify_continue
+
+            self._jit_verify = make_verify(
+                lambda params, cache, inputs, n_input, starts: verify_continue(
+                    params, cache, inputs, n_input, starts, config
                 )
             )
 
@@ -1028,6 +1101,26 @@ class Engine:
             "decode_block_size": self.decode_block_size,
             "decode_steps": self.decode_steps,
             "tokens_generated": self.tokens_generated,
+            # decode efficiency: tokens committed per model step. Without
+            # speculation this is <= 1 (finished lanes pad blocks); with it,
+            # each verify dispatch counts ONE step however many tokens land,
+            # so > 1 means speculation is paying.
+            "tokens_per_decode_step": (
+                round(self.tokens_generated / self.decode_steps, 4)
+                if self.decode_steps else 0.0
+            ),
+            "spec": {
+                "enabled": self.spec_len > 0,
+                "spec_len": self.spec_len,
+                "ngram": self.spec_ngram,
+                "proposed": self.spec_proposed,
+                "accepted": self.spec_accepted,
+                "acceptance_rate": (
+                    round(self.spec_accepted / self.spec_proposed, 4)
+                    if self.spec_proposed else 0.0
+                ),
+                "verify_dispatches": self.spec_dispatches,
+            },
             "mesh": {
                 name: int(size)
                 for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
@@ -1824,6 +1917,10 @@ class Engine:
                 first_token_at=req.first_token_at,
                 admit_seq=self._admit_seq,
             )
+            if self.spec_len:
+                from .spec import SpecState
+
+                sl.spec = SpecState(limit=self.spec_len)
             sl.generated.extend(s.forced_prefix)
             sl.generated.extend(req.resume_tokens)
             sl.generated.append(first_tok)
@@ -1850,9 +1947,11 @@ class Engine:
                     slot, "stop" if first_tok in self.tokenizer.stop_tokens else "length"
                 )
 
-    def _ensure_pages_for_block(self) -> None:
+    def _ensure_pages_for_block(self, need_tokens: Optional[dict] = None) -> None:
         """Paged mode: every active slot's table must cover the next K
-        tokens before dispatch. A slot the pool can't cover triggers
+        tokens before dispatch (or, per slot, ``need_tokens[slot]`` —
+        the speculative verify path writes 1 + draft-length KV rows in one
+        dispatch). A slot the pool can't cover triggers
         PREEMPT-AND-RESUME (never a silent truncation): prefix-cache
         entries yield first, then a policy victim is preempted — its
         generated-so-far tokens are saved on the request, its pages freed,
@@ -1867,7 +1966,8 @@ class Engine:
         for slot in list(self._slots):
             if slot not in self._slots:
                 continue  # preempted as a victim for an earlier slot
-            needed = -(-(int(self._seq_lens[slot]) + K) // self.page_size)
+            need = K if need_tokens is None else need_tokens.get(slot, K)
+            needed = -(-(int(self._seq_lens[slot]) + need) // self.page_size)
             # ctx edge: the decode block deactivates the slot on device at
             # max_ctx-1, so a fully-populated table is always enough — clamp
             # instead of force-finishing (a force-finish here could truncate
@@ -1877,7 +1977,7 @@ class Engine:
             have = len(self._slot_pages.get(slot, []))
             if needed <= have:
                 continue
-            new_pages = self._alloc_with_preemption(needed - have, slot)
+            new_pages = self._alloc_with_preemption(needed - have, slot, need_tokens)
             if new_pages is None:
                 continue  # slot itself was preempted (requeued or finished)
             self._append_pages(slot, new_pages)
@@ -1889,7 +1989,9 @@ class Engine:
         # host->device RTT in the hot loop) per dispatch. Topping up to
         # `page_lookahead_blocks` blocks of pages makes it one upload per
         # lookahead window; a failed top-up is harmless.
-        ahead = K * self.page_lookahead_blocks
+        # speculation writes up to spec_len+1 rows per dispatch; size the
+        # lookahead window to whichever dispatch shape is larger
+        ahead = max(K, self.spec_len + 1) * self.page_lookahead_blocks
         for slot in crossed:
             if slot not in self._slot_pages:
                 continue
@@ -1905,14 +2007,24 @@ class Engine:
             except MemoryError:
                 pass  # pool tight: strict coverage already satisfied
 
-    def _alloc_reclaiming_lookahead(self, n: int, requester: int) -> list[int] | None:
+    def _alloc_reclaiming_lookahead(
+        self, n: int, requester: int, need_tokens: Optional[dict] = None
+    ) -> list[int] | None:
         """Alloc ``n`` pages; on exhaustion, claw back other slots' UNUSED
         lookahead pages (beyond their strict next-block need) and retry.
         Without this, pass-2 top-ups from earlier rounds could hoard pages
         and preempt a strictly-fitting slot in a later round — 'lookahead
         never starves a strict fit' must hold across rounds, not just within
         one. The trimmed slots' tables re-upload next boundary crossing;
-        that cost only occurs when the pool is already exhausted."""
+        that cost only occurs when the pool is already exhausted.
+
+        ``need_tokens`` is THIS dispatch's per-slot row count (speculative
+        verify writes 1 + draft rows, which can exceed the decode block):
+        the reclaim floor must honor it, or a later slot's allocation in the
+        same pass strips pages an earlier slot was just granted for its
+        draft tail — the dispatch would then write that KV to the trash
+        page while the host advances ``seq_len`` over it, and every later
+        attention pass for the slot reads garbage."""
         try:
             return self._allocator.alloc(n)
         except MemoryError:
@@ -1923,8 +2035,9 @@ class Engine:
             table = self._slot_pages.get(slot)
             if slot == requester or not table:
                 continue
+            need = K if need_tokens is None else max(K, need_tokens.get(slot, K))
             strict = min(
-                -(-(int(self._seq_lens[slot]) + K) // self.page_size),
+                -(-(int(self._seq_lens[slot]) + need) // self.page_size),
                 self.max_pages_per_seq,
             )
             if len(table) > strict:
@@ -1941,14 +2054,16 @@ class Engine:
         except MemoryError:
             return None
 
-    def _alloc_with_preemption(self, n: int, requester: int) -> list[int] | None:
+    def _alloc_with_preemption(
+        self, n: int, requester: int, need_tokens: Optional[dict] = None
+    ) -> list[int] | None:
         """Alloc ``n`` pages for an active slot, escalating on exhaustion:
         (1) claw back other slots' unused lookahead pages, (2) evict prefix
         -cache entries (cache must never starve live work), (3) preempt
         policy victims until the allocation fits or the requester itself is
         the victim. Returns None iff the requester was preempted."""
         while True:
-            pages = self._alloc_reclaiming_lookahead(n, requester)
+            pages = self._alloc_reclaiming_lookahead(n, requester, need_tokens)
             if pages is not None:
                 return pages
             if self._evict_one_prefix_entry():
@@ -2072,6 +2187,13 @@ class Engine:
                     self._preempt(victim)
         if not self._slots:
             return
+        # speculative decoding: when enabled and at least one slot has a
+        # draft, ONE verify dispatch replaces this iteration's decode block
+        # (it commits 1 + accepted tokens per slot). When no slot drafts —
+        # adversarial text, decayed adaptive caps — fall through to the
+        # plain block path, which is exactly the spec-off engine.
+        if self.spec_len and self._decode_spec():
+            return
         K = self.decode_block_size
         if self.kv_layout == "paged":
             self._ensure_pages_for_block()
@@ -2104,13 +2226,7 @@ class Engine:
             # transfer cost
             use_real = self._token_table is not None
             for slot, sl in self._slots.items():
-                token_left = sl.request.sampling.max_tokens - (
-                    len(sl.generated) - sl.prefix_len
-                )
-                # true remaining capacity: the device deactivates a slot
-                # after the token that lands it at max_ctx-1
-                ctx_left = self.max_ctx - 1 - int(self._seq_lens[slot])
-                self._budgets[slot] = max(0, min(token_left, ctx_left))
+                self._budgets[slot] = self._slot_budget(slot, sl)
             self._dev = {
                 "W": W,
                 "tokens": self._put(self._last_tokens[:W]),
@@ -2161,30 +2277,40 @@ class Engine:
         # tok_block: [K, W]
         K = tok_block.shape[0]
         self.decode_steps += K
-        active = list(self._slots.items())
-        for slot, sl in active:
-            s = sl.request.sampling
-            done = None
-            block_new: list[int] = []
-            for k in range(K):
-                tok = int(tok_block[k, slot])
-                self._seq_lens[slot] += 1
-                self._last_tokens[slot] = tok
-                sl.generated.append(tok)
-                self.tokens_generated += 1
-                if tok in self.tokenizer.stop_tokens:
-                    done = "stop"
-                    break
-                block_new.append(tok)
-                if (
-                    len(sl.generated) - sl.prefix_len >= s.max_tokens
-                    or self._seq_lens[slot] + 1 >= self.max_ctx
-                ):
-                    done = "length"
-                    break
-            sl.request.emit(block_new)
-            if done is not None:
-                self._finish(slot, done)
+        for slot, sl in list(self._slots.items()):
+            self._consume_tokens(slot, sl, (int(tok_block[k, slot]) for k in range(K)))
+        self._publish_decode_gauges()
+
+    def _consume_tokens(self, slot: int, sl: _Slot, toks) -> None:
+        """Host-side commit of one dispatch's newly sampled tokens for one
+        slot (shared by the decode block and the speculative verify path):
+        advance the host mirrors, stream to the caller, and finish at the
+        first stop token / exhausted budget / context edge — the same spots
+        the device deactivated the lane, so host and device bookkeeping
+        never diverge."""
+        s = sl.request.sampling
+        done = None
+        block_new: list[int] = []
+        for tok in toks:
+            self._seq_lens[slot] += 1
+            self._last_tokens[slot] = tok
+            sl.generated.append(tok)
+            self.tokens_generated += 1
+            if tok in self.tokenizer.stop_tokens:
+                done = "stop"
+                break
+            block_new.append(tok)
+            if (
+                len(sl.generated) - sl.prefix_len >= s.max_tokens
+                or self._seq_lens[slot] + 1 >= self.max_ctx
+            ):
+                done = "length"
+                break
+        sl.request.emit(block_new)
+        if done is not None:
+            self._finish(slot, done)
+
+    def _publish_decode_gauges(self) -> None:
         REGISTRY.gauge_set(
             "acp_engine_active_slots", len(self._slots), help="occupied decode slots"
         )
@@ -2197,6 +2323,180 @@ class Engine:
             self._preempted_waiting(),
             help="preempted requests requeued and awaiting resume",
         )
+
+    def _slot_budget(self, slot: int, sl: _Slot) -> int:
+        """Sampled tokens this slot may still emit — min of its remaining
+        ``max_tokens`` and the context edge (the device deactivates a slot
+        after the token that lands it at max_ctx-1). The decode block and
+        the speculative verify dispatch MUST share this computation: the
+        device-side budget decrement and host max_tokens accounting stay
+        consistent only if both paths upload the same number."""
+        token_left = sl.request.sampling.max_tokens - (
+            len(sl.generated) - sl.prefix_len
+        )
+        ctx_left = self.max_ctx - 1 - int(self._seq_lens[slot])
+        return max(0, min(token_left, ctx_left))
+
+    def _slot_ctx(self, sl: _Slot) -> np.ndarray:
+        """Prompt+generated as one int32 view for the drafter, synced by
+        appending only the tokens emitted since the last dispatch."""
+        n_prompt = len(sl.request.prompt)
+        total = n_prompt + len(sl.generated)
+        if sl.ctx_buf is None:
+            sl.ctx_buf = np.empty(max(total, self.max_ctx), dtype=np.int32)
+            sl.ctx_buf[:n_prompt] = sl.request.prompt
+            sl.ctx_len = n_prompt
+        elif total > sl.ctx_buf.shape[0]:
+            sl.ctx_buf = np.concatenate(
+                [sl.ctx_buf, np.empty(total, dtype=np.int32)]
+            )
+        if sl.ctx_len < total:
+            sl.ctx_buf[sl.ctx_len : total] = sl.generated[sl.ctx_len - n_prompt :]
+            sl.ctx_len = total
+        return sl.ctx_buf[:total]
+
+    def _decode_spec(self) -> bool:
+        """One speculative decode iteration: draft host-side (n-gram prompt
+        lookup over prompt + generated-so-far), verify every position in a
+        single batched dispatch, commit the accepted prefix + one corrected
+        token per slot. Returns False (nothing dispatched) when no active
+        slot produced a draft — the caller then runs the plain decode block,
+        which is byte-for-byte today's non-speculative path.
+
+        Composition notes:
+        - KV: the verify program writes every draft position optimistically;
+          rollback of a rejected tail is implicit — the host advances
+          ``seq_lens`` only over emitted tokens and attention never reads
+          beyond ``seq_len`` (paged: the extra rows sit in pages the slot
+          already owns, exactly like decode-block lookahead pages).
+        - Device-resident decode state: the spec path syncs with the host
+          every dispatch by construction (the drafter needs the sampled
+          tokens), so it re-uploads the small per-slot arrays each time and
+          marks ``_state_dirty`` — a later fallback block re-uploads the
+          carried state like any other dirty block.
+        - Preemption/prefix cache: drafts are host-only; page pressure in
+          ``_ensure_pages_for_block`` preempts exactly as in the block path
+          (preempted slots are dropped from this dispatch).
+        """
+        from .spec import ngram_propose
+
+        T = self.spec_len + 1  # one trace shape per width bucket
+        drafts: dict[int, list[int]] = {}
+        budgets_eff: dict[int, int] = {}
+        any_draft = False
+        for slot, sl in self._slots.items():
+            budget = self._slot_budget(slot, sl)
+            budgets_eff[slot] = budget
+            # the dispatch emits up to draft+1 tokens and writes draft+1 KV
+            # rows: cap the draft so both stay within budget (and therefore
+            # within the context edge — budget <= ctx_left)
+            cap = min(sl.spec.cap(), budget - 1) if sl.spec else 0
+            d: list[int] = []
+            if cap > 0:
+                d = ngram_propose(self._slot_ctx(sl), self.spec_ngram, cap)
+            drafts[slot] = d
+            any_draft = any_draft or bool(d)
+        if not any_draft:
+            return False
+        if self.kv_layout == "paged":
+            # page coverage for the widest row each slot verifies; a slot
+            # preempted under pressure here simply leaves the dispatch
+            self._ensure_pages_for_block(
+                {slot: 1 + len(d) for slot, d in drafts.items()}
+            )
+            if not self._slots:
+                return True
+            drafts = {s: d for s, d in drafts.items() if s in self._slots}
+            if not any(drafts.values()):
+                return False  # the drafted slots were preempted; block-decode
+        force_reject = bool(
+            self._faults.enabled
+            and self._faults.pop("engine.spec_mismatch") is not None
+        )
+        W = next(w for w in self.width_buckets if w >= max(self._slots) + 1)
+        inputs = np.zeros((W, T), dtype=np.int32)
+        n_input = np.ones(W, dtype=np.int32)
+        starts = np.zeros(W, dtype=np.int32)
+        active = np.zeros(W, dtype=bool)
+        budgets = np.zeros(W, dtype=np.int32)
+        proposed = np.zeros(W, dtype=np.int32)
+        for slot, sl in self._slots.items():
+            d = drafts.get(slot, [])
+            inputs[slot, 0] = self._last_tokens[slot]
+            if d:
+                inputs[slot, 1 : 1 + len(d)] = d
+            n_input[slot] = 1 + len(d)
+            starts[slot] = self._seq_lens[slot]
+            active[slot] = True
+            budgets[slot] = budgets_eff[slot]
+            proposed[slot] = len(d)
+        use_real = self._token_table is not None
+        self._rng, step_rng = jax.random.split(self._rng)
+        args = [
+            self.params,
+            self.cache,
+            self._put(inputs),
+            self._put(n_input),
+            self._put(starts),
+            self._put(active),
+            step_rng,
+            self._put(self._temps[:W]),
+            self._put(self._top_ks[:W]),
+            self._put(self._top_ps[:W]),
+            self._token_table if use_real else self._dummy_table,
+            self._put(self._con_states[:W]),
+            self._put(self._constrained[:W]),
+            self._min_close if use_real else self._dummy_min_close,
+            self._put(budgets),
+            self._put(np.asarray(force_reject)),
+        ]
+        if self.kv_layout == "paged":
+            args.append(self._put(self._block_tables[:W]))
+        cache, out_toks, n_emit, new_states = self._jit_verify(*args)
+        self.cache = cache
+        # one combined host round trip, same discipline as the block path
+        out_toks, n_emit, new_states = jax.device_get((out_toks, n_emit, new_states))
+        self._con_states[:W] = new_states
+        self.decode_steps += 1  # one model forward, however many tokens land
+        self.spec_dispatches += 1
+        self._state_dirty = True  # host mirrors advanced; next block re-uploads
+        for slot, sl in list(self._slots.items()):
+            n = int(n_emit[slot])
+            prop = int(proposed[slot])
+            if prop:
+                # emitted = accepted prefix + one corrected token — except
+                # when emission ended ON a matching draft token (stop token
+                # or budget exhaustion), where the final token is an
+                # accepted draft token too. force_reject means the device
+                # treated every position as mismatched; a numerically-equal
+                # final token must not count as accepted or the AIMD
+                # controller would see partial acceptance under the
+                # spec_mismatch fault and never decay.
+                d = drafts.get(slot, [])
+                acc = max(0, n - 1)
+                if (
+                    not force_reject
+                    and 0 < n <= len(d)
+                    and int(out_toks[slot, n - 1]) == d[n - 1]
+                ):
+                    acc = n
+                acc = min(acc, prop)
+                self.spec_proposed += prop
+                self.spec_accepted += acc
+                if sl.spec is not None:
+                    sl.spec.observe(prop, acc)
+                REGISTRY.counter_add(
+                    "acp_engine_spec_proposed_total", float(prop),
+                    help="draft tokens proposed to speculative verification",
+                )
+                REGISTRY.counter_add(
+                    "acp_engine_spec_accepted_total", float(acc),
+                    help="draft tokens accepted by speculative verification",
+                )
+            if n > 0:
+                self._consume_tokens(slot, sl, (int(t) for t in out_toks[slot, :n]))
+        self._publish_decode_gauges()
+        return True
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.pop(slot)

@@ -17,6 +17,13 @@ Engine sites (see ``engine/engine.py``):
   block where ``decode_steps >= after_steps``.
 - ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
   (released when disarmed/reset), shrinking the pool mid-serve.
+- ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:
+  for the next ``times=N`` verify dispatches every draft token is treated
+  as mismatched (full rejection), so each dispatch commits exactly one
+  (still byte-identical) corrected token and the whole rejected tail's KV
+  is rolled back. Exercises the rollback path and the adaptive draft-length
+  decay without perturbing outputs — the accept op always emits the
+  verified model token, never the draft.
 
 This module is deliberately dependency-free (stdlib only) so the engine
 can import it without pulling in the control-plane kernel or the test

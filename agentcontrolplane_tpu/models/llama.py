@@ -589,7 +589,7 @@ def prefill(
     return cache, logits[0]
 
 
-def prefill_continue(
+def _continue_forward(
     params: dict,
     cache: dict,
     tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
@@ -598,12 +598,14 @@ def prefill_continue(
     slots: jax.Array,  # [B] int32
     config: LlamaConfig,
 ) -> tuple[dict, jax.Array]:
-    """Prefix-cache continuation: the first ``starts[b]`` positions of each
-    slot's KV rows were already populated (copied from the prefix cache);
-    run only the suffix through the model, attending over prefix + suffix.
-    Costs O(suffix) model FLOPs instead of O(full prompt) — the win that
-    makes multi-turn agent conversations cheap (each turn's prompt extends
-    the previous one). Returns (cache, last-token logits [B, V])."""
+    """Shared continuation body (slot layout): the first ``starts[b]``
+    positions of each slot's KV rows are already populated; run only the
+    suffix through the model, attending over prefix + suffix, and commit the
+    suffix K/V. Returns ``(cache, x_normed [B, T, D])`` — the final-norm
+    hidden states at EVERY suffix position, so callers choose the head:
+    :func:`prefill_continue` projects only the last token (prefix-cache
+    hits / chunked prefill), :func:`verify_continue` projects all positions
+    (speculative verification)."""
     c = config
     B, T = tokens.shape
     ar = jnp.arange(T)
@@ -612,7 +614,8 @@ def prefill_continue(
     C = cache["k"].shape[2]
     # scatter indices for the suffix writes; clamped so bucket padding can
     # never write past the row (clamped garbage lands at C-1, which is
-    # always re-written by decode before it becomes readable)
+    # never readable: attention masks at seq_len, and a slot finishes
+    # before its seq_len reaches C)
     write_pos = jnp.minimum(starts[:, None] + ar[None, :], C - 1)  # [B, T]
 
     # keys = [prefix rows (read-only, positions < start) ++ own suffix];
@@ -654,9 +657,54 @@ def prefill_continue(
         new_v.astype(cache["v"].dtype)
     )
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
+    return {"k": k_all, "v": v_all}, x
+
+
+def prefill_continue(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true suffix lengths
+    starts: jax.Array,  # [B] int32 — absolute position of each suffix start
+    slots: jax.Array,  # [B] int32
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Prefix-cache continuation: the first ``starts[b]`` positions of each
+    slot's KV rows were already populated (copied from the prefix cache);
+    run only the suffix through the model, attending over prefix + suffix.
+    Costs O(suffix) model FLOPs instead of O(full prompt) — the win that
+    makes multi-turn agent conversations cheap (each turn's prompt extends
+    the previous one). Returns (cache, last-token logits [B, V])."""
+    B = tokens.shape[0]
+    cache, x = _continue_forward(params, cache, tokens, lengths, starts, slots, config)
     last = x[jnp.arange(B), lengths - 1]
-    logits = _head_logits(last, params, c)
-    return {"k": k_all, "v": v_all}, logits
+    logits = _head_logits(last, params, config)
+    return cache, logits
+
+
+def verify_continue(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, T] int32 — last sampled token + draft (rows padded)
+    lengths: jax.Array,  # [B] int32 — 1 + draft length per row
+    starts: jax.Array,  # [B] int32 — seq_len per row (first unwritten KV position)
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Speculative-decode verify pass (slot layout): score EVERY draft
+    position in one dispatch. Row ``b`` IS decode lane/slot ``b`` (the spec
+    path always dispatches the compacted width, so no slot indirection is
+    needed). Same attention/KV-write semantics as :func:`prefill_continue`;
+    the only difference is the head: logits at ALL positions [B, T, V], so
+    ``logits[b, i]`` scores the token following ``tokens[b, i]`` — exactly
+    what :func:`agentcontrolplane_tpu.ops.sampling.speculative_accept`
+    consumes. KV for the whole row is written optimistically; a rejected
+    tail needs no rollback because the engine only advances ``seq_len`` over
+    the accepted prefix and attention never reads beyond it."""
+    B = tokens.shape[0]
+    cache, x = _continue_forward(
+        params, cache, tokens, lengths, starts, jnp.arange(B), config
+    )
+    return cache, _head_logits(x, params, config)
 
 
 # ---------------------------------------------------------------------------
@@ -730,21 +778,22 @@ def prefill_paged(
     return pages, logits[0]
 
 
-def prefill_paged_continue(
+def _paged_continue_forward(
     params: dict,
     pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
-    tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
-    lengths: jax.Array,  # [B] int32 — true suffix lengths
-    starts: jax.Array,  # [B] int32 — absolute suffix start (page-aligned)
-    page_ids: jax.Array,  # [B, T // P] int32 — the SUFFIX pages
-    block_tables: jax.Array,  # [B, max_pages] int32 — prefix + suffix pages
+    tokens: jax.Array,  # [B, T] int32 — new tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true token counts
+    starts: jax.Array,  # [B] int32 — absolute position of each row's first token
+    block_tables: jax.Array,  # [B, max_pages] int32
     config: LlamaConfig,
-) -> tuple[dict, jax.Array]:
-    """Paged prefix-cache continuation: the prefix pages referenced by each
-    row's block table are already populated (SHARED with the cache entry —
-    never written here; starts are page-aligned so suffix writes only touch
-    fresh pages). Runs the suffix through the model, attending over the
-    gathered prefix+suffix pages. Returns (pages, last-token logits [B, V])."""
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Shared paged continuation body: run each row's new tokens through the
+    model attending over its gathered prefix pages (positions < start) plus
+    the new tokens themselves. Returns ``(new_k, new_v, x_normed)`` with
+    ``new_k/new_v`` [L, B, T, H_kv, d] UNCOMMITTED — the callers commit
+    differently: :func:`prefill_paged_continue` writes whole (page-aligned,
+    fresh) pages, :func:`verify_paged_continue` scatters per token because a
+    draft starts mid-page, inside a page holding live prefix KV."""
     c = config
     B, T = tokens.shape
     ar = jnp.arange(T)
@@ -795,16 +844,70 @@ def prefill_paged_continue(
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], pages["k"], pages["v"])
     )
+    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
+    return new_k, new_v, x
+
+
+def prefill_paged_continue(
+    params: dict,
+    pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
+    tokens: jax.Array,  # [B, T] int32 — SUFFIX tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true suffix lengths
+    starts: jax.Array,  # [B] int32 — absolute suffix start (page-aligned)
+    page_ids: jax.Array,  # [B, T // P] int32 — the SUFFIX pages
+    block_tables: jax.Array,  # [B, max_pages] int32 — prefix + suffix pages
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Paged prefix-cache continuation: the prefix pages referenced by each
+    row's block table are already populated (SHARED with the cache entry —
+    never written here; starts are page-aligned so suffix writes only touch
+    fresh pages). Runs the suffix through the model, attending over the
+    gathered prefix+suffix pages. Returns (pages, last-token logits [B, V])."""
+    B, T = tokens.shape
+    P = pages["k"].shape[2]
+    new_k, new_v, x = _paged_continue_forward(
+        params, pages, tokens, lengths, starts, block_tables, config
+    )
     # one scatter commits the suffix blocks for every layer
     L = new_k.shape[0]
     blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
     flat_ids = page_ids.reshape(-1)
     k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
     v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
-    x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
-    logits = _head_logits(last, params, c)
+    logits = _head_logits(last, params, config)
     return {"k": k_all, "v": v_all}, logits
+
+
+def verify_paged_continue(
+    params: dict,
+    pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
+    tokens: jax.Array,  # [B, T] int32 — last sampled token + draft (rows padded)
+    lengths: jax.Array,  # [B] int32 — 1 + draft length per row
+    starts: jax.Array,  # [B] int32 — seq_len per row (NOT page-aligned)
+    block_tables: jax.Array,  # [B, max_pages] int32
+    config: LlamaConfig,
+) -> tuple[dict, jax.Array]:
+    """Speculative-decode verify pass (paged layout): score every draft
+    position in one dispatch over the gathered block-table pages. Unlike
+    :func:`prefill_paged_continue`, the rows start MID-PAGE (``starts`` is
+    the slot's live seq_len), so the commit scatters per token via
+    :func:`agentcontrolplane_tpu.ops.paged.token_write_targets` — a page-
+    granular write would clobber the live prefix KV sharing the first page.
+    Padded positions land on the trash page. Returns (pages, logits
+    [B, T, V]); the rejected tail's KV needs no rollback (attention masks
+    by seq_len, which the engine only advances over the accepted prefix)."""
+    from ..ops.paged import token_write_targets
+
+    B, T = tokens.shape
+    P = pages["k"].shape[2]
+    new_k, new_v, x = _paged_continue_forward(
+        params, pages, tokens, lengths, starts, block_tables, config
+    )
+    target, offset = token_write_targets(block_tables, starts, lengths, P, T)
+    k_all = pages["k"].at[:, target, offset].set(new_k.astype(pages["k"].dtype))
+    v_all = pages["v"].at[:, target, offset].set(new_v.astype(pages["v"].dtype))
+    return {"k": k_all, "v": v_all}, _head_logits(x, params, config)
 
 
 def decode_step_paged(

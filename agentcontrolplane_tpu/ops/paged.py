@@ -63,6 +63,30 @@ def write_token_to_pages(
     return k_pages, v_pages
 
 
+def token_write_targets(
+    block_tables: jax.Array,  # [S, max_pages] int32
+    starts: jax.Array,  # [S] int32 — absolute position of each row's first token
+    lengths: jax.Array,  # [S] int32 — valid tokens per row
+    page_size: int,
+    T: int,  # row width (padded token count)
+) -> tuple[jax.Array, jax.Array]:
+    """Per-token scatter targets for a multi-token write whose start is NOT
+    page-aligned (speculative verify: the draft begins mid-page, inside a
+    page that already holds live prefix KV — the page-granular commit of
+    ``prefill_paged_continue`` would clobber it). Returns ``(pages [S, T],
+    offsets [S, T])``; padded positions (beyond ``lengths``) land on the
+    trash page, and page indexes are clamped so bucket padding can never
+    gather out of bounds."""
+    S = starts.shape[0]
+    ar = jnp.arange(T)
+    pos = starts[:, None] + ar[None, :]  # [S, T]
+    valid = ar[None, :] < lengths[:, None]
+    page_idx = jnp.minimum(pos // page_size, block_tables.shape[1] - 1)
+    pages = jnp.take_along_axis(block_tables, page_idx, axis=1)
+    pages = jnp.where(valid, pages, TRASH_PAGE)
+    return pages, pos % page_size
+
+
 def paged_decode_attention_reference(
     q: jax.Array,  # [S, H, d] — one new token per slot
     k_pages: jax.Array,  # [num_pages, P, H_kv, d]

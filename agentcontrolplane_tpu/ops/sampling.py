@@ -65,6 +65,75 @@ def _topp_threshold(
     return hi[:, None], probs
 
 
+def speculative_accept(
+    logits: jax.Array,  # [S, T, V] float32 — logits[s, i] scores the token AFTER inputs[s, i]
+    inputs: jax.Array,  # [S, T] int32 — row 0 is the last sampled token, rest the draft
+    n_input: jax.Array,  # [S] int32 — valid prefix of ``inputs`` (1 + draft length)
+    active: jax.Array,  # [S] bool — inactive lanes emit nothing
+    rng: jax.Array,
+    temperature: jax.Array,  # [S]
+    top_k: jax.Array,  # [S] int32
+    top_p: jax.Array,  # [S] float32
+    stop_tokens: tuple,  # static: emission halts AFTER a stop token
+    budgets: jax.Array,  # [S] int32 — sampled tokens remaining INCLUDING this dispatch's
+    force_reject: jax.Array,  # [] bool — fault injection: treat every draft as mismatched
+    constrain_fn=None,  # (logits [S, V], con_state [S], budget [S]) -> logits
+    advance_fn=None,  # (con_state [S], toks [S], take [S] bool) -> con_state
+    con_states: jax.Array = None,  # [S] int32
+) -> tuple[jax.Array, jax.Array, jax.Array]:
+    """Vectorized accept for speculative decoding (one verify dispatch).
+
+    Walks the T scored positions per lane: at each position the token is
+    sampled from the VERIFIED logits (greedy = argmax, so greedy emission is
+    exactly the non-speculative engine's choice); emission continues to the
+    next position only while the sampled token equals the drafted one — the
+    first mismatch emits the corrected token and stops. Every emitted token
+    is therefore distributed exactly as ancestral sampling from the model;
+    the draft only decides how many positions land per dispatch. Rollback is
+    implicit: the caller advances ``seq_len`` by the emitted count and the
+    rejected tail's KV is dead (never read — attention masks by position).
+
+    Returns ``(out_tokens [S, T], n_emit [S], con_states [S])`` where
+    ``out_tokens[s, : n_emit[s]]`` are the committed tokens (-1 padded) and
+    ``con_states`` advanced over exactly the emitted tokens.
+    """
+    S, T, V = logits.shape
+    if con_states is None:
+        con_states = jnp.zeros((S,), jnp.int32)
+    # draft candidate for position i is the NEXT input token (shifted left)
+    cand = jnp.concatenate(
+        [inputs[:, 1:], jnp.zeros((S, 1), inputs.dtype)], axis=1
+    )
+
+    def step(carry, xs):
+        emitting, state, budget, rng = carry
+        logits_i, cand_i, has_draft = xs
+        l = constrain_fn(logits_i, state, budget) if constrain_fn is not None else logits_i
+        rng, sub = jax.random.split(rng)
+        tok = sample(l, sub, temperature, top_k, top_p)
+        out_i = jnp.where(emitting, tok, -1)
+        take = emitting
+        budget = budget - take.astype(budget.dtype)
+        if advance_fn is not None:
+            state = advance_fn(state, tok, take)
+        is_stop = jnp.zeros_like(emitting)
+        for st in stop_tokens:
+            is_stop = is_stop | (tok == st)
+        match = has_draft & (tok == cand_i) & ~force_reject
+        emitting = take & match & ~is_stop & (budget > 0)
+        return (emitting, state, budget, rng), out_i
+
+    has_draft = (jnp.arange(T)[:, None] + 1) < n_input[None, :]  # [T, S]
+    (_, state, _, _), outs = jax.lax.scan(
+        step,
+        (active, con_states, budgets, rng),
+        (jnp.swapaxes(logits, 0, 1), cand.T, has_draft),
+    )
+    out_tokens = outs.T  # [S, T]
+    n_emit = jnp.sum(out_tokens >= 0, axis=1).astype(jnp.int32)
+    return out_tokens, n_emit, state
+
+
 def sample(
     logits: jax.Array,  # [S, V] float32
     rng: jax.Array,

@@ -1133,6 +1133,12 @@ class RestServer:
                     "acp_engine_waiting_requests", float(s["waiting"]),
                     help="admission queue depth",
                 )
+                REGISTRY.gauge_set(
+                    "acp_engine_tokens_per_decode_step",
+                    float(s.get("tokens_per_decode_step", 0.0)),
+                    help="mean tokens committed per decode model step "
+                    "(> 1 means speculative decoding is paying)",
+                )
             except Exception:
                 pass  # a crashed engine must not take /metrics down
 

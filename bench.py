@@ -19,6 +19,12 @@ phase runs in a watchdogged CHILD process:
          ──main child───▶ ``bench.py --phase main``  (attach → engine → burst → TTFT)
          ──ab child─────▶ ``bench.py --phase ab``    (the other KV layout)
 
+``ACP_BENCH_SPEC_LEN`` (default 0 = off) opts the burst into n-gram
+prompt-lookup speculative decoding (``ACP_BENCH_SPEC_NGRAM`` tunes the
+drafter); the emitted payloads then carry an additive ``spec`` block —
+acceptance counters plus ``spec_accepted_tokens_per_block`` and a spec-
+on/off delta note — without changing what the headline metric measures.
+
 Children report progress via ``MARK <name>`` / ``RESULT <key> <json>`` lines
 on stdout; the parent enforces a per-mark deadline schedule and SIGKILLs a
 child that misses one (a hung PJRT attach leaves threads alive, so
@@ -456,6 +462,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                     # if the peak table is ever corrected
                     if "peak_flops_per_chip" in val:
                         doc["peak_flops_per_chip"] = val["peak_flops_per_chip"]
+                if "spec" in val:  # additive; absent unless ACP_BENCH_SPEC_LEN
+                    doc["spec"] = val["spec"]
             elif key == "ttft" and got["ttft"] is None:
                 got["ttft"] = val
                 doc["ttft_first_toolcall_ms"] = val
@@ -530,6 +538,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc[f"{other}_tok_s_per_chip"] = ab["tok_s_per_chip"]
                 if "mfu" in ab:
                     doc[f"{other}_mfu"] = ab["mfu"]
+                if "spec" in ab:
+                    doc[f"{other}_spec"] = ab["spec"]
                 doc["kv_layout_winner"] = (
                     kv_layout if doc["value"] >= ab["tok_s_per_chip"] else other
                 )
@@ -647,6 +657,10 @@ def _child(args: argparse.Namespace) -> None:
     quantize = os.environ.get("ACP_BENCH_QUANTIZE") or None
     deadline_s = float(os.environ.get("ACP_BENCH_DEADLINE_S", "420"))
     kv_layout = args.layout or os.environ.get("ACP_BENCH_KV_LAYOUT", "slot")
+    # speculative decoding knobs (off by default so the headline's meaning
+    # is unchanged unless the operator opts in, like ACP_BENCH_QUANTIZE)
+    spec_len = int(os.environ.get("ACP_BENCH_SPEC_LEN", "0"))
+    spec_ngram = int(os.environ.get("ACP_BENCH_SPEC_NGRAM", "3"))
     if args.budget:
         deadline_s = min(deadline_s, args.budget / 3)
 
@@ -690,6 +704,8 @@ def _child(args: argparse.Namespace) -> None:
         decode_block_size=block,
         kv_layout=kv_layout,
         quantize=quantize,
+        spec_len=spec_len,
+        spec_ngram=spec_ngram,
         seed=0,
     )
     if ttft_on or (args.phase == "ab" and os.environ.get("ACP_BENCH_TTFT", "1") != "0"):
@@ -705,6 +721,34 @@ def _child(args: argparse.Namespace) -> None:
 
     prompt = [1 + (i % 250) for i in range(prompt_len - 1)]
     sampling = SamplingParams(temperature=0.8, top_p=0.95, max_tokens=max_tokens)
+    # measured-burst window of the speculative-decoding counters (zeros and
+    # absent from payloads unless ACP_BENCH_SPEC_LEN opted in)
+    spec_window: dict = {"d0": 0, "p0": 0, "a0": 0, "dispatches": 0, "proposed": 0, "accepted": 0}
+
+    def spec_fields() -> dict:
+        """Additive spec block for the result payloads — the headline
+        decode_tok_s_per_chip contract is untouched (same metric, same
+        burst); this only documents how much of it speculation carried."""
+        if not engine.spec_len:
+            return {}
+        d = spec_window["dispatches"]
+        acc = spec_window["accepted"]
+        prop = spec_window["proposed"]
+        per_block = round(acc / d, 3) if d else 0.0
+        return {"spec": {
+            "spec_len": engine.spec_len,
+            "ngram": engine.spec_ngram,
+            "proposed": prop,
+            "accepted": acc,
+            "acceptance_rate": round(acc / prop, 4) if prop else 0.0,
+            "verify_dispatches": d,
+            "spec_accepted_tokens_per_block": per_block,
+            "note": (
+                f"speculation on (len={engine.spec_len}, ngram={engine.spec_ngram}): "
+                f"{1 + per_block:.2f} tokens/verify dispatch vs 1.00/model-step "
+                "with speculation off — headline metric unchanged"
+            ),
+        }}
 
     def measure(
         warm_timeout: float = float(os.environ.get("ACP_BENCH_WARM_TIMEOUT_S", "1200")),
@@ -723,6 +767,10 @@ def _child(args: argparse.Namespace) -> None:
         _mark("warm_done")
         t0 = time.monotonic()
         toks0 = engine.tokens_generated
+        spec_window.update(
+            d0=engine.spec_dispatches, p0=engine.spec_proposed,
+            a0=engine.spec_accepted, dispatches=0, proposed=0, accepted=0,
+        )
         futures = [engine.submit(list(prompt), sampling) for _ in range(n_requests)]
         deadline = t0 + deadline_s
         done = 0
@@ -737,6 +785,9 @@ def _child(args: argparse.Namespace) -> None:
                 break
         elapsed = time.monotonic() - t0
         total = engine.tokens_generated - toks0
+        spec_window["dispatches"] = engine.spec_dispatches - spec_window["d0"]
+        spec_window["proposed"] = engine.spec_proposed - spec_window["p0"]
+        spec_window["accepted"] = engine.spec_accepted - spec_window["a0"]
         # drain leftovers so any next phase in THIS process measures an idle
         # engine; skipped when the result is about to be emitted and the
         # process exits (the parent's mark deadline must not eat the drain)
@@ -776,6 +827,7 @@ def _child(args: argparse.Namespace) -> None:
         _result("ab", {
             "tok_s_per_chip": round(tok_s, 1),
             **mfu_fields(total, elapsed, done),
+            **spec_fields(),
             "note": (
                 f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); kv={kv_layout} "
                 f"quant={quantize or 'bf16'}; {done}/{n_requests} done"
@@ -789,6 +841,7 @@ def _child(args: argparse.Namespace) -> None:
         _result("headline", {
             "tok_s_per_chip": round(tok_s, 1),
             **mfu_fields(total, elapsed, done),
+            **spec_fields(),
             "note": (
                 f"{total} tokens in {elapsed:.2f}s on {n_chips} chip(s); preset={preset} "
                 f"kv={kv_layout} quant={quantize or 'bf16'} block={block}; "

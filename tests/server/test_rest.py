@@ -593,3 +593,44 @@ async def test_chat_completions_timeout_s_expires_queued_request_fast():
                 assert time.monotonic() - t0 < 30
     finally:
         eng.stop()
+
+
+async def test_engine_status_exposes_decode_efficiency_and_spec_block():
+    """/v1/engine must surface tokens_per_decode_step and the speculative-
+    decoding stats block (ISSUE 5 acceptance: visible decode efficiency)."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+        spec_len=4,
+    )
+    eng.start()
+    try:
+        eng.generate("abcabcabcabc", SamplingParams(temperature=0.0, max_tokens=12))
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            resp = await h.http.get(f"{h.base}/v1/engine")
+            doc = await resp.json()
+            assert doc["configured"] is True
+            assert doc["tokens_per_decode_step"] > 0
+            spec = doc["spec"]
+            assert spec["enabled"] is True and spec["spec_len"] == 4
+            for key in ("proposed", "accepted", "acceptance_rate", "verify_dispatches"):
+                assert key in spec
+            # the scrape-time gauge rides /metrics too
+            h.operator.options.engine = eng
+            text = await (await h.http.get(f"{h.base}/metrics")).text()
+            assert "acp_engine_tokens_per_decode_step" in text
+    finally:
+        eng.stop()
