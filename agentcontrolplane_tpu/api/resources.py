@@ -144,6 +144,14 @@ class TPUProviderConfig(APIModel):
     # oversubscribed, and the reconciler should 504/retry rather than hold
     # the task lease forever.
     queue_timeout_seconds: float = Field(default=600.0, gt=0)
+    # Overlapped tool execution: stream-parse tool calls during decode and
+    # surface each one to the task controller the moment its arguments
+    # close, so ToolCall CRs execute while the model is still generating;
+    # the finished turn's engine slot parks so the follow-up turn prefills
+    # only its suffix. Moves only WHEN execution starts — generated text
+    # and the joined conversation are byte-identical either way (see
+    # docs/serving-engine.md "Overlapped tool execution").
+    overlap_tool_calls: bool = True
 
 
 class OpenAIProviderConfig(APIModel):

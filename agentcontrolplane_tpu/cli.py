@@ -81,6 +81,13 @@ def _add_tpu_flags(p) -> None:
         "--tpu-spec-ngram", type=int, default=3,
         help="longest n-gram the prompt-lookup drafter matches on",
     )
+    p.add_argument(
+        "--tpu-park-max-s", type=float, default=30.0,
+        help="overlapped tool execution: seconds a slot parked at "
+        "generation end (prompt KV resident) waits for the conversation's "
+        "next turn before releasing; 0 disables parking "
+        "(see docs/serving-engine.md)",
+    )
 
 
 def _build_engine(args, coordination=None):
@@ -98,6 +105,7 @@ def _build_engine(args, coordination=None):
         max_queue=args.tpu_max_queue,
         spec_len=args.tpu_spec_len,
         spec_ngram=args.tpu_spec_ngram,
+        park_max_s=args.tpu_park_max_s,
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:

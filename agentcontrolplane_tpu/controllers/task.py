@@ -59,6 +59,7 @@ from ..llmclient.base import LLMClient, LLMRequestError, Tool, tool_from_contact
 from ..llmclient.factory import LLMClientFactory, resolve_secret_key
 from ..mcp.adapters import convert_mcp_tools, convert_sub_agents
 from ..mcp.manager import MCPManager
+from ..observability.metrics import REGISTRY
 from ..observability.tracing import NOOP_TRACER, Tracer
 from ..validation import (
     get_user_message_preview,
@@ -73,6 +74,18 @@ log = logging.getLogger("acp_tpu.task")
 REQUEUE_DELAY = 5.0
 LLM_LEASE_TTL = 30.0
 NOTIFY_BACKOFF = (1.0, 2.0, 4.0)  # state_machine.go:908-936
+
+
+@dataclass
+class _EarlyDispatch:
+    """One turn's overlapped tool dispatch: the request_id minted before
+    the LLM call, the calls whose CRs were already created (in stream
+    order), and whether a creation failed (forces the fresh-request_id
+    fallback at fan-out)."""
+
+    request_id: str
+    records: list = field(default_factory=list)  # MessageToolCall, in order
+    failed: bool = False
 
 
 @dataclass
@@ -255,18 +268,79 @@ class TaskReconciler:
             outbound = compact_window(
                 outbound, agent.spec.context_policy.max_messages
             )
+        # Overlapped tool execution: when the client stream-parses tool
+        # calls, create each ToolCall CR the moment its arguments close —
+        # the ToolCall controller starts executing (approval gate included)
+        # while the model is still decoding the rest of the turn. The
+        # definitive fan-out below reconciles against these early CRs; a
+        # mismatch (or a failed/errored turn) orphans them — they may
+        # execute, which is the at-least-once posture this control plane
+        # already has everywhere (the join selector keys on request_id, so
+        # orphans never contaminate the context window).
+        early: Optional[_EarlyDispatch] = None
+        send_kwargs: dict = {}
+        if tools and getattr(client, "supports_early_tool_calls", False):
+            early = _EarlyDispatch(request_id=generate_k8s_random_string(7))
+            tool_types = {t.function.name: t.acp_tool_type for t in tools}
+
+            def _on_tool_call(idx: int, tc, _task=task, _early=early):
+                if _early.failed:
+                    return
+                name = f"{_task.name}-{_early.request_id}-tc-{idx + 1:02d}"
+                try:
+                    self._create_tool_call(
+                        _task, name, _early.request_id, tc.id,
+                        tc.function.name, tc.function.arguments,
+                        tool_types.get(tc.function.name, "MCP"),
+                    )
+                except Exception:
+                    # fan-out falls back to a fresh request_id; the turn
+                    # itself must not die on an early-dispatch failure
+                    log.exception("early ToolCall create failed for %s", _task.name)
+                    _early.failed = True
+                    return
+                _early.records.append(tc)
+                REGISTRY.counter_add(
+                    "acp_task_early_toolcalls_total", 1.0,
+                    help="ToolCall CRs created from streamed tool calls "
+                    "before the turn's generation finished",
+                )
+
+            send_kwargs["on_tool_call"] = _on_tool_call
         try:
-            response = await client.send_request(outbound, tools)
+            response = await client.send_request(outbound, tools, **send_kwargs)
         except LLMRequestError as e:
             self.tracer.end_span(span, "ERROR")
+            self._orphan_early(task, early, f"turn failed: {e}")
             return self._llm_request_failed(task, e)
         except Exception as e:  # transport/unknown: retryable
             self.tracer.end_span(span, "ERROR")
+            self._orphan_early(task, early, f"turn failed: {e}")
             return self._llm_request_failed(task, LLMRequestError(500, str(e)))
         finally:
             await client.close()
         self.tracer.end_span(span)
-        return self._process_llm_response(task, response, tools)
+        return self._process_llm_response(task, response, tools, early)
+
+    def _orphan_early(self, task: Task, early: Optional[_EarlyDispatch], why: str) -> None:
+        """Account for early-created ToolCall CRs this turn is abandoning
+        (failed send, content-only final parse, or early/definitive
+        divergence). They may execute — the at-least-once posture — but
+        their results never join (the join selector keys on request_id);
+        the counter is the operator's signal that spurious executions
+        happened."""
+        if early is None or not early.records:
+            return
+        log.warning(
+            "task %s: orphaning %d early-dispatched tool call(s): %s",
+            task.name, len(early.records), why,
+        )
+        REGISTRY.counter_add(
+            "acp_task_early_toolcalls_orphaned_total", float(len(early.records)),
+            help="early-created ToolCall CRs abandoned (failed turn, "
+            "content-only final parse, or early/definitive divergence)",
+        )
+        early.records.clear()  # never double-count one turn's orphans
 
     def _llm_request_failed(self, task: Task, err: LLMRequestError) -> Result:
         """4xx -> terminal Failed; else keep phase and retry (733-790).
@@ -316,9 +390,18 @@ class TaskReconciler:
 
     # -- response processing (605-731, 967-1066) -------------------------
 
-    def _process_llm_response(self, task: Task, response: Message, tools: list[Tool]) -> Result:
+    def _process_llm_response(
+        self,
+        task: Task,
+        response: Message,
+        tools: list[Tool],
+        early: Optional[_EarlyDispatch] = None,
+    ) -> Result:
         if response.tool_calls:
-            return self._fan_out_tool_calls(task, response, tools)
+            return self._fan_out_tool_calls(task, response, tools, early)
+        # content-only final parse: any early CRs are orphans (degenerate —
+        # the stream saw call-shaped text the batch parse rejected)
+        self._orphan_early(task, early, "final parse yielded no tool calls")
         if task.metadata.labels.get(LABEL_V1BETA3) == "true" and task.spec.contact_channel_ref:
             # v1beta3: final answers become respond_to_human tool calls
             # (state_machine.go:967-1066).
@@ -341,21 +424,59 @@ class TaskReconciler:
         self._end_task_span(task, "OK")
         return Result.done()
 
-    def _fan_out_tool_calls(self, task: Task, response: Message, tools: list[Tool]) -> Result:
+    def _fan_out_tool_calls(
+        self,
+        task: Task,
+        response: Message,
+        tools: list[Tool],
+        early: Optional[_EarlyDispatch] = None,
+    ) -> Result:
         tool_types = {t.function.name: t.acp_tool_type for t in tools}
+        calls = list(response.tool_calls)
+        # Reconcile against early-dispatched CRs: adopt them iff the early
+        # stream is a positional prefix of the definitive batch parse (same
+        # names and arguments, in order) — then those CRs (already
+        # executing) ARE this turn's fan-out, and the context window takes
+        # the early call objects so its ids match their tool_call_ids.
+        # Any divergence (a creation failure, or degenerate output where
+        # the stream scan and the fenced-preference batch rule disagree)
+        # falls back to a fresh request_id: the early CRs are orphaned —
+        # possibly executed, never joined — and the definitive set is
+        # created from scratch. Dispatch moves WHEN execution starts,
+        # never what the conversation records.
+        pre_created = 0
         request_id = generate_k8s_random_string(7)
+        if early is not None and early.records and not early.failed:
+            recs = early.records
+            if len(recs) <= len(calls) and all(
+                r.function.name == calls[i].function.name
+                and r.function.arguments == calls[i].function.arguments
+                for i, r in enumerate(recs)
+            ):
+                calls[: len(recs)] = recs
+                request_id = early.request_id
+                pre_created = len(recs)
+            else:
+                self._orphan_early(
+                    task, early,
+                    f"diverged from the final parse ({len(recs)} early vs "
+                    f"{len(calls)} final)",
+                )
+        response.tool_calls = calls
         task.status.context_window = task.status.context_window + [
-            Message(role="assistant", content="", tool_calls=response.tool_calls)
+            Message(role="assistant", content="", tool_calls=calls)
         ]
         task.status.message_count = len(task.status.context_window)
         task.status.phase = TASK_PHASE_TOOL_CALLS_PENDING
         task.status.status = "Ready"
-        task.status.status_detail = f"LLM requested {len(response.tool_calls)} tool call(s)"
+        task.status.status_detail = f"LLM requested {len(calls)} tool call(s)"
         task.status.tool_call_request_id = request_id
         self._update_status(task)  # status FIRST, then create children (667-731)
 
         try:
-            for i, tc in enumerate(response.tool_calls):
+            for i, tc in enumerate(calls):
+                if i < pre_created:
+                    continue  # created while the model was still decoding
                 name = f"{task.name}-{request_id}-tc-{i + 1:02d}"
                 tool_type = tool_types.get(tc.function.name, "MCP")
                 self._create_tool_call(task, name, request_id, tc.id, tc.function.name, tc.function.arguments, tool_type)

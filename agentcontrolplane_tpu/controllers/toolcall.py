@@ -21,6 +21,7 @@ executor.go 401 LoC), §3.3 of SURVEY.md:
 
 from __future__ import annotations
 
+import asyncio
 import logging
 import time
 from dataclasses import dataclass, field
@@ -295,6 +296,18 @@ class ToolCallReconciler:
         tc.status.status = "Ready"
         tc.status.status_detail = f"Executing {server}/{tool}"
         self._update_status(tc)
+        # deterministic fault sites (faults.py): "tool.slow" stretches this
+        # execution by spec seconds (overlap/park stress — a parked slot
+        # outliving a slow tool); "tool.error" fails it, exercising the
+        # error-becomes-tool-result join path. Budget-armed, never random.
+        from ..faults import FAULTS
+
+        if FAULTS.enabled:
+            slow = FAULTS.pop("tool.slow")
+            if slow is not None:
+                await asyncio.sleep(float(slow.get("seconds", 0.05)))
+            if FAULTS.pop("tool.error") is not None:
+                return self._fail(tc, "fault injection: tool error")
         try:
             result = await self.mcp_manager.call_tool(server, tool, args)
         except Exception as e:

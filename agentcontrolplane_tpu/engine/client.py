@@ -25,6 +25,30 @@ from .tokenizer import render_prompt
 from .toolparse import to_message
 
 
+def forced_call_prefix(tokenizer, tools: list[Tool], tool_choice: str) -> tuple:
+    """Teacher-forced tool-call envelope tokens for a tool_choice that
+    names one tool ("required" with a single tool, or an explicit name) —
+    shared by the LLM-client path and the REST front door's OpenAI
+    ``tool_choice`` field. Empty tuple when nothing can be forced."""
+    if not tools:
+        return ()
+    name = None
+    if tool_choice == "required" and len(tools) == 1:
+        name = tools[0].function.name
+    elif tool_choice not in ("auto", "required", "none", ""):
+        offered = {t.function.name for t in tools}
+        if tool_choice in offered:
+            name = tool_choice
+    if name is None:
+        return ()
+    import json as _json
+
+    # json.dumps escapes quotes/backslashes in exotic tool names — an
+    # unescaped name would be an illegal prefix and fail every request
+    prefix = f'{{"name": {_json.dumps(name)}, "arguments": {{'
+    return tuple(tokenizer.encode(prefix))
+
+
 class TPUEngineClient(LLMClient):
     def __init__(
         self,
@@ -34,9 +58,17 @@ class TPUEngineClient(LLMClient):
         tool_choice: str = "auto",
         request_timeout_s: float | None = None,
         queue_timeout_s: float | None = None,
+        overlap_tool_calls: bool = True,
     ):
         self.engine = engine
         self.params = params
+        # LLM.spec.tpu.overlapToolCalls: stream-parse tool calls during
+        # decode, surface each to the caller the moment its braces close
+        # (send_request's on_tool_call keyword), and park the finished
+        # slot so the follow-up turn prefills only its suffix. Moves WHEN
+        # execution starts, never what is generated.
+        self.overlap_tool_calls = bool(overlap_tool_calls)
+        self.supports_early_tool_calls = self.overlap_tool_calls
         # LLM.spec.tpu.requestTimeoutSeconds — mirrors the reference's 30 s
         # LLMRequestTimeout (task_controller.go:25): a wedged generation
         # fails the request (5xx -> reconciler retry) instead of holding the
@@ -70,25 +102,19 @@ class TPUEngineClient(LLMClient):
         self.tool_choice = tool_choice
 
     def _forced_call(self, tools: list[Tool]) -> tuple:
-        if not tools:
-            return ()
-        name = None
-        if self.tool_choice == "required" and len(tools) == 1:
-            name = tools[0].function.name
-        elif self.tool_choice not in ("auto", "required", "none", ""):
-            offered = {t.function.name for t in tools}
-            if self.tool_choice in offered:
-                name = self.tool_choice
-        if name is None:
-            return ()
-        import json as _json
+        return forced_call_prefix(self.engine.tokenizer, tools, self.tool_choice)
 
-        # json.dumps escapes quotes/backslashes in exotic tool names — an
-        # unescaped name would be an illegal prefix and fail every request
-        prefix = f'{{"name": {_json.dumps(name)}, "arguments": {{'
-        return tuple(self.engine.tokenizer.encode(prefix))
-
-    async def send_request(self, messages: list[Message], tools: list[Tool]) -> Message:
+    async def send_request(
+        self, messages: list[Message], tools: list[Tool], on_tool_call=None
+    ) -> Message:
+        """``on_tool_call`` (optional, honored when ``overlap_tool_calls``):
+        called on the event loop as ``(index, MessageToolCall)`` for each
+        streamed call the moment its arguments close — indices are dense
+        over the calls that pass the allowed-tools filter, matching the
+        positional order of the final message's tool_calls for wire-
+        convention output. The final Message is still authoritative: it is
+        batch-parsed from the finished text, and callers reconcile early
+        dispatches against it (see TaskReconciler._fan_out_tool_calls)."""
         prompt = render_prompt(messages, tools)
         # crash recovery: a dead engine loop (exception, not user stop) is
         # rebuilt and restarted; the reconciler's requeue retries land here.
@@ -107,9 +133,33 @@ class TPUEngineClient(LLMClient):
             json_only=bool((self.force_json_tools or forced or json_required) and tools),
             forced_prefix=forced,
         )
+        allowed = {t.function.name for t in tools} if tools else None
+        engine_cb = None
+        overlap = self.overlap_tool_calls and bool(tools)
+        if overlap and on_tool_call is not None:
+            loop = asyncio.get_running_loop()
+            seen = {"n": 0}  # re-index past filtered (hallucinated) names
+
+            def engine_cb(_idx, tc):
+                # engine thread -> event loop; the loop's FIFO guarantees
+                # every bridged event lands before the future's own waiter
+                # resumes, so send_request never returns with events in
+                # flight
+                if allowed is not None and tc.function.name not in allowed:
+                    return
+                idx, seen["n"] = seen["n"], seen["n"] + 1
+                loop.call_soon_threadsafe(on_tool_call, idx, tc)
+
         # the queue deadline rides INTO the engine: if the request would
         # outwait its queue budget it is failed engine-side without prefill
-        future = self.engine.submit(prompt, sampling, timeout_s=self.queue_timeout_s)
+        future = self.engine.submit(
+            prompt, sampling, timeout_s=self.queue_timeout_s,
+            on_tool_call=engine_cb,
+            # park the finished slot: the next turn of this conversation
+            # (arriving as soon as the overlapped tools complete) adopts
+            # it and prefills only the suffix
+            park=overlap,
+        )
         try:
             result = await self._await_result(future)
         except asyncio.TimeoutError as e:
@@ -128,7 +178,6 @@ class TPUEngineClient(LLMClient):
             raise LLMRequestError(504, f"TPU engine queue deadline: {e}")
         except Exception as e:
             raise LLMRequestError(500, f"TPU engine failure: {e}")
-        allowed = {t.function.name for t in tools} if tools else None
         return to_message(result.text, allowed)
 
     async def _await_result(self, future):

@@ -116,6 +116,24 @@ class _Request:
     # called from the ENGINE thread with each block's newly sampled token
     # ids (must not block; bridge to asyncio with call_soon_threadsafe)
     on_tokens: Optional[callable] = None
+    # overlapped tool execution: called from the ENGINE thread as
+    # ``(index, MessageToolCall)`` the moment a streamed tool call's braces
+    # close — while the model is still decoding the rest of the turn. Must
+    # not block (bridge to asyncio with call_soon_threadsafe). Set by
+    # submit(on_tool_call=...), which also builds ``tool_parser``.
+    on_tool_call: Optional[callable] = None
+    tool_parser: Optional[object] = None  # toolparse.ToolStreamParser
+    # detokenization holdback for the stream parser: token ids whose text
+    # is still an incomplete UTF-8 sequence at a commit boundary
+    detok_pending: list[int] = field(default_factory=list)
+    # (monotonic emit time, MessageToolCall) per early-emitted call; the
+    # same list object is exposed as ``future.early_tool_calls``
+    early_calls: list = field(default_factory=list)
+    # park-on-finish: when generation completes normally, keep the slot
+    # PARKED (prompt KV resident, surplus pages released) so the next turn
+    # of the same conversation — sent while this turn's tool calls execute
+    # — resumes with a suffix-only prefill (see Engine._park)
+    park: bool = False
     # tail-truncated prompts keep their suffix, not their prefix — they can
     # neither hit nor usefully seed the prefix cache
     truncated: bool = False
@@ -167,6 +185,14 @@ class _Slot:
     # verify dispatch would be O(ctx) host work in the decode hot loop.
     ctx_buf: Optional[np.ndarray] = None
     ctx_len: int = 0
+    # parked: generation finished (future resolved) but the slot lingers
+    # holding its PROMPT KV so the conversation's next turn — typically
+    # arriving as soon as this turn's overlapped tool calls complete —
+    # prefills only the suffix. Parked slots never decode, yield their
+    # pages voluntarily under pool pressure, and expire after park_max_s.
+    parked: bool = False
+    parked_at: float = 0.0
+    park_cut: int = 0  # KV rows valid for adoption (page-aligned in paged)
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -226,6 +252,12 @@ class Engine:
         # how many positions commit). 0 disables (the default).
         spec_len: int = 0,
         spec_ngram: int = 3,  # longest n-gram the drafter matches on
+        # parked-slot lifetime: a slot parked at generation end (see
+        # _Request.park) that no follow-up turn adopts within this window
+        # is released. 0 disables parking entirely. Parking is also
+        # disabled under multi-host coordination — the expiry decision is
+        # wall-clock and would fork lockstep (same rule as deadlines).
+        park_max_s: float = 30.0,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
         # Multi-host lockstep serving (engine/coordination.py): rank 0
@@ -494,6 +526,17 @@ class Engine:
         self.spec_proposed = 0  # draft tokens sent to verification
         self.spec_accepted = 0  # draft tokens the model agreed with
         self.spec_dispatches = 0  # verify dispatches issued
+        # overlapped tool execution (see _stream / _park). _parked_count is
+        # a plain int mirror of "slots in _slots with parked=True" so
+        # cross-thread readers (stats()) never iterate the engine-mutated
+        # dict — same racy-but-safe ints-only contract as the other stats.
+        self._parked_count = 0
+        self.park_max_s = 0.0 if coordination is not None else max(0.0, park_max_s)
+        self.tool_calls_early = 0  # calls emitted before generation ended
+        self.tool_overlap_saved_s = 0.0  # sum of (finish - emit) per early call
+        self.parks = 0  # slots parked at generation end
+        self.park_adoptions = 0  # parked slots adopted by a follow-up turn
+        self.park_releases = 0  # parked slots released (pressure/expiry/stop)
         self._admit_seq = 0  # monotonically increasing admission stamp
         # fault-injection seam (faults.FAULTS): near-free when disabled —
         # every hook is guarded by the plain-bool ``enabled`` attribute
@@ -804,6 +847,8 @@ class Engine:
             log.warning("engine crashed; rebuilding serving state and restarting")
             self._init_kv_state()
             self._slots = {}
+            self._parked_count = 0
+            self._publish_park_gauge()
             self._free = list(range(self.max_slots))
             self._waiting.clear()
             self._cancelled.clear()
@@ -828,6 +873,8 @@ class Engine:
         sampling: Optional[SamplingParams] = None,
         on_tokens=None,
         timeout_s: Optional[float] = None,
+        on_tool_call=None,
+        park: bool = False,
         _prewarm: bool = False,
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
@@ -836,7 +883,18 @@ class Engine:
         caller's deadline into the admission queue: a request still queued
         when it expires fails fast (DeadlineExceededError) without wasting
         prefill. ``_prewarm`` requests bypass the prefix cache entirely (no
-        entries, no counters) and are exempt from the queue cap."""
+        entries, no counters) and are exempt from the queue cap.
+
+        Overlapped tool execution: ``on_tool_call`` is invoked from the
+        engine thread as ``(index, MessageToolCall)`` the moment a streamed
+        tool call's closing brace is decoded — while the model is still
+        generating — so callers can start executing it immediately. The
+        emitted calls (with timestamps) are also exposed on the returned
+        future as ``early_tool_calls``. ``park=True`` keeps the slot parked
+        after a normal finish so the conversation's next turn prefills only
+        its suffix (see docs/serving-engine.md "Overlapped tool
+        execution"). Neither knob changes WHAT is generated — greedy output
+        is byte-identical with them on or off."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         s = sampling or SamplingParams()
         prefix_len = len(s.forced_prefix)
@@ -856,7 +914,17 @@ class Engine:
             on_tokens=on_tokens,
             truncated=truncated,
             deadline=(time.monotonic() + timeout_s) if timeout_s else None,
+            on_tool_call=on_tool_call,
+            # truncated prompts keep their suffix, not their prefix: the
+            # next turn's prompt can never extend them, so parking would
+            # pin pages that no adoption can ever use
+            park=bool(park) and self.park_max_s > 0 and not truncated,
         )
+        if on_tool_call is not None:
+            from .toolparse import ToolStreamParser
+
+            req.tool_parser = ToolStreamParser()
+        req.future.early_tool_calls = req.early_calls  # type: ignore[attr-defined]
         if self._coord_follower:
             # any locally-originated request (prewarm included) would break
             # lockstep — followers only replay the leader's frame stream
@@ -1093,7 +1161,8 @@ class Engine:
             "kv_layout": self.kv_layout,
             "max_slots": self.max_slots,
             "max_ctx": self.max_ctx,
-            "active_slots": len(self._slots),
+            "active_slots": self._n_active(),
+            "parked_slots": self._parked_count,
             "waiting": len(self._waiting),
             "max_queue": self.max_queue,
             "preemptions": self.preemptions,
@@ -1109,6 +1178,14 @@ class Engine:
                 round(self.tokens_generated / self.decode_steps, 4)
                 if self.decode_steps else 0.0
             ),
+            "tool_overlap": {
+                "early_calls": self.tool_calls_early,
+                "overlap_saved_s": round(self.tool_overlap_saved_s, 4),
+                "parks": self.parks,
+                "park_adoptions": self.park_adoptions,
+                "park_releases": self.park_releases,
+                "park_max_s": self.park_max_s,
+            },
             "spec": {
                 "enabled": self.spec_len > 0,
                 "spec_len": self.spec_len,
@@ -1166,7 +1243,7 @@ class Engine:
     def _run(self) -> None:
         try:
             while not self._stopping:
-                admitted = self._admit(block=not self._slots)
+                admitted = self._admit(block=not self._n_active())
                 if self._stopping:
                     break
                 # after _admit, not before: the loop parks in _admit while
@@ -1176,13 +1253,16 @@ class Engine:
                 # which is the recovery path worth testing
                 if self._faults.enabled and self._faults.pop("engine.crash") is not None:
                     raise RuntimeError("fault injection: engine crash")
-                if not self._slots:
+                self._sweep_parked()
+                if not self._n_active():
                     if not admitted:
                         continue
                 self._decode_once()
         except Exception as e:  # an engine crash must not hang callers
             log.exception("engine loop crashed")
             self._slots.clear()
+            self._parked_count = 0
+            self._publish_park_gauge()
             self._stopping = True
             self._crashed = True  # restartable (see ensure_running)
             REGISTRY.counter_add("acp_engine_crashes_total", 1.0)
@@ -1230,7 +1310,7 @@ class Engine:
         requests + cancel snapshot as a frame and followers replay it — every
         process then runs the identical pure admission logic and joins the
         identical global dispatches (see engine/coordination.py)."""
-        may_block = block and not self._waiting and not self._slots
+        may_block = block and not self._waiting and not self._n_active()
         if self._coord_follower:
             try:
                 frame = self._coordination.recv()
@@ -1349,7 +1429,7 @@ class Engine:
 
         self._expire_deadlines()
         if held:
-            if not self._slots:
+            if not self._n_active():
                 # idle hold: don't busy-spin against the submitting thread
                 time.sleep(0.002)
             return False
@@ -1409,7 +1489,7 @@ class Engine:
         of _admit, split out so the coordinated multi-host loop can replay
         broadcast admissions without touching the local submit queue)."""
         admitted = False
-        while self._free and self._waiting:
+        while self._waiting and (self._free or self._has_parked()):
             group = self._collect_group()
             if not group:
                 break  # head request can't fit (KV pages); FIFO, wait
@@ -1427,7 +1507,11 @@ class Engine:
             for item in group:
                 req, slot, _pages, match = item
                 start = 0
-                if match is not None:
+                if match is not None and match[1].get("in_slot"):
+                    # adopted parked slot: the prompt KV is already resident
+                    # in THIS slot — no copy, just a suffix start offset
+                    start = match[1]["cut"]
+                elif match is not None:
                     if self.kv_layout == "slot":
                         self._copy_prefix_into_slot(slot, match[1])
                     # paged: the shared prefix pages are already in the
@@ -1673,7 +1757,11 @@ class Engine:
         allocated suffix pages. Strict FIFO: stop at the first request that
         can't get pages."""
         group: list[tuple[_Request, int, Optional[list[int]], Optional[tuple]]] = []
-        while self._waiting and self._free and len(group) < self.prefill_batch_max:
+        while (
+            self._waiting
+            and len(group) < self.prefill_batch_max
+            and (self._free or self._has_parked())
+        ):
             req = self._waiting[0]
             s = req.sampling
             # queued-deadline expiry happens in _expire_deadlines, which
@@ -1691,18 +1779,31 @@ class Engine:
             match: Optional[tuple] = None
             if self._prefix_enabled and not req.truncated:
                 match = self._match_prefix(req)
+            # parked-slot adoption: a slot parked by this conversation's
+            # previous turn holds its prompt KV in place — resume there
+            # (suffix-only prefill, no copy) unless a cache entry covers
+            # strictly more of the row
+            adopt = self._match_parked(req)
+            if (
+                adopt is not None
+                and match is not None
+                and match[1]["cut"] > self._slots[adopt].park_cut
+            ):
+                adopt = None
+            if adopt is not None:
+                item = self._adopt_parked(req, adopt)
+                if item is None:
+                    break  # pages short even after yielding; head waits (FIFO)
+                if item:
+                    group.append(item[0])
+                continue  # oversize-prompt rejection popped the head
+            # no adoption possible: parked capacity yields a free slot
+            if not self._free and not self._release_lru_parked():
+                break
             pages: Optional[list[int]] = None
             if self.kv_layout == "paged":
                 total_pages = -(-len(self._full_row(req)) // self.page_size)
-                if total_pages > self._allocator.num_pages - 1:
-                    # bigger than the entire pool: waiting would spin forever
-                    self._waiting.popleft()
-                    req.future.set_exception(
-                        RuntimeError(
-                            f"prompt needs {total_pages} KV pages but the pool has "
-                            f"{self._allocator.num_pages - 1}"
-                        )
-                    )
+                if self._reject_oversize_head(req, total_pages):
                     continue
                 shared: list[int] = []
                 if match is not None:
@@ -1715,9 +1816,13 @@ class Engine:
                     try:
                         fresh = self._allocator.alloc(total_pages - len(shared))
                     except MemoryError:
-                        # cache entries PIN pages; under pressure they must
-                        # yield or an idle engine could livelock with the
-                        # head request waiting on pages nothing will free
+                        # parked slots yield first (speculative capacity for
+                        # ONE possible future turn), then cache entries —
+                        # under pressure both must give way or an idle
+                        # engine could livelock with the head request
+                        # waiting on pages nothing will free
+                        if self._release_lru_parked():
+                            continue
                         if not self._evict_one_prefix_entry():
                             break
                 if fresh is None:
@@ -1927,12 +2032,13 @@ class Engine:
             if first_tok not in self.tokenizer.stop_tokens:
                 # resumed requests already emitted prefix + resume tokens
                 # before preemption — only the fresh token streams out
-                req.emit(
+                self._stream(
+                    req,
                     [first_tok] if req.resume_tokens
-                    else list(s.forced_prefix) + [first_tok]
+                    else list(s.forced_prefix) + [first_tok],
                 )
             elif s.forced_prefix and not req.resume_tokens:
-                req.emit(list(s.forced_prefix))
+                self._stream(req, list(s.forced_prefix))
             self._slots[slot] = sl
             self._seq_lens[slot] = full_lens[i]  # cached prefix + suffix
             self._last_tokens[slot] = first_tok
@@ -1966,6 +2072,8 @@ class Engine:
         for slot in list(self._slots):
             if slot not in self._slots:
                 continue  # preempted as a victim for an earlier slot
+            if self._slots[slot].parked:
+                continue  # parked slots never decode; no coverage needed
             need = K if need_tokens is None else need_tokens.get(slot, K)
             needed = -(-(int(self._seq_lens[slot]) + need) // self.page_size)
             # ctx edge: the decode block deactivates the slot on device at
@@ -2035,6 +2143,8 @@ class Engine:
             table = self._slot_pages.get(slot)
             if slot == requester or not table:
                 continue
+            if self._slots[slot].parked:
+                continue  # already trimmed to its park cut; nothing spare
             need = K if need_tokens is None else max(K, need_tokens.get(slot, K))
             strict = min(
                 -(-(int(self._seq_lens[slot]) + need) // self.page_size),
@@ -2066,6 +2176,8 @@ class Engine:
             pages = self._alloc_reclaiming_lookahead(n, requester, need_tokens)
             if pages is not None:
                 return pages
+            if self._release_lru_parked():
+                continue
             if self._evict_one_prefix_entry():
                 continue
             victim = self._pick_victim()
@@ -2085,6 +2197,12 @@ class Engine:
         order so the engine converges instead of thrashing)."""
         if not self._slots:
             return None
+        # parked slots volunteer first (oldest park): their generation is
+        # done and their caller already has its result — evicting one
+        # costs at most a future suffix-prefill, never lost work
+        parked = [(sl.parked_at, s) for s, sl in self._slots.items() if sl.parked]
+        if parked:
+            return min(parked)[1]
         return min(
             self._slots,
             key=lambda s: (
@@ -2100,6 +2218,11 @@ class Engine:
         admission queue. On re-admission it prefills prompt+partial and
         decode continues — the caller's result is byte-identical (greedy)
         to an uncontended run, with only ``preempt_count`` as evidence."""
+        if self._slots[slot].parked:
+            # a parked slot has nothing to save or requeue — its future
+            # resolved at park time; the "preemption" is a pure release
+            self._release_parked(slot)
+            return
         sl = self._slots.pop(slot)
         req = sl.request
         req.resume_tokens = list(sl.generated[sl.prefix_len:])
@@ -2177,7 +2300,7 @@ class Engine:
             for slot, sl in list(self._slots.items()):
                 if sl.request.rid in self._applied_cancels:
                     self._finish(slot, "cancelled")
-        if not self._slots:
+        if not self._n_active():
             return
         if self._faults.enabled:
             spec = self._faults.pop("engine.force_preempt", steps=self.decode_steps)
@@ -2185,7 +2308,7 @@ class Engine:
                 victim = self._pick_victim()
                 if victim is not None:
                     self._preempt(victim)
-        if not self._slots:
+        if not self._n_active():
             return
         # speculative decoding: when enabled and at least one slot has a
         # draft, ONE verify dispatch replaces this iteration's decode block
@@ -2197,7 +2320,7 @@ class Engine:
         K = self.decode_block_size
         if self.kv_layout == "paged":
             self._ensure_pages_for_block()
-            if not self._slots:
+            if not self._n_active():
                 return
         # Device-resident decode state: the per-slot arrays (tokens,
         # seq_lens, con_states, budgets, active, rng) round-trip through the
@@ -2213,11 +2336,12 @@ class Engine:
             # stays compacted) — one live request doesn't pay max_slots of
             # compute. Width is recomputed only on dirty blocks; finishes
             # mark dirty, so the decay through narrower widths is preserved.
-            max_active = max(self._slots) + 1
+            max_active = max(s for s, sl in self._slots.items() if not sl.parked) + 1
             W = next(w for w in self.width_buckets if w >= max_active)
             active_mask = np.zeros(W, dtype=bool)
-            for slot in self._slots:
-                active_mask[slot] = True
+            for slot, sl in self._slots.items():
+                if not sl.parked:
+                    active_mask[slot] = True
             self._rng, step_rng = jax.random.split(self._rng)
             # once the token table exists it is passed unconditionally
             # (matching the prefill path): keying jit entries on "any slot
@@ -2226,7 +2350,8 @@ class Engine:
             # transfer cost
             use_real = self._token_table is not None
             for slot, sl in self._slots.items():
-                self._budgets[slot] = self._slot_budget(slot, sl)
+                if not sl.parked:
+                    self._budgets[slot] = self._slot_budget(slot, sl)
             self._dev = {
                 "W": W,
                 "tokens": self._put(self._last_tokens[:W]),
@@ -2278,6 +2403,8 @@ class Engine:
         K = tok_block.shape[0]
         self.decode_steps += K
         for slot, sl in list(self._slots.items()):
+            if sl.parked:
+                continue  # parked lanes were not in this dispatch
             self._consume_tokens(slot, sl, (int(tok_block[k, slot]) for k in range(K)))
         self._publish_decode_gauges()
 
@@ -2306,13 +2433,70 @@ class Engine:
             ):
                 done = "length"
                 break
-        sl.request.emit(block_new)
+        self._stream(sl.request, block_new)
         if done is not None:
             self._finish(slot, done)
 
+    def _stream(self, req: _Request, tokens: list[int]) -> None:
+        """Engine-thread commit of newly sampled tokens to the caller:
+        forwards the raw ids (on_tokens) and — when overlapped tool
+        execution is on — detokenizes the delta and feeds the request's
+        incremental tool parser, firing ``on_tool_call`` for every call
+        whose braces closed in this commit. Shared by every path that
+        emits tokens (prefill first-token + forced prefix, the plain
+        decode block, and speculative multi-token commits), so early
+        dispatch sees the same token stream in every engine mode."""
+        req.emit(tokens)
+        if req.tool_parser is None or not tokens:
+            return
+        req.detok_pending.extend(tokens)
+        text = self.tokenizer.decode(req.detok_pending)
+        if text.endswith("�"):
+            return  # partial multi-byte char at a commit boundary; hold
+        req.detok_pending.clear()
+        self._feed_tool_parser(req, text)
+
+    def _stream_flush(self, req: _Request) -> None:
+        """Final flush at generation end: feed any held-back text (an
+        incomplete UTF-8 tail never completed) so the parser has consumed
+        exactly the generated text before the batch reconcile."""
+        if req.tool_parser is None or not req.detok_pending:
+            return
+        text = self.tokenizer.decode(req.detok_pending)
+        req.detok_pending.clear()
+        self._feed_tool_parser(req, text)
+
+    def _feed_tool_parser(self, req: _Request, text: str) -> None:
+        try:
+            calls = req.tool_parser.feed(text)
+        except Exception:  # a broken parser must not kill the engine
+            log.exception("tool stream parser failed; disabling for rid %s", req.rid)
+            req.tool_parser = None
+            return
+        if not calls:
+            return
+        now = time.monotonic()
+        for tc in calls:
+            idx = len(req.early_calls)
+            req.early_calls.append((now, tc))
+            self.tool_calls_early += 1
+            REGISTRY.counter_add(
+                "acp_engine_tool_calls_early_total", 1.0,
+                help="tool calls emitted from the decode stream before "
+                "generation finished",
+            )
+            if req.on_tool_call is not None:
+                try:
+                    req.on_tool_call(idx, tc)
+                except Exception:  # a broken consumer must not kill the engine
+                    log.exception("on_tool_call failed; disabling for rid %s", req.rid)
+                    req.on_tool_call = None
+
     def _publish_decode_gauges(self) -> None:
         REGISTRY.gauge_set(
-            "acp_engine_active_slots", len(self._slots), help="occupied decode slots"
+            "acp_engine_active_slots", self._n_active(),
+            help="occupied decode slots (parked slots excluded — see "
+            "acp_engine_parked_slots)",
         )
         REGISTRY.gauge_set(
             "acp_engine_waiting_requests", len(self._waiting),
@@ -2385,6 +2569,8 @@ class Engine:
         budgets_eff: dict[int, int] = {}
         any_draft = False
         for slot, sl in self._slots.items():
+            if sl.parked:
+                continue
             budget = self._slot_budget(slot, sl)
             budgets_eff[slot] = budget
             # the dispatch emits up to draft+1 tokens and writes draft+1 KV
@@ -2404,7 +2590,7 @@ class Engine:
             self._ensure_pages_for_block(
                 {slot: 1 + len(d) for slot, d in drafts.items()}
             )
-            if not self._slots:
+            if not self._n_active():
                 return True
             drafts = {s: d for s, d in drafts.items() if s in self._slots}
             if not any(drafts.values()):
@@ -2413,7 +2599,10 @@ class Engine:
             self._faults.enabled
             and self._faults.pop("engine.spec_mismatch") is not None
         )
-        W = next(w for w in self.width_buckets if w >= max(self._slots) + 1)
+        W = next(
+            w for w in self.width_buckets
+            if w >= max(s for s, sl in self._slots.items() if not sl.parked) + 1
+        )
         inputs = np.zeros((W, T), dtype=np.int32)
         n_input = np.ones(W, dtype=np.int32)
         starts = np.zeros(W, dtype=np.int32)
@@ -2421,6 +2610,8 @@ class Engine:
         budgets = np.zeros(W, dtype=np.int32)
         proposed = np.zeros(W, dtype=np.int32)
         for slot, sl in self._slots.items():
+            if sl.parked:
+                continue
             d = drafts.get(slot, [])
             inputs[slot, 0] = self._last_tokens[slot]
             if d:
@@ -2461,6 +2652,8 @@ class Engine:
         self.spec_dispatches += 1
         self._state_dirty = True  # host mirrors advanced; next block re-uploads
         for slot, sl in list(self._slots.items()):
+            if sl.parked:
+                continue
             n = int(n_emit[slot])
             prop = int(proposed[slot])
             if prop:
@@ -2499,10 +2692,42 @@ class Engine:
         return True
 
     def _finish(self, slot: int, reason: str) -> None:
-        sl = self._slots.pop(slot)
+        sl = self._slots.get(slot)
+        if sl is None:
+            return
+        if sl.parked:
+            # the future resolved when the slot parked; a finish now is a
+            # cancel/stop/drain — release the lingering bookkeeping
+            self._release_parked(slot)
+            return
+        req = sl.request
+        if reason in ("stop", "length"):
+            # a cancelled/drained request must not fire late tool events —
+            # its caller is gone and an early CR would be pure orphan
+            self._stream_flush(req)
+        if req.early_calls:
+            # overlap window this turn made available: time between each
+            # call becoming dispatchable and the generation completing
+            now = time.monotonic()
+            saved = sum(now - t for t, _ in req.early_calls)
+            self.tool_overlap_saved_s += saved
+            REGISTRY.counter_add(
+                "acp_engine_tool_overlap_saved_seconds", saved,
+                help="per early tool call, seconds between its dispatch "
+                "becoming possible and its turn's generation finishing",
+            )
+        if (
+            req.park
+            and reason in ("stop", "length")
+            and not self._stopping
+            and self._park_cut_for(sl) > 0
+        ):
+            self._park(slot, sl, reason)
+            return
+        self._slots.pop(slot)
         self._state_dirty = True  # device lane must be re-uploaded inactive
-        self._cancelled.discard(sl.request.rid)
-        self._applied_cancels.discard(sl.request.rid)
+        self._cancelled.discard(req.rid)
+        self._applied_cancels.discard(req.rid)
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
         self._con_states[slot] = 0
@@ -2511,6 +2736,12 @@ class Engine:
         if self.kv_layout == "paged":
             self._allocator.free(self._slot_pages.pop(slot, []))
             self._block_tables[slot, :] = TRASH_PAGE
+        self._resolve_result(sl, reason)
+
+    def _resolve_result(self, sl: _Slot, reason: str) -> None:
+        """Resolve a slot's future with its GenerationResult — shared by the
+        normal finish and the park transition (a parked slot's caller gets
+        its result immediately; only the KV bookkeeping lingers)."""
         gen = sl.generated
         if gen and gen[-1] in self.tokenizer.stop_tokens:
             gen = gen[:-1]
@@ -2528,3 +2759,191 @@ class Engine:
             sl.request.future.set_result(result)
         REGISTRY.counter_add("acp_engine_requests_total", 1.0)
         REGISTRY.counter_add("acp_engine_tokens_total", float(len(gen)))
+
+    # -- parked slots (overlapped tool execution) -------------------------
+
+    def _park_cut_for(self, sl: _Slot) -> int:
+        """KV rows a parked slot can lend the conversation's next turn:
+        the PROMPT rows only (the next turn re-renders the assistant
+        message, so generated-token KV can never match), page-aligned in
+        paged mode because continuation prefill resumes at page grain."""
+        if self.kv_layout == "paged":
+            return (sl.prompt_len // self.page_size) * self.page_size
+        return sl.prompt_len
+
+    def _park(self, slot: int, sl: _Slot, reason: str) -> None:
+        """Voluntary park at generation end (the preempt machinery's page
+        discipline, minus the victim scan and the requeue): the caller's
+        future resolves NOW with the finished result; the slot stays
+        occupied holding only its prompt KV — surplus pages are freed —
+        so the next turn of this conversation prefills just its suffix.
+        Under pool pressure parked slots are the first to yield
+        (_release_parked), and an unclaimed park expires after
+        park_max_s."""
+        req = sl.request
+        self._state_dirty = True
+        self._cancelled.discard(req.rid)
+        self._applied_cancels.discard(req.rid)
+        cut = self._park_cut_for(sl)
+        sl.parked = True
+        sl.parked_at = time.monotonic()
+        sl.park_cut = cut
+        self._parked_count += 1
+        # host mirrors: the lane is finished on device (never advances);
+        # seq_len records the rows that remain meaningful for adoption
+        self._seq_lens[slot] = cut
+        self._last_tokens[slot] = 0
+        self._con_states[slot] = 0
+        self._constrained[slot] = False
+        self._budgets[slot] = 0
+        if self.kv_layout == "paged":
+            keep = cut // self.page_size
+            table = self._slot_pages.get(slot, [])
+            if len(table) > keep:
+                excess = table[keep:]
+                del table[keep:]
+                self._block_tables[slot, keep : keep + len(excess)] = TRASH_PAGE
+                self._allocator.free(excess)
+                self._tables_dirty = True
+        self.parks += 1
+        REGISTRY.counter_add(
+            "acp_engine_parks_total", 1.0,
+            help="slots parked at generation end awaiting the "
+            "conversation's next turn",
+        )
+        self._publish_park_gauge()
+        self._resolve_result(sl, reason)
+
+    def _release_parked(self, slot: int) -> None:
+        """Free a parked slot entirely (pressure, expiry, stop, or a
+        forced preemption landing on it). The future resolved at park
+        time, so this is pure bookkeeping — the voluntary, no-victim-scan
+        analogue of _preempt's page release."""
+        sl = self._slots.get(slot)
+        if sl is None or not sl.parked:
+            return
+        self._slots.pop(slot)
+        self._parked_count -= 1
+        self._state_dirty = True
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        heapq.heappush(self._free, slot)
+        if self.kv_layout == "paged":
+            self._allocator.free(self._slot_pages.pop(slot, []))
+            self._block_tables[slot, :] = TRASH_PAGE
+            self._tables_dirty = True
+        self.park_releases += 1
+        self._publish_park_gauge()
+
+    def _release_lru_parked(self, exclude: Optional[int] = None) -> bool:
+        """Release the longest-parked slot (if any). True if one yielded."""
+        parked = [
+            (sl.parked_at, s)
+            for s, sl in self._slots.items()
+            if sl.parked and s != exclude
+        ]
+        if not parked:
+            return False
+        self._release_parked(min(parked)[1])
+        return True
+
+    def _sweep_parked(self) -> None:
+        """Expire parked slots whose next turn never came (final answers,
+        failed tasks). Engine-thread, every loop iteration — cheap."""
+        if not self.park_max_s:
+            return
+        now = time.monotonic()
+        expired = [
+            s for s, sl in self._slots.items()
+            if sl.parked and now - sl.parked_at > self.park_max_s
+        ]
+        for slot in expired:
+            self._release_parked(slot)
+
+    def _match_parked(self, req: _Request) -> Optional[int]:
+        """Parked slot whose prompt KV covers the longest prefix of this
+        request's row — the adoption candidate for a conversation's next
+        turn. Strict prefix (suffix tokens must remain to prefill)."""
+        if req.truncated:
+            return None
+        full = self._full_row(req)
+        best, best_cut = None, 0
+        for slot, sl in self._slots.items():
+            if not sl.parked:
+                continue
+            cut = sl.park_cut
+            if (
+                0 < cut < len(full)
+                and cut > best_cut
+                and list(sl.request.prompt[:cut]) == full[:cut]
+            ):
+                best, best_cut = slot, cut
+        return best
+
+    def _reject_oversize_head(self, req: _Request, total_pages: int) -> bool:
+        """Paged admission guard shared by the free-slot and parked-
+        adoption paths: a row bigger than the ENTIRE pool can never fit —
+        fail it up front (waiting would spin forever). True if rejected."""
+        if total_pages <= self._allocator.num_pages - 1:
+            return False
+        self._waiting.popleft()
+        req.future.set_exception(
+            RuntimeError(
+                f"prompt needs {total_pages} KV pages but the pool has "
+                f"{self._allocator.num_pages - 1}"
+            )
+        )
+        return True
+
+    def _adopt_parked(self, req: _Request, slot: int) -> Optional[list]:
+        """Hand a parked slot to the next turn of its conversation (the
+        head of the waiting deque). Returns ``[group_item]`` on success,
+        ``[]`` when the head was popped and failed (oversize prompt), or
+        ``None`` when pages ran short even after yielding — the caller
+        breaks and the head waits, with the parked slot intact (FIFO)."""
+        cut = self._slots[slot].park_cut
+        pages: Optional[list[int]] = None
+        if self.kv_layout == "paged":
+            total_pages = -(-len(self._full_row(req)) // self.page_size)
+            if self._reject_oversize_head(req, total_pages):
+                return []
+            kept = list(self._slot_pages.get(slot, []))
+            fresh: Optional[list[int]] = None
+            while fresh is None:
+                try:
+                    fresh = self._allocator.alloc(total_pages - len(kept))
+                except MemoryError:
+                    # OTHER parked slots and cache entries yield before the
+                    # adoption fails; never release the adoptee itself
+                    if self._release_lru_parked(exclude=slot):
+                        continue
+                    if not self._evict_one_prefix_entry():
+                        break
+            if fresh is None:
+                return None
+            pages = kept + fresh
+        self._slots.pop(slot)  # the new turn takes the slot over in place
+        self._parked_count -= 1
+        self.park_adoptions += 1
+        REGISTRY.counter_add(
+            "acp_engine_park_adoptions_total", 1.0,
+            help="parked slots adopted by their conversation's next turn "
+            "(suffix-only prefill)",
+        )
+        self._publish_park_gauge()
+        self._waiting.popleft()
+        return [(req, slot, pages, (None, {"cut": cut, "in_slot": True}))]
+
+    def _n_active(self) -> int:
+        return len(self._slots) - self._parked_count
+
+    def _has_parked(self) -> bool:
+        return self._parked_count > 0
+
+    def _publish_park_gauge(self) -> None:
+        REGISTRY.gauge_set(
+            "acp_engine_parked_slots",
+            float(self._parked_count),
+            help="slots parked at generation end, prompt KV resident, "
+            "awaiting the conversation's next turn",
+        )

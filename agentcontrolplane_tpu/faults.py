@@ -25,6 +25,15 @@ Engine sites (see ``engine/engine.py``):
   decay without perturbing outputs — the accept op always emits the
   verified model token, never the draft.
 
+Tool-execution sites (see ``controllers/toolcall.py``, overlapped tool
+execution stress):
+
+- ``tool.slow``  — stretch the next ``times=N`` MCP executions by
+  ``seconds=S`` each (a slow tool outliving its turn's parked slot).
+- ``tool.error`` — fail the next ``times=N`` MCP executions before the
+  call reaches the server; the failure joins the conversation as an error
+  tool result (the state machine's normal posture), never a crash.
+
 This module is deliberately dependency-free (stdlib only) so the engine
 can import it without pulling in the control-plane kernel or the test
 fixtures in :mod:`agentcontrolplane_tpu.testing`, which re-exports

@@ -84,6 +84,14 @@ class LLMClient(ABC):
     """The seam (llm_client.go:11-14). Implementations: openai-compatible
     HTTP, anthropic HTTP, the in-tree TPU engine, and a scriptable mock."""
 
+    # overlapped tool execution: a client that sets this True accepts an
+    # ``on_tool_call=(index, MessageToolCall) -> None`` keyword on
+    # send_request and invokes it (on the event loop) for each tool call
+    # the moment its arguments close — while the completion is still
+    # streaming. Callers MUST gate the keyword on this flag: providers
+    # that never stream-parse keep the plain two-argument signature.
+    supports_early_tool_calls: bool = False
+
     @abstractmethod
     async def send_request(
         self, messages: list[Message], tools: list[Tool]

@@ -183,6 +183,9 @@ class DefaultLLMClientFactory:
                 queue_timeout_s=(
                     llm.spec.tpu or TPUProviderConfig()
                 ).queue_timeout_seconds,
+                overlap_tool_calls=(
+                    llm.spec.tpu or TPUProviderConfig()
+                ).overlap_tool_calls,
             )
         if provider == "mock":
             return MockLLMClient(

@@ -4,6 +4,12 @@ Equivalent of the reference's NewStdioMCPClient path
 (``acp/internal/mcpmanager/mcpmanager.go:142``, via mark3labs/mcp-go):
 newline-delimited JSON-RPC, ``initialize`` handshake, ``tools/list``,
 ``tools/call``.
+
+Requests are MULTIPLEXED by JSON-RPC id: a background reader resolves
+per-request futures, so concurrent ``call_tool``s to one server overlap
+instead of serializing behind a single request-response lock — the
+transport-level half of executing a turn's independent tool calls in
+parallel (the ToolCall controller's workers provide the other half).
 """
 
 from __future__ import annotations
@@ -47,7 +53,10 @@ class StdioMCPClient:
         self.memory_limit = memory_limit
         self._proc: Optional[asyncio.subprocess.Process] = None
         self._id = 0
-        self._lock = asyncio.Lock()
+        self._lock = asyncio.Lock()  # serializes stdin writes only
+        self._pending: dict[int, asyncio.Future] = {}
+        self._reader: Optional[asyncio.Task] = None
+        self._dead: Optional[str] = None  # reader's terminal error, if any
         self.server_info: dict[str, Any] = {}
 
     def _argv(self) -> list[str]:
@@ -78,6 +87,7 @@ class StdioMCPClient:
             stderr=asyncio.subprocess.DEVNULL,
             env=env,
         )
+        self._reader = asyncio.ensure_future(self._read_loop())
         result = await self._request(
             "initialize",
             {
@@ -95,26 +105,64 @@ class StdioMCPClient:
         self._proc.stdin.write(json.dumps(msg).encode() + b"\n")
         await self._proc.stdin.drain()
 
-    async def _request(self, method: str, params: dict[str, Any], timeout: float = 30.0) -> dict[str, Any]:
-        async with self._lock:
-            self._id += 1
-            rid = self._id
-            await self._send({"jsonrpc": "2.0", "id": rid, "method": method, "params": params})
-            assert self._proc and self._proc.stdout
+    async def _read_loop(self) -> None:
+        """Single stdout reader resolving pending requests by id. A dead
+        pipe fails every in-flight and future request — concurrent callers
+        must never hang on a response that can no longer arrive."""
+        assert self._proc and self._proc.stdout
+        error = f"MCP server {self.command} closed its stdout"
+        try:
             while True:
-                line = await asyncio.wait_for(self._proc.stdout.readline(), timeout)
+                line = await self._proc.stdout.readline()
                 if not line:
-                    raise MCPError(f"MCP server {self.command} closed its stdout")
+                    break
                 try:
                     msg = json.loads(line)
                 except json.JSONDecodeError:
                     continue  # stray non-protocol output
-                if msg.get("id") != rid:
-                    continue  # notification or unrelated message
-                if "error" in msg:
-                    err = msg["error"]
-                    raise MCPError(f"{method}: {err.get('message')} ({err.get('code')})")
-                return msg.get("result", {})
+                fut = self._pending.pop(msg.get("id"), None)
+                if fut is not None and not fut.done():
+                    fut.set_result(msg)
+        except asyncio.CancelledError:
+            error = "MCP client closed"
+        except Exception as e:
+            error = f"MCP stdout reader failed: {e}"
+        self._dead = error
+        pending, self._pending = self._pending, {}
+        for fut in pending.values():
+            if not fut.done():
+                fut.set_exception(MCPError(error))
+
+    async def _request(self, method: str, params: dict[str, Any], timeout: float = 30.0) -> dict[str, Any]:
+        if self._dead is not None:
+            raise MCPError(self._dead)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        async with self._lock:  # writes serialize; responses multiplex
+            self._id += 1
+            rid = self._id
+            self._pending[rid] = fut
+            # the reader sets _dead BEFORE swapping out the pending dict:
+            # if it died between the fast-path check and this registration,
+            # our future landed in the post-swap dict nobody will ever
+            # sweep — re-checking AFTER registering closes the window
+            # (dead already set => fail fast; dead set later => the sweep
+            # sees our entry)
+            if self._dead is not None:
+                self._pending.pop(rid, None)
+                raise MCPError(self._dead)
+            try:
+                await self._send({"jsonrpc": "2.0", "id": rid, "method": method, "params": params})
+            except Exception:
+                self._pending.pop(rid, None)
+                raise
+        try:
+            msg = await asyncio.wait_for(fut, timeout)
+        finally:
+            self._pending.pop(rid, None)
+        if "error" in msg:
+            err = msg["error"]
+            raise MCPError(f"{method}: {err.get('message')} ({err.get('code')})")
+        return msg.get("result", {})
 
     async def _notify(self, method: str, params: dict[str, Any]) -> None:
         await self._send({"jsonrpc": "2.0", "method": method, "params": params})
@@ -131,6 +179,13 @@ class StdioMCPClient:
         return self._proc is not None and self._proc.returncode is None
 
     async def close(self) -> None:
+        if self._reader is not None:
+            self._reader.cancel()
+            try:
+                await self._reader
+            except (asyncio.CancelledError, Exception):
+                pass
+            self._reader = None
         if self._proc is None:
             return
         if self._proc.returncode is None:

@@ -792,11 +792,24 @@ class RestServer:
                 for t in body.get("tools") or []
             ]
             json_only = (body.get("response_format") or {}).get("type") == "json_object"
+            # OpenAI tool_choice: "required"/{"type": "function", ...} force
+            # a parseable call exactly like LLM.spec tool_choice does for
+            # the task controller — teacher-forced envelope + grammar
+            # constraint (engine/client.py forced_call_prefix)
+            from ..engine.client import forced_call_prefix
+
+            tool_choice = body.get("tool_choice")
+            if isinstance(tool_choice, dict):
+                tool_choice = (tool_choice.get("function") or {}).get("name") or ""
+            tool_choice = str(tool_choice or "auto")
+            forced = forced_call_prefix(engine.tokenizer, tools, tool_choice)
+            json_required = tool_choice == "required" and bool(tools)
             sampling = SamplingParams(
                 temperature=float(body.get("temperature") or 0.0),
                 top_p=float(body["top_p"]) if body.get("top_p") is not None else 1.0,
                 max_tokens=int(body.get("max_tokens") or 512),
-                json_only=json_only,
+                json_only=json_only or bool(forced) or json_required,
+                forced_prefix=forced,
             )
             # per-request generation deadline (replaces the old hard-coded
             # 600s): propagated into the engine's admission queue, so a
@@ -882,9 +895,14 @@ class RestServer:
                            timeout_s: float = 600.0):
         """SSE streaming (OpenAI chat.completion.chunk wire format): token
         deltas flow from the engine thread per decode block. With tools, the
-        streamed content is the raw (grammar-constrained) JSON text; if the
-        final text parses into tool calls, a tool_calls delta follows before
-        the finish chunk."""
+        engine stream-parses the completion and each call is emitted as a
+        ``tool_calls`` delta chunk the moment its arguments close — while
+        the model is still decoding — so agent clients can start executing
+        early (overlapped tool execution); the finish chunk follows once
+        generation ends. Calls the final batch parse finds beyond the
+        streamed ones are flushed as trailing deltas before the finish
+        chunk, so accumulate-by-index clients always end with the full
+        set."""
         import asyncio as _asyncio
         import time as _time
         import uuid as _uuid
@@ -894,9 +912,17 @@ class RestServer:
 
         loop = _asyncio.get_running_loop()
         q: _asyncio.Queue = _asyncio.Queue()
+        allowed = {t.function.name for t in tools} if tools else None
+
+        def _on_tool_call(_idx, tc):
+            if allowed is not None and tc.function.name not in allowed:
+                return
+            loop.call_soon_threadsafe(q.put_nowait, ("tool_call", tc))
+
         fut = engine.submit(
             prompt, sampling,
             on_tokens=lambda ids: loop.call_soon_threadsafe(q.put_nowait, list(ids)),
+            on_tool_call=_on_tool_call if tools else None,
             timeout_s=timeout_s,
         )
         if fut.done() and isinstance(fut.exception(), EngineOverloadedError):
@@ -933,6 +959,23 @@ class RestServer:
         # tool_calls (matching the non-streamed path): buffer instead of
         # streaming raw tool-call JSON as content deltas
         buffer_mode = bool(tools)
+        streamed_calls: list = []  # tool calls already sent as deltas
+
+        def tool_chunk(calls, base: int) -> bytes:
+            return chunk({
+                "tool_calls": [
+                    {
+                        "index": base + i,
+                        "id": tc.id,
+                        "type": "function",
+                        "function": {
+                            "name": tc.function.name,
+                            "arguments": tc.function.arguments,
+                        },
+                    }
+                    for i, tc in enumerate(calls)
+                ]
+            })
 
         async def error_event(message: str, etype: str) -> None:
             # OpenAI-style streamed error event; no [DONE] after an error
@@ -950,6 +993,14 @@ class RestServer:
                 try:
                     ids = await _asyncio.wait_for(q.get(), timeout=0.1)
                 except _asyncio.TimeoutError:
+                    continue
+                if isinstance(ids, tuple) and ids and ids[0] == "tool_call":
+                    # early tool-call delta: the call's arguments closed in
+                    # the decode stream; flush it NOW so the client can
+                    # dispatch while the model keeps generating
+                    tc = ids[1]
+                    await resp.write(tool_chunk([tc], len(streamed_calls)))
+                    streamed_calls.append(tc)
                     continue
                 pending.extend(ids)
                 if buffer_mode:
@@ -977,8 +1028,11 @@ class RestServer:
                 await resp.write_eof()
                 return resp
             finish = "length" if result.finish_reason == "length" else "stop"
-            allowed = {t.function.name for t in tools} if tools else None
             msg = to_message(result.text, allowed)
+            # the batch parse is authoritative (it is what the non-streamed
+            # endpoint returns): if it yields NO calls, the content flows
+            # and finish stays stop/length even when degenerate output made
+            # the stream emit speculative deltas
             if not (buffer_mode and msg.tool_calls):
                 # authoritative final flush: result.text covers tokens whose
                 # queue callback raced the loop exit and held-back chars;
@@ -987,24 +1041,32 @@ class RestServer:
                 if delta:
                     await resp.write(chunk({"content": delta}))
             if msg.tool_calls:
-                await resp.write(
-                    chunk(
-                        {
-                            "tool_calls": [
-                                {
-                                    "index": i,
-                                    "id": tc.id,
-                                    "type": "function",
-                                    "function": {
-                                        "name": tc.function.name,
-                                        "arguments": tc.function.arguments,
-                                    },
-                                }
-                                for i, tc in enumerate(msg.tool_calls)
-                            ]
-                        }
-                    )
+                # dedupe against the early deltas: the streamed prefix that
+                # positionally matches the batch parse was already sent;
+                # flush only the remainder. (A divergent stream — possible
+                # only for degenerate mixed fenced/bare output — appends
+                # the definitive set after the streamed indices so an
+                # accumulate-by-index client still ends with every real
+                # call.)
+                matched = 0
+                for tc in msg.tool_calls:
+                    if matched >= len(streamed_calls):
+                        break
+                    s = streamed_calls[matched]
+                    if (
+                        s.function.name == tc.function.name
+                        and s.function.arguments == tc.function.arguments
+                    ):
+                        matched += 1
+                    else:
+                        break
+                rest_calls = (
+                    msg.tool_calls[matched:]
+                    if matched == len(streamed_calls)
+                    else msg.tool_calls
                 )
+                if rest_calls:
+                    await resp.write(tool_chunk(rest_calls, len(streamed_calls)))
                 finish = "tool_calls"
             final = {
                 "id": cid,

@@ -1,0 +1,263 @@
+"""Overlapped tool execution end to end: Task controller + TPU engine.
+
+Lives under tests/engine (not tests/controllers) deliberately: it builds
+real Engines, and on this jax build an engine's jitted programs poison
+later TRAINER compiles in the same process (the known CPU donation bug
+class, see the _put upload guard) — so like every other engine-building
+test it must run AFTER the train-path tests (lora/moe/parallel_train),
+which pytest's alphabetical order within this directory provides.
+
+The tentpole contract at the control-plane level: with overlap ON the
+ToolCall CR is created the moment the streamed call's arguments close
+(acp_task_early_toolcalls_total) and the engine slot parks after the turn;
+with overlap OFF everything happens after the full completion — and the
+JOINED CONVERSATION STATE is identical either way (modulo generated call
+ids, which are random in both modes).
+"""
+
+import asyncio
+import dataclasses
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.api import ObjectMeta
+from agentcontrolplane_tpu.api.resources import (
+    LLM,
+    BaseConfig,
+    LLMSpec,
+    MCPTool,
+    TPUProviderConfig,
+    TASK_PHASE_FAILED,
+)
+from agentcontrolplane_tpu.engine.engine import Engine
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.metrics import REGISTRY
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import (
+    FAULTS,
+    make_agent,
+    make_mcpserver,
+    make_task,
+    setup_with_status,
+)
+
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=512, n_kv_heads=2)
+
+
+class FakeMCPManager:
+    def __init__(self):
+        self.calls = []
+
+    def get_tools(self, name):
+        if name != "svc":
+            return []
+        return [
+            MCPTool(
+                name="lookup",
+                description="look something up",
+                input_schema={"type": "object", "properties": {}},
+            )
+        ]
+
+    async def call_tool(self, server, tool, args):
+        self.calls.append((server, tool, args))
+        return "lookup-result"
+
+
+def make_engine():
+    eng = Engine(
+        config=CFG,
+        tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=4,
+        max_ctx=512,
+        prefill_buckets=(64, 128, 256, 512),
+        decode_block_size=4,
+        kv_layout="slot",
+    )
+    eng.start()
+    return eng
+
+
+def counter(name: str) -> float:
+    m = REGISTRY._metrics.get(name)
+    return 0.0 if m is None else m.values.get((), 0.0)
+
+
+def normalized_window(task):
+    """Context window with the random call ids replaced positionally, so
+    two runs compare on structure + content."""
+    out = []
+    for m in task.status.context_window:
+        calls = [
+            (tc.function.name, tc.function.arguments) for tc in (m.tool_calls or [])
+        ]
+        out.append((m.role, m.content, calls, bool(m.tool_call_id)))
+    return out
+
+
+async def drive_turn(overlap: bool, mid_turn=None):
+    """Run one full tool-call turn (send -> fan-out -> execute -> join) and
+    return (normalized window, fake manager, engine stats, task). The LLM
+    forces a single parseable call via tool_choice=required, so a random-
+    weights model produces a real ToolCall deterministically."""
+    engine = make_engine()
+    op = Operator(
+        options=OperatorOptions(
+            enable_rest=False, llm_probe=False,
+            verify_channel_credentials=False, engine=engine,
+        ),
+    )
+    op.task_reconciler.requeue_delay = 0.02
+    op.toolcall_reconciler.poll_interval = 0.02
+    fake = FakeMCPManager()
+    op.task_reconciler.mcp_manager = fake
+    op.toolcall_reconciler.mcp_manager = fake
+    store = op.store
+    try:
+        setup_with_status(
+            store,
+            LLM(
+                metadata=ObjectMeta(name="tpu-llm"),
+                spec=LLMSpec(
+                    provider="tpu",
+                    parameters=BaseConfig(model="tiny", max_tokens=24, temperature=0.0),
+                    tpu=TPUProviderConfig(
+                        preset="tiny", overlap_tool_calls=overlap
+                    ),
+                    provider_config={"tool_choice": "required"},
+                ),
+            ),
+            lambda o: (
+                setattr(o.status, "ready", True),
+                setattr(o.status, "status", "Ready"),
+            ),
+        )
+        make_mcpserver(store, name="svc", tools=("lookup",))
+        make_agent(store, name="agent", llm="tpu-llm", system="use tools",
+                   mcp_servers=("svc",))
+        await op.start()
+        make_task(store, name="t1", agent="agent", user_message="look it up")
+
+        deadline = asyncio.get_running_loop().time() + 120
+        task = None
+        while asyncio.get_running_loop().time() < deadline:
+            task = store.try_get("Task", "t1", "default")
+            if task is not None and task.status.phase == TASK_PHASE_FAILED:
+                raise AssertionError(f"task failed: {task.status.error}")
+            # one full turn joined: [system, user, assistant(calls), tool]
+            if task is not None and task.status.message_count >= 4:
+                break
+            if mid_turn is not None:
+                await mid_turn(engine, task)
+            await asyncio.sleep(0.02)
+        assert task is not None and task.status.message_count >= 4, (
+            task and task.status.phase
+        )
+        stats = engine.stats()
+        from agentcontrolplane_tpu.api.resources import ToolCall
+
+        crs = [
+            tc for tc in store.list("ToolCall", "default")
+            if isinstance(tc, ToolCall)
+        ]
+        return normalized_window(task), fake, stats, (task, crs)
+    finally:
+        await op.stop()
+        engine.stop()
+
+
+async def test_overlap_on_off_identical_joined_state():
+    before = counter("acp_task_early_toolcalls_total")
+    win_on, fake_on, stats_on, _ = await drive_turn(overlap=True)
+    after = counter("acp_task_early_toolcalls_total")
+    win_off, fake_off, stats_off, _ = await drive_turn(overlap=False)
+
+    # the load-bearing contract: identical joined conversation state (the
+    # constrained completion's argument JSON is arbitrary with random
+    # weights but greedily deterministic — both modes must agree exactly)
+    assert win_on == win_off
+    assert fake_on.calls == fake_off.calls
+    assert [c[:2] for c in fake_on.calls] == [("svc", "lookup")]
+    # overlap actually took the early path and parked the finished slot
+    assert after - before >= 1
+    assert stats_on["tool_overlap"]["parks"] >= 1
+    assert stats_on["tool_overlap"]["early_calls"] >= 1
+    # plain mode took neither
+    assert stats_off["tool_overlap"]["parks"] == 0
+    assert stats_off["tool_overlap"]["early_calls"] == 0
+
+
+async def test_stress_early_dispatch_slow_tool_force_preempt_on_parked_slot():
+    """Satellite stress: the streamed call dispatches early, the tool is
+    slow (fault tool.slow), and while the slot sits parked waiting out the
+    tool a forced preemption lands on it — the parked slot absorbs the
+    fault (voluntary release), the join still completes, and the joined
+    state matches an unstressed run."""
+    # the slow tool holds the join open long enough for the filler's cold
+    # decode-width compile to finish INSIDE the parked window (turn 2 must
+    # not start and adopt the parked slot before the fault fires)
+    FAULTS.arm("tool.slow", times=1, seconds=6.0)
+    fired = {"done": False, "released_in_window": False}
+
+    async def mid_turn(engine, task):
+        # once the turn parked (generation done, slow tool still running),
+        # force a preemption via an unrelated engine request — the victim
+        # scan must pick the parked slot. json_only + an open forced
+        # prefix guarantees the filler actually DECODES (grammar masks
+        # stop tokens until the object closes), so the fault site in the
+        # decode path is reached deterministically.
+        if not fired["done"] and engine.stats()["parked_slots"] == 1:
+            fired["done"] = True
+            FAULTS.arm("engine.force_preempt", times=1)
+            from agentcontrolplane_tpu.engine.engine import SamplingParams
+
+            engine.submit(
+                "unrelated filler work",
+                SamplingParams(
+                    temperature=0.0, max_tokens=24, json_only=True,
+                    forced_prefix=tuple(
+                        engine.tokenizer.encode('{"filler": ')
+                    ),
+                ),
+            )
+            deadline = asyncio.get_running_loop().time() + 60
+            while (
+                FAULTS.armed("engine.force_preempt")
+                and asyncio.get_running_loop().time() < deadline
+            ):
+                await asyncio.sleep(0.05)
+            fired["released_in_window"] = engine.stats()["parked_slots"] == 0
+
+    try:
+        win, fake, stats, _ = await drive_turn(overlap=True, mid_turn=mid_turn)
+    finally:
+        FAULTS.reset()
+    assert fired["done"], "parked window never observed"
+    assert fired["released_in_window"], "forced preemption missed the parked slot"
+    assert stats["tool_overlap"]["park_releases"] >= 1
+    assert [c[:2] for c in fake.calls] == [("svc", "lookup")]
+
+    ref_win, _, _, _ = await drive_turn(overlap=False)
+    assert win == ref_win
+
+
+async def test_early_cr_is_adopted_by_fan_out():
+    """The early-created CR must BE the turn's fan-out: its request_id
+    label matches task.status.tool_call_request_id and its tool_call_id is
+    the id recorded in the assistant message (no duplicate CRs)."""
+    _, _, _, (task, crs) = await drive_turn(overlap=True)
+    assistant = next(
+        m for m in task.status.context_window if m.role == "assistant" and m.tool_calls
+    )
+    assert len(crs) == 1  # adopted, not duplicated
+    cr = crs[0]
+    rid = task.status.tool_call_request_id
+    from agentcontrolplane_tpu.api.resources import LABEL_TOOL_CALL_REQUEST
+
+    assert rid and cr.metadata.labels.get(LABEL_TOOL_CALL_REQUEST) == rid
+    assert cr.spec.tool_call_id == assistant.tool_calls[0].id
+    assert cr.spec.tool_ref.name == "svc__lookup"

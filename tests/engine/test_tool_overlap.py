@@ -1,0 +1,263 @@
+"""Overlapped tool execution, engine side: early tool-call events from the
+decode stream, park-at-finish slots, adoption by the next turn, and the
+byte-identity contract — overlap/park on vs off changes WHEN tool calls
+become dispatchable, never what is generated.
+"""
+
+import dataclasses
+import threading
+import time
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2)
+
+TWO_CALLS = '{"name": "t1", "arguments": {"x": 1}} {"name": "t2", "arguments": {}}'
+
+
+def make_engine(kv_layout="paged", **kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("park_max_s", 30.0)
+    kw.setdefault("max_slots", 4)
+    eng = Engine(
+        config=CFG,
+        tokenizer=TOK,
+        mesh=mesh,
+        max_ctx=256,
+        prefill_buckets=(32, 64, 128),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        page_size=8,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_early_events_fire_before_generation_ends(kv_layout):
+    """Two tool calls closing before a ~40-token decode tail must be
+    surfaced while the model is still generating: each event strictly
+    precedes the future's resolution, in stream order, and the same list
+    rides the future as ``early_tool_calls``."""
+    eng = make_engine(kv_layout)
+    try:
+        events = []
+        done_at = {}
+        fut = eng.submit(
+            "hello " * 8,
+            SamplingParams(
+                temperature=0.0, max_tokens=40,
+                forced_prefix=tuple(TOK.encode(TWO_CALLS)),
+            ),
+            on_tool_call=lambda i, tc: events.append((i, tc.function.name, time.monotonic())),
+            park=False,
+        )
+        res = fut.result(120)
+        done_at["t"] = time.monotonic()
+        assert [(i, n) for i, n, _ in events] == [(0, "t1"), (1, "t2")]
+        assert all(t < done_at["t"] for _, _, t in events)
+        assert [tc.function.name for _, tc in fut.early_tool_calls] == ["t1", "t2"]
+        assert len(res.tokens) >= 40
+        s = eng.stats()["tool_overlap"]
+        assert s["early_calls"] == 2
+        assert s["overlap_saved_s"] > 0
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_overlap_park_two_turn_byte_identity(kv_layout):
+    """The safety rail: a two-turn conversation with overlap + park on
+    (turn 2 adopts the parked slot, suffix-only prefill) generates the
+    exact token streams of a plain engine, in both KV layouts."""
+    turn1 = "user question " * 4
+    turn2 = turn1 + "assistant said things; tool results; next question"
+    sp1 = SamplingParams(
+        temperature=0.0, max_tokens=16, forced_prefix=tuple(TOK.encode(TWO_CALLS))
+    )
+    sp2 = SamplingParams(temperature=0.0, max_tokens=8)
+
+    eng = make_engine(kv_layout)
+    try:
+        r1 = eng.submit(turn1, sp1, on_tool_call=lambda i, tc: None, park=True).result(120)
+        assert eng.stats()["parked_slots"] == 1
+        r2 = eng.submit(turn2, sp2).result(120)
+        st = eng.stats()["tool_overlap"]
+        assert st["parks"] == 1 and st["park_adoptions"] == 1
+        assert eng.stats()["parked_slots"] == 0  # turn 2 didn't ask to park
+    finally:
+        eng.stop()
+
+    ref = make_engine(kv_layout, park_max_s=0.0)
+    try:
+        p1 = ref.submit(turn1, sp1).result(120)
+        p2 = ref.submit(turn2, sp2).result(120)
+    finally:
+        ref.stop()
+    assert r1.tokens == p1.tokens and r1.text == p1.text
+    assert r2.tokens == p2.tokens and r2.text == p2.text
+
+
+def test_overlap_byte_identity_with_speculation_and_json_constraint():
+    """Speculation on + grammar-forced tool call + overlap/park vs the
+    plain spec-off engine: identical bytes, and the (decoded, not
+    prefilled) closing brace still emits an early event — the spec path's
+    multi-token commits feed the same stream seam."""
+    envelope = '{"name": "fetch", "arguments": {'
+    sp = SamplingParams(
+        temperature=0.0, max_tokens=48, json_only=True,
+        forced_prefix=tuple(TOK.encode(envelope)),
+    )
+    prompt = "fetch fetch fetch " * 6  # self-repetitive: lets the drafter engage
+
+    eng = make_engine("paged", spec_len=8, spec_ngram=3)
+    try:
+        events = []
+        r = eng.submit(
+            prompt, sp, on_tool_call=lambda i, tc: events.append(tc), park=True
+        ).result(180)
+        assert [tc.function.name for tc in events] == ["fetch"]
+        assert eng.stats()["tool_overlap"]["parks"] == 1
+    finally:
+        eng.stop()
+
+    ref = make_engine("paged", park_max_s=0.0)
+    try:
+        p = ref.submit(prompt, sp).result(180)
+    finally:
+        ref.stop()
+    assert r.tokens == p.tokens and r.text == p.text
+
+
+def test_parked_slot_yields_under_pool_pressure():
+    """Parked pages are speculative capacity: when the pool runs dry they
+    are released (voluntarily, before any live slot is preempted) so new
+    admissions never starve behind a parked conversation."""
+    eng = make_engine("paged", kv_pages=18, max_slots=2)
+    try:
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=8, forced_prefix=tuple(TOK.encode(TWO_CALLS))
+        )
+        eng.submit("a" * 40, sp, on_tool_call=lambda i, tc: None, park=True).result(120)
+        assert eng.stats()["parked_slots"] == 1
+        # a fat unrelated burst needs the parked pages
+        futs = [
+            eng.submit(ch * 60, SamplingParams(temperature=0.0, max_tokens=24))
+            for ch in "bc"
+        ]
+        for f in futs:
+            f.result(120)
+        st = eng.stats()
+        assert st["parked_slots"] == 0
+        assert st["tool_overlap"]["park_releases"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_force_preempt_lands_on_parked_slot_first():
+    """faults: engine.force_preempt while a parked slot and a live slot
+    coexist — the parked slot is the victim (voluntary release, no work
+    lost), and the live generation completes un-preempted."""
+    eng = make_engine("paged")
+    try:
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=8, forced_prefix=tuple(TOK.encode(TWO_CALLS))
+        )
+        eng.submit("conversation one " * 3, sp, park=True).result(120)
+        assert eng.stats()["parked_slots"] == 1
+        FAULTS.arm("engine.force_preempt", times=1)
+        live = eng.submit(
+            "unrelated work", SamplingParams(temperature=0.0, max_tokens=24)
+        ).result(120)
+        assert live.preempt_count == 0  # the parked slot absorbed the fault
+        st = eng.stats()
+        assert st["parked_slots"] == 0
+        assert st["tool_overlap"]["park_releases"] == 1
+        assert st["preemptions"] == 0  # a park release is not a preemption
+    finally:
+        eng.stop()
+
+
+def test_unclaimed_park_expires():
+    eng = make_engine("slot", park_max_s=0.3)
+    try:
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=6, forced_prefix=tuple(TOK.encode(TWO_CALLS))
+        )
+        eng.submit("final answer turn " * 3, sp, park=True).result(120)
+        assert eng.stats()["parked_slots"] == 1
+        deadline = time.monotonic() + 10
+        while eng.stats()["parked_slots"] and time.monotonic() < deadline:
+            time.sleep(0.05)
+        st = eng.stats()
+        assert st["parked_slots"] == 0
+        assert st["tool_overlap"]["park_releases"] == 1
+    finally:
+        eng.stop()
+
+
+def test_full_house_of_parked_slots_never_blocks_admission():
+    """Every slot parked: a new, unrelated prompt must still admit (the
+    LRU parked slot yields its slot index)."""
+    eng = make_engine("slot", max_slots=2)
+    try:
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=4, forced_prefix=tuple(TOK.encode(TWO_CALLS))
+        )
+        eng.submit("conv A " * 4, sp, park=True).result(120)
+        eng.submit("conv B " * 4, sp, park=True).result(120)
+        assert eng.stats()["parked_slots"] == 2
+        r = eng.submit(
+            "conv C brand new", SamplingParams(temperature=0.0, max_tokens=4)
+        ).result(120)
+        assert r.finish_reason in ("stop", "length")
+        st = eng.stats()
+        assert st["tool_overlap"]["park_releases"] >= 1
+    finally:
+        eng.stop()
+
+
+def test_early_events_survive_preempt_resume_without_replay():
+    """A request preempted mid-decode and resumed must neither drop nor
+    re-emit its early tool calls: the parser rides the request, and resume
+    streams only fresh tokens."""
+    eng = make_engine("paged", kv_pages=24, max_slots=2)
+    try:
+        events = []
+        lock = threading.Lock()
+
+        def on_tc(i, tc):
+            with lock:
+                events.append((i, tc.function.name))
+
+        # both admit together (11 pages each of 23), then grow past the pool
+        sp = SamplingParams(
+            temperature=0.0, max_tokens=40,
+            forced_prefix=tuple(TOK.encode(TWO_CALLS)),
+        )
+        with eng.hold_admission():
+            futs = [
+                eng.submit(ch * 16, sp, on_tool_call=on_tc) for ch in "ab"
+            ]
+        results = [f.result(180) for f in futs]
+        assert sum(r.preempt_count for r in results) >= 1  # pressure did preempt
+        with lock:
+            # exactly one (0, t1) + one (1, t2) pair per request — no replay
+            assert sorted(events) == [(0, "t1"), (0, "t1"), (1, "t2"), (1, "t2")]
+    finally:
+        eng.stop()

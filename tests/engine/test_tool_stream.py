@@ -1,0 +1,155 @@
+"""ToolStreamParser: incremental tool-call extraction for overlapped
+execution, plus the parse_tool_calls fenced-fallback regression.
+
+The load-bearing property: for the wire convention the system prompt
+teaches (bare JSON objects, optionally fenced), the stream parser fed any
+chunking of the text emits exactly the calls the batch parser extracts
+from the finished text — early dispatch moves WHEN execution starts,
+never what the conversation records.
+"""
+
+import json
+
+from agentcontrolplane_tpu.engine.toolparse import (
+    ToolStreamParser,
+    parse_tool_calls,
+    to_message,
+)
+
+CALL1 = '{"name": "web__fetch", "arguments": {"url": "https://x.test/a"}}'
+CALL2 = '{"name": "db__query", "arguments": {"sql": "select 1"}}'
+
+
+def feed_chunks(text, size):
+    p = ToolStreamParser()
+    out = []
+    for i in range(0, len(text), size):
+        out.extend(p.feed(text[i : i + size]))
+    return p, out
+
+
+def names_args(calls):
+    return [(c.function.name, c.function.arguments) for c in calls]
+
+
+def test_single_call_one_feed_matches_batch():
+    p = ToolStreamParser()
+    got = p.feed(CALL1)
+    assert names_args(got) == names_args(parse_tool_calls(CALL1))
+
+
+def test_call_split_at_every_boundary():
+    """Chunk the text at EVERY possible split point (the worst decode-block
+    boundary): one call in, one call out, identical arguments."""
+    for cut in range(1, len(CALL1)):
+        p = ToolStreamParser()
+        got = p.feed(CALL1[:cut]) + p.feed(CALL1[cut:])
+        assert names_args(got) == names_args(parse_tool_calls(CALL1)), cut
+
+
+def test_multi_token_commit_chunkings_match_batch():
+    """Prose + two calls, chunked at sizes mimicking 1-token deltas up to
+    speculative multi-token commits — every chunking yields the batch
+    parser's calls in order."""
+    text = f"I'll do two things.\nFirst: {CALL1}\nthen also {CALL2} — done!"
+    want = names_args(parse_tool_calls(text))
+    assert len(want) == 2
+    for size in (1, 2, 3, 5, 8, 13, 64, len(text)):
+        _, got = feed_chunks(text, size)
+        assert names_args(got) == want, size
+
+
+def test_escaped_quotes_and_unicode_escapes_in_arguments():
+    call = (
+        '{"name": "note__add", "arguments": '
+        '{"text": "he said \\"hi\\" \\u00e9\\u0301 {not a call}"}}'
+    )
+    want = names_args(parse_tool_calls(call))
+    assert want and want[0][0] == "note__add"
+    for size in (1, 3, 7, len(call)):
+        _, got = feed_chunks(call, size)
+        assert names_args(got) == want, size
+        # the escaped payload survives intact
+        assert json.loads(got[0].function.arguments)["text"].startswith('he said "hi"')
+
+
+def test_python_tag_split_across_deltas():
+    """<|python_tag|> is prose to the scanner (no braces): a call after a
+    tag split mid-delta parses identically."""
+    text = f"<|python_tag|>{CALL1}"
+    for cut in (1, 5, 9, 14):  # splits inside the tag
+        p = ToolStreamParser()
+        got = p.feed(text[:cut]) + p.feed(text[cut:])
+        assert names_args(got) == names_args(parse_tool_calls(text)), cut
+
+
+def test_prose_interleaved_between_calls():
+    text = f"step one {CALL1} now, after thinking a bit... step two {CALL2} ok"
+    _, got = feed_chunks(text, 4)
+    assert [n for n, _ in names_args(got)] == ["web__fetch", "db__query"]
+
+
+def test_never_closing_brace_bounded_buffering():
+    """An object that never closes must not buffer unboundedly: past
+    max_object_bytes it is abandoned as prose (dropped counter), and a
+    later well-formed call still parses."""
+    p = ToolStreamParser(max_object_bytes=256)
+    p.feed('{"name": "stuck", "arguments": {"x": "')
+    for _ in range(64):
+        assert p.feed("a" * 64) == []
+    assert p.dropped >= 1
+    assert p._buf_len <= 256 + 64  # bounded: candidate was reset
+    got = p.feed(f" trailing prose {CALL2}")
+    assert names_args(got) == names_args(parse_tool_calls(CALL2))
+
+
+def test_nested_objects_and_string_arguments_form():
+    nested = '{"name": "cfg__set", "arguments": {"obj": {"a": {"b": 1}}}}'
+    _, got = feed_chunks(nested, 3)
+    assert json.loads(got[0].function.arguments) == {"obj": {"a": {"b": 1}}}
+    stringly = '{"name": "t__x", "arguments": "{\\"k\\": 1}"}'
+    _, got = feed_chunks(stringly, 5)
+    assert got[0].function.arguments == '{"k": 1}'
+
+
+def test_fenced_block_objects_found_by_scanner():
+    text = f'Sure:\n```json\n{CALL1}\n```\nrunning it now'
+    _, got = feed_chunks(text, 6)
+    assert names_args(got) == names_args(parse_tool_calls(text))
+
+
+def test_emitted_indices_are_stable():
+    p = ToolStreamParser()
+    a = p.feed(CALL1)
+    b = p.feed(" and " + CALL2)
+    assert p.emitted == 2 and len(a) == 1 and len(b) == 1
+
+
+# -- parse_tool_calls fenced-fallback regression (satellite bugfix) ---------
+
+
+def test_fenced_block_that_fails_json_falls_back_to_brace_scan():
+    """Regression: a fenced block whose whole content fails json.loads
+    (prose around the object) used to suppress the balanced-brace fallback
+    entirely — the call inside was lost."""
+    text = f"```json\nhere is the call:\n{CALL1}\n```"
+    calls = parse_tool_calls(text)
+    assert names_args(calls) == [
+        ("web__fetch", '{"url": "https://x.test/a"}'),
+    ]
+    msg = to_message(text, allowed_tools={"web__fetch"})
+    assert msg.tool_calls and msg.content == ""
+
+
+def test_fenced_block_with_two_objects_falls_back_and_finds_both():
+    text = f"```json\n{CALL1}\n{CALL2}\n```"
+    assert [n for n, _ in names_args(parse_tool_calls(text))] == [
+        "web__fetch", "db__query",
+    ]
+
+
+def test_parseable_fenced_block_still_takes_precedence():
+    """Unchanged rule: when a fence yields a call, bare objects outside
+    fences stay prose (defensive against JSON-looking prose)."""
+    text = f"```json\n{CALL1}\n```\nand ignore {CALL2} please"
+    assert [n for n, _ in names_args(parse_tool_calls(text))] == ["web__fetch"]

@@ -40,7 +40,7 @@ class _StuckEngine:
     def ensure_running(self) -> bool:
         return True
 
-    def submit(self, prompt, sampling, timeout_s=None) -> Future:
+    def submit(self, prompt, sampling, timeout_s=None, **kw) -> Future:
         return Future()  # never resolves
 
     def cancel(self, future: Future) -> None:

@@ -3,12 +3,15 @@ against real MCP servers like mcp-server-fetch; we need zero-dependency).
 
 Speaks newline-delimited JSON-RPC 2.0: initialize, tools/list, tools/call.
 Tools: echo (returns its input), env (returns an env var — used to test
-Secret-resolved env injection), fail (returns isError).
+Secret-resolved env injection), fail (returns isError), sleep (responds
+after N seconds FROM A THREAD — concurrent sleeps overlap and responses
+can arrive out of order, exercising the client's id-multiplexed reader).
 """
 
 import json
 import os
 import sys
+import threading
 
 TOOLS = [
     {
@@ -34,7 +37,34 @@ TOOLS = [
         "description": "always fails",
         "inputSchema": {"type": "object", "properties": {}},
     },
+    {
+        "name": "sleep",
+        "description": "respond after N seconds (from a worker thread)",
+        "inputSchema": {
+            "type": "object",
+            "properties": {"seconds": {"type": "number"}},
+        },
+    },
 ]
+
+_WRITE_LOCK = threading.Lock()
+
+
+def _write(resp):
+    with _WRITE_LOCK:
+        sys.stdout.write(json.dumps(resp) + "\n")
+        sys.stdout.flush()
+
+
+def _sleep_worker(msg_id, seconds):
+    import time
+
+    time.sleep(seconds)
+    _write({
+        "jsonrpc": "2.0",
+        "id": msg_id,
+        "result": {"content": [{"type": "text", "text": f"slept {seconds}"}]},
+    })
 
 
 def handle(msg):
@@ -71,6 +101,15 @@ def main():
             continue
         if "id" not in msg:
             continue  # notification
+        if (
+            msg.get("method") == "tools/call"
+            and (msg.get("params") or {}).get("name") == "sleep"
+        ):
+            secs = float((msg["params"].get("arguments") or {}).get("seconds", 0.1))
+            threading.Thread(
+                target=_sleep_worker, args=(msg["id"], secs), daemon=True
+            ).start()
+            continue
         result = handle(msg)
         if result is None:
             resp = {
@@ -80,8 +119,7 @@ def main():
             }
         else:
             resp = {"jsonrpc": "2.0", "id": msg["id"], "result": result}
-        sys.stdout.write(json.dumps(resp) + "\n")
-        sys.stdout.flush()
+        _write(resp)
 
 
 if __name__ == "__main__":

@@ -46,7 +46,7 @@ async def test_connect_discovers_tools(store):
     mgr = MCPManager(store)
     try:
         conn = await mgr.connect_server(echo_server_spec())
-        assert {t.name for t in conn.tools} == {"echo", "env", "fail"}
+        assert {t.name for t in conn.tools} == {"echo", "env", "fail", "sleep"}
         assert conn.client.server_info["name"] == "echo-test-server"
         assert mgr.get_tools("echo")  # pool populated
     finally:
@@ -251,3 +251,30 @@ async def test_http_transport_against_live_server(store):
     finally:
         await mgr.close()
         await runner.cleanup()
+
+
+async def test_concurrent_calls_to_one_stdio_server_overlap(store):
+    """Overlapped tool execution, transport half: two slow calls to ONE
+    stdio server must run concurrently (id-multiplexed reader), not
+    serialize behind a request-response lock — and out-of-order responses
+    route to the right caller."""
+    import asyncio
+    import time
+
+    mgr = MCPManager(store)
+    try:
+        await mgr.connect_server(echo_server_spec())
+        t0 = time.monotonic()
+        slow, fast, echoed = await asyncio.gather(
+            mgr.call_tool("echo", "sleep", {"seconds": 0.8}),
+            mgr.call_tool("echo", "sleep", {"seconds": 0.1}),
+            mgr.call_tool("echo", "echo", {"message": "while sleeping"}),
+        )
+        elapsed = time.monotonic() - t0
+        assert slow == "slept 0.8" and fast == "slept 0.1"
+        assert echoed == "echo: while sleeping"
+        # serial execution would take >= 0.9s; overlapped ~0.8s. Generous
+        # margin for slow CI, still far below the serial floor.
+        assert elapsed < 1.4, elapsed
+    finally:
+        await mgr.close()

@@ -634,3 +634,85 @@ async def test_engine_status_exposes_decode_efficiency_and_spec_block():
             assert "acp_engine_tokens_per_decode_step" in text
     finally:
         eng.stop()
+
+
+async def test_chat_completions_sse_streams_early_tool_call_deltas():
+    """Overlapped tool execution over the OpenAI SSE wire: with tools, a
+    tool_calls delta chunk is emitted the moment the streamed call's
+    arguments close — BEFORE the finish chunk — and accumulating the
+    deltas by index yields exactly the non-streamed response's call set
+    (names + arguments; ids are per-request randoms)."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=512, prefill_buckets=(256, 512), decode_block_size=4,
+    )
+    eng.start()
+    try:
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            # tool_choice "required" teacher-forces the call envelope +
+            # grammar constraint, so a random-weights model deterministically
+            # produces a parseable call over the wire
+            payload = {
+                "model": "tiny",
+                "messages": [{"role": "user", "content": "call the tool"}],
+                "tools": [
+                    {"function": {"name": "svc__lookup", "description": "", "parameters": {}}}
+                ],
+                "tool_choice": "required",
+                "max_tokens": 24,
+                "temperature": 0,
+            }
+            ref = await (await h.http.post(
+                f"{h.base}/v1/chat/completions", json=payload
+            )).json()
+            ref_msg = ref["choices"][0]["message"]
+            assert ref_msg.get("tool_calls"), ref_msg  # forced call landed
+
+            resp = await h.http.post(
+                f"{h.base}/v1/chat/completions", json={**payload, "stream": True}
+            )
+            assert resp.status == 200
+            raw = (await resp.read()).decode()
+            events = [
+                json.loads(line[len("data: "):])
+                for line in raw.splitlines()
+                if line.startswith("data: ") and line != "data: [DONE]"
+            ]
+            deltas = [e["choices"][0]["delta"] for e in events]
+            # the early delta precedes the finish chunk
+            first_tc = next(i for i, d in enumerate(deltas) if d.get("tool_calls"))
+            finish_idx = next(
+                i for i, e in enumerate(events)
+                if e["choices"][0]["finish_reason"] is not None
+            )
+            assert first_tc < finish_idx
+            assert events[finish_idx]["choices"][0]["finish_reason"] == "tool_calls"
+            # accumulate tool_calls deltas by index -> the non-streamed set
+            acc: dict[int, dict] = {}
+            for d in deltas:
+                for tc in d.get("tool_calls") or []:
+                    acc[tc["index"]] = tc
+            assert [
+                (acc[i]["function"]["name"], acc[i]["function"]["arguments"])
+                for i in sorted(acc)
+            ] == [
+                (tc["function"]["name"], tc["function"]["arguments"])
+                for tc in ref_msg["tool_calls"]
+            ]
+            # buffer mode: raw tool-call JSON never leaks as content deltas
+            assert not any(d.get("content") for d in deltas)
+    finally:
+        eng.stop()

@@ -397,3 +397,23 @@ def test_burst_flops_counts_lm_head_once_per_prefill():
     # decode tokens still pay the head every step (they each sample)
     got2 = bench._burst_model_flops(c, P, prefills=1, gen_tokens=3, mean_ctx=12.0)
     assert got2 == got + 3 * bench._flops_per_token(c, 12.0)
+
+
+def test_write_pr_doc_emits_and_respects_absence(tmp_path, monkeypatch):
+    """ACP_BENCH_PR_DOC persists the final doc (per-PR perf trajectory);
+    unset, nothing is written and the headline contract is untouched."""
+    import json
+
+    import bench
+
+    doc = {"metric": "decode_tok_s_per_chip", "value": 1.0,
+           "tool_turn": {"saved_pct": 42.0}}
+    monkeypatch.delenv("ACP_BENCH_PR_DOC", raising=False)
+    bench._write_pr_doc(doc)  # no env -> no-op, no crash
+
+    path = tmp_path / "BENCH_PR999.json"
+    monkeypatch.setenv("ACP_BENCH_PR_DOC", str(path))
+    bench._write_pr_doc(doc)
+    saved = json.loads(path.read_text())
+    assert saved["tool_turn"]["saved_pct"] == 42.0
+    assert saved["measured_at"]  # provenance stamp rides along
