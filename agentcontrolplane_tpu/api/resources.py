@@ -152,6 +152,19 @@ class TPUProviderConfig(APIModel):
     # and the joined conversation are byte-identical either way (see
     # docs/serving-engine.md "Overlapped tool execution").
     overlap_tool_calls: bool = True
+    # Chunked prefill + unified token-budget scheduler: > 0 splits every
+    # prefill into chunks of at most this many tokens that co-schedule with
+    # decode steps and speculative verify under one per-dispatch token
+    # budget, so a long agent prompt cannot head-of-line-block every
+    # decoding slot for its whole prefill. Greedy outputs are byte-identical
+    # chunked on or off. 0 = off (whole prefill at admission) — the
+    # engine-side default; serve-time CLI: --tpu-prefill-chunk.
+    prefill_chunk: int = Field(default=0, ge=0)
+    # Per-dispatch-cycle token budget the scheduler spends across prefill
+    # chunks, the decode block, and draft verification. 0 = auto-sized
+    # (decode always dispatches; one chunk per mid-prefill slot rides
+    # along). Only meaningful with prefill_chunk > 0; CLI: --tpu-token-budget.
+    token_budget: int = Field(default=0, ge=0)
 
 
 class OpenAIProviderConfig(APIModel):

@@ -82,6 +82,21 @@ def _add_tpu_flags(p) -> None:
         help="longest n-gram the prompt-lookup drafter matches on",
     )
     p.add_argument(
+        "--tpu-prefill-chunk", type=int, default=0,
+        help="chunked prefill: split every prefill into chunks of at most "
+        "this many tokens, co-scheduled with decode under the unified "
+        "token-budget scheduler so one long prompt can't head-of-line-block "
+        "decoding slots (greedy outputs byte-identical on/off; see "
+        "docs/serving-engine.md); 0 = off (whole prefill at admission)",
+    )
+    p.add_argument(
+        "--tpu-token-budget", type=int, default=0,
+        help="per-dispatch-cycle token budget the scheduler spends across "
+        "prefill chunks + decode + speculative verify; 0 = auto-sized "
+        "(decode always dispatches, one chunk per mid-prefill slot rides "
+        "along); only meaningful with --tpu-prefill-chunk",
+    )
+    p.add_argument(
         "--tpu-park-max-s", type=float, default=30.0,
         help="overlapped tool execution: seconds a slot parked at "
         "generation end (prompt KV resident) waits for the conversation's "
@@ -106,6 +121,8 @@ def _build_engine(args, coordination=None):
         spec_len=args.tpu_spec_len,
         spec_ngram=args.tpu_spec_ngram,
         park_max_s=args.tpu_park_max_s,
+        prefill_chunk=args.tpu_prefill_chunk,
+        token_budget=args.tpu_token_budget,
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:

@@ -193,6 +193,18 @@ class _Slot:
     parked: bool = False
     parked_at: float = 0.0
     park_cut: int = 0  # KV rows valid for adoption (page-aligned in paged)
+    # chunked prefill: the slot is admitted (slot id + KV pages reserved)
+    # but its prompt KV is only partially written — the unified scheduler
+    # advances it one chunk per dispatch cycle, interleaved with decode.
+    # A prefilling slot never decodes; it is a first-class preemption
+    # citizen (preempting it loses no sampled tokens — the request requeues
+    # and re-enters the chunk loop from its prefix-cache start on
+    # re-admission) and its deadline expiring mid-prefill releases the
+    # partial KV. ``prefill_pos`` = KV rows written so far; ``prefill_row``
+    # caches _full_row(request) so the hot loop doesn't rebuild it.
+    prefilling: bool = False
+    prefill_pos: int = 0
+    prefill_row: Optional[list] = None
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -242,6 +254,26 @@ class Engine:
         # (EngineOverloadedError -> REST 503 + Retry-After) instead of
         # queueing unboundedly. 0 = unbounded (tests, embedded use).
         max_queue: int = 0,
+        # chunked prefill + unified token-budget scheduler: > 0 splits every
+        # prefill into chunks of at most this many tokens that CO-SCHEDULE
+        # with decode blocks and speculative verify dispatches — one long
+        # prompt no longer head-of-line-blocks every decoding slot for its
+        # whole prefill. Greedy outputs are byte-identical chunked on vs off
+        # (chunks only re-shape WHEN prompt KV is written, never what is
+        # sampled). 0 = off (the default): the whole prefill runs at
+        # admission, exactly the pre-chunking engine. Paged layout rounds
+        # the chunk up to a page multiple (non-final chunks must commit
+        # whole pages); values above the largest prefill bucket clamp to it.
+        prefill_chunk: int = 0,
+        # per-dispatch-cycle token budget the scheduler spends across
+        # {pending prefill chunks, decode block, draft verify}. 0 = auto:
+        # active_decoding_slots * decode_block_size + prefill_chunk *
+        # prefilling_slots (every mid-prefill slot advances one chunk per
+        # cycle while decode runs every cycle). The budget is a throttle on
+        # prefill aggressiveness, not a hard gate: decode always dispatches,
+        # and at least one chunk advances per cycle so neither side can
+        # starve the other. Only meaningful with prefill_chunk > 0.
+        token_budget: int = 0,
         # model-free speculative decoding (prompt lookup): per slot, an
         # n-gram drafter proposes up to spec_len tokens from earlier
         # occurrences in prompt + generated-so-far, and ONE batched verify
@@ -482,6 +514,7 @@ class Engine:
         # is verified against this, not assumed from submit timing)
         self._cont_batch_sizes: set[int] = set()
         self._spill_batch_sizes: set[int] = set()
+        self._chunk_batch_sizes: set[int] = set()  # KV-only chunk dispatches
         # plain prefill (bucket, B) pairs dispatched — each is its own
         # compiled program; prewarm's mid-batch phase verifies against this
         self._full_batch_shapes: set[tuple[int, int]] = set()
@@ -520,6 +553,19 @@ class Engine:
         self.table_uploads = 0  # paged: block-table host->device re-uploads
         self.max_queue = max(0, max_queue)
         self.preemptions = 0  # pool-pressure preempt-and-resume events
+        # chunked prefill + unified token-budget scheduler (see _dispatch_once
+        # / _prefill_chunks). Both knobs are plain mutable attributes read
+        # per admission/cycle so benches and tests can A/B them on one
+        # engine (the chunk loop reuses the continuation programs the
+        # legacy spill path already compiles).
+        self.prefill_chunk = max(0, int(prefill_chunk))
+        self.token_budget = max(0, int(token_budget))
+        self._prefilling_count = 0  # int mirror for cross-thread stats()
+        self.prefill_chunks = 0  # chunk dispatches (per-slot chunks)
+        self.hol_wait_s = 0.0  # decode-stall seconds attributable to prefill
+        self._budget_last = (0, 0)  # (budget, tokens spent) last cycle
+        self._budget_spent_total = 0
+        self._budget_total = 0
         # speculative decoding state/counters (see _decode_spec)
         self.spec_len = max(0, int(spec_len))
         self.spec_ngram = max(1, int(spec_ngram))
@@ -848,6 +894,7 @@ class Engine:
             self._init_kv_state()
             self._slots = {}
             self._parked_count = 0
+            self._prefilling_count = 0
             self._publish_park_gauge()
             self._free = list(range(self.max_slots))
             self._waiting.clear()
@@ -970,7 +1017,49 @@ class Engine:
         token table). Without this, the FIRST Task after startup pays
         20-40s of TPU compiles — fatal to the 500ms time-to-first-ToolCall
         target. Blocking; run from a background thread if startup latency
-        matters more than first-request latency."""
+        matters more than first-request latency.
+
+        Chunked-prefill engines run the legacy phases with chunking
+        TEMPORARILY OFF (the phases' shape verification assumes the
+        at-admission dispatch pattern; the continuation programs they
+        compile are shared with the chunk loop), then one chunked phase
+        warms the chunk-specific shapes."""
+        ch, self.prefill_chunk = self.prefill_chunk, 0
+        try:
+            self._prewarm_phases(constrained)
+        finally:
+            self.prefill_chunk = ch
+        if ch:
+            self._prewarm_chunked(constrained)
+        log.info("engine prewarm complete (constrained=%s)", constrained)
+
+    def _prewarm_chunked(self, constrained: bool) -> None:
+        """Warm the chunk loop's own shapes: multi-chunk prompts at every
+        power-of-two batch size compile the KV-only chunk dispatch at the
+        chunk bucket plus the final-chunk continuation buckets."""
+        K = self.decode_block_size
+        CHK = self._chunk_tokens()
+        long_len = min(self.max_ctx - K - 2, CHK * 2 + max(3, CHK // 2))
+        if long_len <= CHK:
+            return  # every admissible prompt fits one chunk: legacy shapes cover it
+        one = SamplingParams(temperature=0.0, max_tokens=1, json_only=constrained)
+        b = 1
+        while b <= min(self.prefill_batch_max, self.max_slots):
+            for _attempt in range(5):
+                with self.hold_admission():
+                    futs = [
+                        self.submit([1] * (long_len - i), one, _prewarm=True)
+                        for i in range(b)
+                    ]
+                for f in futs:
+                    f.result(timeout=1800)
+                if b in self._chunk_batch_sizes:
+                    break
+            else:
+                log.warning("prewarm: chunked batch B=%d never formed", b)
+            b *= 2
+
+    def _prewarm_phases(self, constrained: bool = False) -> None:
         # coverage (documented, not aspirational): per mode —
         #   (a) a full-width staggered burst at the largest bucket that
         #       leaves decode room: batched prefill at the max chunk size,
@@ -1130,7 +1219,6 @@ class Engine:
                     else:
                         log.warning("prewarm: spill batch B=%d never formed", b)
                     b *= 2
-        log.info("engine prewarm complete (constrained=%s)", constrained)
 
     def cancel(self, future: Future) -> None:
         """Abort the request behind a Future returned by :meth:`submit`.
@@ -1163,6 +1251,7 @@ class Engine:
             "max_ctx": self.max_ctx,
             "active_slots": self._n_active(),
             "parked_slots": self._parked_count,
+            "prefilling_slots": self._prefilling_count,
             "waiting": len(self._waiting),
             "max_queue": self.max_queue,
             "preemptions": self.preemptions,
@@ -1185,6 +1274,25 @@ class Engine:
                 "park_adoptions": self.park_adoptions,
                 "park_releases": self.park_releases,
                 "park_max_s": self.park_max_s,
+            },
+            # unified token-budget scheduler (chunked prefill); utilization
+            # is tokens dispatched / per-cycle budget — persistently low
+            # means the budget is oversized for the traffic, ~1.0 with
+            # waiting chunks means prefill is throttled by it
+            "scheduler": {
+                "chunked_prefill": self.prefill_chunk > 0,
+                "prefill_chunk": self.prefill_chunk,
+                "token_budget": self.token_budget,  # 0 = auto-sized
+                "prefill_chunks_total": self.prefill_chunks,
+                "hol_wait_seconds": round(self.hol_wait_s, 4),
+                "budget_utilization_last": (
+                    round(min(1.0, self._budget_last[1] / self._budget_last[0]), 4)
+                    if self._budget_last[0] else 0.0
+                ),
+                "budget_utilization_avg": (
+                    round(min(1.0, self._budget_spent_total / self._budget_total), 4)
+                    if self._budget_total else 0.0
+                ),
             },
             "spec": {
                 "enabled": self.spec_len > 0,
@@ -1243,7 +1351,7 @@ class Engine:
     def _run(self) -> None:
         try:
             while not self._stopping:
-                admitted = self._admit(block=not self._n_active())
+                admitted = self._admit(block=not self._has_work())
                 if self._stopping:
                     break
                 # after _admit, not before: the loop parks in _admit while
@@ -1254,14 +1362,15 @@ class Engine:
                 if self._faults.enabled and self._faults.pop("engine.crash") is not None:
                     raise RuntimeError("fault injection: engine crash")
                 self._sweep_parked()
-                if not self._n_active():
+                if not self._has_work():
                     if not admitted:
                         continue
-                self._decode_once()
+                self._dispatch_once()
         except Exception as e:  # an engine crash must not hang callers
             log.exception("engine loop crashed")
             self._slots.clear()
             self._parked_count = 0
+            self._prefilling_count = 0
             self._publish_park_gauge()
             self._stopping = True
             self._crashed = True  # restartable (see ensure_running)
@@ -1310,7 +1419,7 @@ class Engine:
         requests + cancel snapshot as a frame and followers replay it — every
         process then runs the identical pure admission logic and joins the
         identical global dispatches (see engine/coordination.py)."""
-        may_block = block and not self._waiting and not self._n_active()
+        may_block = block and not self._waiting and not self._has_work()
         if self._coord_follower:
             try:
                 frame = self._coordination.recv()
@@ -1429,7 +1538,7 @@ class Engine:
 
         self._expire_deadlines()
         if held:
-            if not self._n_active():
+            if not self._has_work():
                 # idle hold: don't busy-spin against the submitting thread
                 time.sleep(0.002)
             return False
@@ -1531,16 +1640,27 @@ class Engine:
                     self._slot_pages[slot] = pages
                     self._block_tables[slot, :] = TRASH_PAGE
                     self._block_tables[slot, : len(pages)] = pages
-            self._spill_long_chunks(enriched)
-            plain = [e for e in enriched if e[1] == 0]  # cheaper causal program
-            conts = [e for e in enriched if e[1] > 0]  # suffix continuation
-            for chunk in _pow2_chunks(plain, self.prefill_batch_max):
-                self._prefill_group([it for it, _ in chunk])
-            for chunk in _pow2_chunks(conts, self.prefill_batch_max):
-                self._prefill_group(
-                    [it for it, _ in chunk],
-                    starts_np=np.asarray([s for _, s in chunk], dtype=np.int32),
-                )
+            if self.prefill_chunk:
+                # chunked mode: admission only RESERVES (slot id + pages +
+                # prefix-cache start); all prefill compute happens one chunk
+                # per dispatch cycle in _prefill_chunks, interleaved with
+                # decode — a long prompt never stalls decoding slots for its
+                # whole prefill
+                for item, start in enriched:
+                    req, slot, _pages, _m = item
+                    self._begin_chunked_prefill(req, slot, start)
+                continue
+            with self._hol_clock():
+                self._spill_long_chunks(enriched)
+                plain = [e for e in enriched if e[1] == 0]  # cheaper causal program
+                conts = [e for e in enriched if e[1] > 0]  # suffix continuation
+                for chunk in _pow2_chunks(plain, self.prefill_batch_max):
+                    self._prefill_group([it for it, _ in chunk])
+                for chunk in _pow2_chunks(conts, self.prefill_batch_max):
+                    self._prefill_group(
+                        [it for it, _ in chunk],
+                        starts_np=np.asarray([s for _, s in chunk], dtype=np.int32),
+                    )
         return admitted
 
     def _spill_long_chunks(self, enriched: list[list]) -> None:
@@ -1610,6 +1730,317 @@ class Engine:
                     )
                 for e in batch:
                     e[1] += CH
+
+    # -- chunked prefill + unified token-budget scheduler -----------------
+
+    @contextlib.contextmanager
+    def _hol_clock(self):
+        """Attribute prefill wall time to head-of-line decode stall: while
+        any slot is actively DECODING, every second spent inside a prefill
+        dispatch is a second those slots' tokens arrive late. Wraps the
+        legacy at-admission prefill (the monolithic stall chunking removes)
+        and the chunked path's per-cycle chunk dispatches (the residual
+        stall that remains), so the same metric compares both modes."""
+        stalled = self._n_active() > 0
+        t0 = time.monotonic()
+        try:
+            yield
+        finally:
+            if stalled:
+                dt = time.monotonic() - t0
+                self.hol_wait_s += dt
+                REGISTRY.counter_add(
+                    "acp_engine_hol_wait_seconds", dt,
+                    help="seconds decoding slots were stalled behind "
+                    "prefill dispatches (head-of-line blocking)",
+                )
+
+    def _chunk_tokens(self) -> int:
+        """Effective chunk size: clamped to the largest prefill bucket
+        (each chunk is one continuation dispatch at a compiled bucket) and,
+        in paged mode, rounded UP to a page multiple — non-final chunks
+        commit whole pages, so every chunk boundary must be page-aligned.
+        prefill_chunk == 0 here means the knob was toggled off while slots
+        were still mid-prefill (_dispatch_once drains them through the
+        chunk loop regardless): drain at the largest bucket — collapsing
+        to 1-token chunks would break paged page alignment and crawl."""
+        ch = min(
+            self.prefill_chunk or self.prefill_buckets[-1],
+            self.prefill_buckets[-1],
+        )
+        if self.kv_layout == "paged":
+            ch = -(-ch // self.page_size) * self.page_size
+        return max(1, ch)
+
+    def _begin_chunked_prefill(self, req: _Request, slot: int, start: int) -> None:
+        """Admit a request as a PREFILLING slot: the slot id and (paged) KV
+        pages are reserved and the prefix-cache start resolved, but no model
+        compute has run — the unified scheduler advances it chunk by chunk.
+        ``start`` rows of KV are already valid (prefix-cache copy, shared
+        pages, or an adopted parked slot's resident prompt)."""
+        self._admit_seq += 1
+        sl = _Slot(
+            request=req,
+            prompt_len=len(req.prompt),
+            prefix_len=len(req.sampling.forced_prefix),
+            admit_seq=self._admit_seq,
+            prefilling=True,
+            prefill_pos=start,
+        )
+        sl.prefill_row = self._full_row(req)
+        self._slots[slot] = sl
+        self._prefilling_count += 1
+        self._seq_lens[slot] = start
+        self._last_tokens[slot] = 0
+        self._state_dirty = True  # the lane must upload as inactive
+
+    def _has_work(self) -> bool:
+        """Anything the dispatch loop must advance: decoding or mid-prefill
+        slots (parked slots are speculative capacity, not work)."""
+        return len(self._slots) - self._parked_count > 0
+
+    def _dispatch_once(self) -> None:
+        """One unified scheduler cycle. Chunked-off (or nothing mid-
+        prefill): exactly the legacy decode iteration. Chunked-on: spend the
+        per-cycle token budget across pending prefill chunks (deadline-
+        weighted order) and the decode/verify dispatch. Policy guarantees,
+        pinned by tests: decode dispatches EVERY cycle active slots exist
+        (never starved by pending chunks), and at least one chunk advances
+        per cycle (a tight budget throttles prefill, never deadlocks it)."""
+        if not self._prefilling_count:
+            # chunked off, or nothing mid-prefill: the legacy decode
+            # iteration. Keyed on _prefilling_count, not the knob: slots
+            # admitted as prefilling must drain through the chunk loop even
+            # if prefill_chunk was toggled off mid-flight (benches/tests
+            # A/B the knob on a live engine).
+            self._decode_once()
+            return
+        self._apply_cancels()
+        self._expire_prefilling()
+        n_active = self._n_active()
+        decode_reserve = n_active * self.decode_block_size
+        budget = self.token_budget or (
+            decode_reserve + self._chunk_tokens() * max(1, self._prefilling_count)
+        )
+        spent = self._prefill_chunks(max(0, budget - decode_reserve))
+        if self._n_active():
+            steps0 = self.decode_steps
+            self._decode_once()
+            if self.decode_steps > steps0:
+                # block path advances K steps, a verify dispatch 1 — count
+                # the dispatch's compute rows (estimate; utilization is an
+                # observability aid, not an accounting invariant)
+                spent += n_active * min(
+                    self.decode_steps - steps0, self.decode_block_size
+                )
+        self._budget_last = (budget, spent)
+        self._budget_spent_total += spent
+        self._budget_total += budget
+        REGISTRY.gauge_set(
+            "acp_engine_token_budget_utilization",
+            min(1.0, spent / budget) if budget else 0.0,
+            help="tokens dispatched last scheduler cycle / per-cycle token "
+            "budget (chunked prefill mode)",
+        )
+
+    def _apply_cancels(self) -> None:
+        """Free slots whose requests were cancelled (shared by the decode
+        path and the chunked scheduler — a cancelled mid-prefill slot must
+        release its partial KV before more chunks are spent on it)."""
+        if not self._applied_cancels:
+            return
+        for slot, sl in list(self._slots.items()):
+            if sl.request.rid in self._applied_cancels:
+                self._finish(slot, "cancelled")
+
+    def _expire_prefilling(self) -> None:
+        """Deadline expiry for mid-prefill slots: release the partial KV
+        and fail the request — spending more chunks on a dead deadline is
+        pure waste. Same coordination discipline as _expire_deadlines:
+        single-host releases in place; the leader resolves the future
+        host-locally and routes the release through the replicated cancel
+        stream; followers never expire on wall clock."""
+        if self._coord_follower:
+            return
+        now = time.monotonic()
+        expired = [
+            (s, sl) for s, sl in self._slots.items()
+            if sl.prefilling
+            and sl.request.deadline is not None
+            and now > sl.request.deadline
+            and not sl.request.future.done()
+        ]
+        for slot, sl in expired:
+            req = sl.request
+            req.future.set_exception(DeadlineExceededError(
+                "deadline expired mid-prefill (partial prompt KV released)"
+            ))
+            REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+            if self._coordination is not None:
+                self._cancelled.add(req.rid)  # rides the next published frame
+            else:
+                self._drop_prefilling_slot(slot)
+
+    def _drop_prefilling_slot(self, slot: int) -> _Slot:
+        """Release a mid-prefill slot's bookkeeping (partial KV pages, host
+        mirrors, slot id). The caller owns resolving/requeueing the
+        request."""
+        sl = self._slots.pop(slot)
+        self._prefilling_count -= 1
+        self._state_dirty = True
+        self._seq_lens[slot] = 0
+        self._last_tokens[slot] = 0
+        self._con_states[slot] = 0
+        self._constrained[slot] = False
+        heapq.heappush(self._free, slot)
+        if self.kv_layout == "paged":
+            self._allocator.free(self._slot_pages.pop(slot, []))
+            self._block_tables[slot, :] = TRASH_PAGE
+            self._tables_dirty = True
+        return sl
+
+    def _prefill_chunks(self, chunk_budget: int) -> int:
+        """One scheduler round of chunked prefill: give each mid-prefill
+        slot at most ONE chunk, in deadline-weighted order (earliest
+        deadline first, then admission order; under multi-host coordination
+        deadlines are leader-local wall clock, so ordering falls back to
+        admission order — the same lockstep rule as deadline expiry), until
+        the chunk budget is spent. The first chunk always dispatches even
+        over budget (minimum-progress guarantee). Non-final chunks write KV
+        only; a final chunk samples the slot's first token and flips it to
+        decoding via the shared _prefill_group path. Returns tokens spent."""
+        pre = [(s, sl) for s, sl in self._slots.items() if sl.prefilling]
+        if not pre:
+            return 0
+        if self._faults.enabled:
+            # deterministic mid-prefill preemption: lands on the PARTIALLY
+            # prefilled slot with the most progress (steps = total chunks
+            # dispatched, so after_steps=N lets N chunks land first)
+            spec = self._faults.pop(
+                "engine.preempt_mid_prefill", steps=self.prefill_chunks
+            )
+            if spec is not None:
+                victim = max(pre, key=lambda t: (t[1].prefill_pos, t[0]))[0]
+                self._preempt(victim)
+                pre = [(s, sl) for s, sl in self._slots.items() if sl.prefilling]
+                if not pre:
+                    return 0
+        if self._coordination is None:
+            pre.sort(key=lambda t: (
+                t[1].request.deadline
+                if t[1].request.deadline is not None else float("inf"),
+                t[1].admit_seq,
+            ))
+        else:
+            pre.sort(key=lambda t: t[1].admit_seq)
+        CHK = self._chunk_tokens()
+        sched: list[tuple[int, _Slot, int, int]] = []  # (slot, sl, start, n)
+        spent = 0
+        for slot, sl in pre:
+            n = min(CHK, len(sl.prefill_row) - sl.prefill_pos)
+            if sched and spent + n > chunk_budget:
+                break  # budget spent; later (EDF-ordered) slots wait a cycle
+            sched.append((slot, sl, sl.prefill_pos, n))
+            spent += n
+        mids = [c for c in sched if c[2] + c[3] < len(c[1].prefill_row)]
+        finals = [c for c in sched if c[2] + c[3] >= len(c[1].prefill_row)]
+        with self._hol_clock():
+            for batch in _pow2_chunks(mids, self.prefill_batch_max):
+                self._chunk_dispatch(batch)
+            # finals whose whole row fits one chunk (start 0) take the plain
+            # causal program — byte-for-byte the chunked-off dispatch; only
+            # true continuations need the offset program
+            plain = [c for c in finals if c[2] == 0]
+            conts = [c for c in finals if c[2] > 0]
+            paged = self.kv_layout == "paged"
+
+            def items(batch):
+                return [
+                    (sl.request, slot,
+                     self._slot_pages.get(slot) if paged else None, None)
+                    for slot, sl, _st, _n in batch
+                ]
+
+            for batch in _pow2_chunks(plain, self.prefill_batch_max):
+                self._prefill_group(items(batch))
+            for batch in _pow2_chunks(conts, self.prefill_batch_max):
+                self._prefill_group(
+                    items(batch),
+                    starts_np=np.asarray([st for _, _, st, _ in batch], dtype=np.int32),
+                )
+        for slot, sl, st, n in mids:
+            sl.prefill_pos = st + n
+            self._seq_lens[slot] = sl.prefill_pos
+        self.prefill_chunks += len(sched)
+        REGISTRY.counter_add(
+            "acp_engine_prefill_chunks_total", float(len(sched)),
+            help="prefill chunk dispatches (per-slot chunks) under the "
+            "unified token-budget scheduler",
+        )
+        return spent
+
+    def _chunk_dispatch(self, batch: list[tuple[int, "_Slot", int, int]]) -> None:
+        """One batched KV-only chunk dispatch (the per-cycle analogue of
+        _spill_long_chunks' rounds): each row runs tokens [start, start+n)
+        of its slot's prefill row through the continuation program, writing
+        KV without sampling. Rows may have different lengths (final-size
+        remainders never land here, but budget clipping is caller policy)."""
+        B = len(batch)
+        self._chunk_batch_sizes.add(B)
+        bucket = _next_bucket(max(n for _, _, _, n in batch), self.prefill_buckets)
+        toks = np.zeros((B, bucket), dtype=np.int32)
+        lengths = np.zeros(B, dtype=np.int32)
+        starts = np.zeros(B, dtype=np.int32)
+        slots = np.zeros(B, dtype=np.int32)
+        for i, (slot, sl, st, n) in enumerate(batch):
+            toks[i, :n] = sl.prefill_row[st : st + n]
+            lengths[i] = n
+            starts[i] = st
+            slots[i] = slot
+        self._rng, step_rng = jax.random.split(self._rng)
+        tail = (
+            step_rng,
+            self._put(np.zeros(B, dtype=np.float32)),  # temps (sample unused)
+            self._put(np.zeros(B, dtype=np.int32)),
+            self._put(np.ones(B, dtype=np.float32)),
+            self._dummy_table,
+            self._put(np.zeros(B, dtype=np.int32)),
+            self._put(np.zeros(B, dtype=bool)),  # unconstrained
+            self._dummy_min_close,
+            self._put(np.ones(B, dtype=np.int32)),
+        )
+        if self.kv_layout == "paged":
+            P = self.page_size
+            page_ids = np.full((B, bucket // P), TRASH_PAGE, dtype=np.int32)
+            for i, (slot, _sl, st, n) in enumerate(batch):
+                # chunk boundaries are page-aligned (see _chunk_tokens), so
+                # the commit's whole-page writes touch exactly this chunk's
+                # fresh pages — never a page holding earlier KV
+                sub = self._slot_pages[slot][st // P : -(-(st + n) // P)]
+                page_ids[i, : len(sub)] = sub
+            block_tables = self._put(
+                self._block_tables[[slot for slot, _, _, _ in batch]]
+            )
+            self.cache, _tok, _state = self._jit_prefill_paged_continue(
+                self.params,
+                self.cache,
+                self._put(toks),
+                self._put(lengths),
+                self._put(starts),
+                self._put(page_ids),
+                block_tables,
+                *tail,
+            )
+        else:
+            self.cache, _tok, _state = self._jit_prefill_continue(
+                self.params,
+                self.cache,
+                self._put(toks),
+                self._put(lengths),
+                self._put(starts),
+                self._put(slots),
+                *tail,
+            )
 
     # -- prefix KV cache (slot layout) -----------------------------------
 
@@ -2014,13 +2445,23 @@ class Engine:
                     "acp_engine_ttft_seconds", now - req.enqueued,
                     help="time to first token",
                 )
-            self._admit_seq += 1
+            prior = self._slots.get(slot)
+            if prior is not None and prior.prefilling:
+                # chunked prefill's FINAL chunk: the slot existed mid-prefill
+                # (same request); it flips to decoding here, keeping its
+                # admission stamp so victim-policy recency is admission
+                # order, not final-chunk order
+                self._prefilling_count -= 1
+                admit_seq = prior.admit_seq
+            else:
+                self._admit_seq += 1
+                admit_seq = self._admit_seq
             sl = _Slot(
                 request=req,
                 prompt_len=len(req.prompt),
                 prefix_len=len(s.forced_prefix),
                 first_token_at=req.first_token_at,
-                admit_seq=self._admit_seq,
+                admit_seq=admit_seq,
             )
             if self.spec_len:
                 from .spec import SpecState
@@ -2072,8 +2513,11 @@ class Engine:
         for slot in list(self._slots):
             if slot not in self._slots:
                 continue  # preempted as a victim for an earlier slot
-            if self._slots[slot].parked:
-                continue  # parked slots never decode; no coverage needed
+            if self._slots[slot].parked or self._slots[slot].prefilling:
+                # parked slots never decode; mid-prefill slots reserved
+                # their whole row's pages at admission — neither needs
+                # decode-block coverage
+                continue
             need = K if need_tokens is None else need_tokens.get(slot, K)
             needed = -(-(int(self._seq_lens[slot]) + need) // self.page_size)
             # ctx edge: the decode block deactivates the slot on device at
@@ -2145,6 +2589,13 @@ class Engine:
                 continue
             if self._slots[slot].parked:
                 continue  # already trimmed to its park cut; nothing spare
+            if self._slots[slot].prefilling:
+                # a mid-prefill slot's "spare" pages are the reservation its
+                # upcoming chunks write into — trimming them would tear the
+                # admission-time all-pages-reserved invariant (the chunk
+                # loop never allocates). Pressure takes the whole slot via
+                # _pick_victim instead.
+                continue
             need = K if need_tokens is None else max(K, need_tokens.get(slot, K))
             strict = min(
                 -(-(int(self._seq_lens[slot]) + need) // self.page_size),
@@ -2194,7 +2645,9 @@ class Engine:
         fewest sampled tokens first (least work lost / cheapest resume
         prefill), ties broken by MOST recently admitted (LIFO — the oldest
         requests keep their progress, mirroring the front-of-queue resume
-        order so the engine converges instead of thrashing)."""
+        order so the engine converges instead of thrashing). Mid-prefill
+        slots have sampled nothing, so they sort first among non-parked
+        slots — preempting one loses only chunk compute, never tokens."""
         if not self._slots:
             return None
         # parked slots volunteer first (oldest park): their generation is
@@ -2224,6 +2677,12 @@ class Engine:
             self._release_parked(slot)
             return
         sl = self._slots.pop(slot)
+        if sl.prefilling:
+            # mid-prefill victim: no sampled tokens to save — the partial
+            # prompt KV is released with the pages and the request re-enters
+            # the chunk loop from its (fresh) prefix-cache start on
+            # re-admission. Byte-identical: nothing was sampled yet.
+            self._prefilling_count -= 1
         req = sl.request
         req.resume_tokens = list(sl.generated[sl.prefix_len:])
         req.preempt_count += 1
@@ -2296,10 +2755,7 @@ class Engine:
         self._tables_dirty = True
 
     def _decode_once(self) -> None:
-        if self._applied_cancels:
-            for slot, sl in list(self._slots.items()):
-                if sl.request.rid in self._applied_cancels:
-                    self._finish(slot, "cancelled")
+        self._apply_cancels()
         if not self._n_active():
             return
         if self._faults.enabled:
@@ -2336,11 +2792,14 @@ class Engine:
             # stays compacted) — one live request doesn't pay max_slots of
             # compute. Width is recomputed only on dirty blocks; finishes
             # mark dirty, so the decay through narrower widths is preserved.
-            max_active = max(s for s, sl in self._slots.items() if not sl.parked) + 1
+            max_active = max(
+                s for s, sl in self._slots.items()
+                if not sl.parked and not sl.prefilling
+            ) + 1
             W = next(w for w in self.width_buckets if w >= max_active)
             active_mask = np.zeros(W, dtype=bool)
             for slot, sl in self._slots.items():
-                if not sl.parked:
+                if not sl.parked and not sl.prefilling and slot < W:
                     active_mask[slot] = True
             self._rng, step_rng = jax.random.split(self._rng)
             # once the token table exists it is passed unconditionally
@@ -2350,7 +2809,7 @@ class Engine:
             # transfer cost
             use_real = self._token_table is not None
             for slot, sl in self._slots.items():
-                if not sl.parked:
+                if not sl.parked and not sl.prefilling:
                     self._budgets[slot] = self._slot_budget(slot, sl)
             self._dev = {
                 "W": W,
@@ -2403,8 +2862,8 @@ class Engine:
         K = tok_block.shape[0]
         self.decode_steps += K
         for slot, sl in list(self._slots.items()):
-            if sl.parked:
-                continue  # parked lanes were not in this dispatch
+            if sl.parked or sl.prefilling:
+                continue  # parked/mid-prefill lanes were not in this dispatch
             self._consume_tokens(slot, sl, (int(tok_block[k, slot]) for k in range(K)))
         self._publish_decode_gauges()
 
@@ -2507,6 +2966,12 @@ class Engine:
             self._preempted_waiting(),
             help="preempted requests requeued and awaiting resume",
         )
+        REGISTRY.gauge_set(
+            "acp_engine_prefilling_slots",
+            float(self._prefilling_count),
+            help="slots admitted but still mid-prefill under the chunked "
+            "token-budget scheduler",
+        )
 
     def _slot_budget(self, slot: int, sl: _Slot) -> int:
         """Sampled tokens this slot may still emit — min of its remaining
@@ -2569,7 +3034,7 @@ class Engine:
         budgets_eff: dict[int, int] = {}
         any_draft = False
         for slot, sl in self._slots.items():
-            if sl.parked:
+            if sl.parked or sl.prefilling:
                 continue
             budget = self._slot_budget(slot, sl)
             budgets_eff[slot] = budget
@@ -2601,16 +3066,28 @@ class Engine:
         )
         W = next(
             w for w in self.width_buckets
-            if w >= max(s for s, sl in self._slots.items() if not sl.parked) + 1
+            if w >= max(
+                s for s, sl in self._slots.items()
+                if not sl.parked and not sl.prefilling
+            ) + 1
         )
         inputs = np.zeros((W, T), dtype=np.int32)
-        n_input = np.ones(W, dtype=np.int32)
-        starts = np.zeros(W, dtype=np.int32)
+        # lanes NOT in this dispatch (free, parked, mid-prefill) must write
+        # their optimistic K/V somewhere HARMLESS: n_input=0 sends every
+        # paged write to the trash page (token_write_targets masks by
+        # length), and starts=max_ctx clamps the slot layout's scatter to
+        # row max_ctx-1, which attention can never read (a lane deactivates
+        # at max_ctx-1). The old defaults (n_input=1, starts=0) scattered
+        # one garbage row into position 0 of the lane's LIVE KV — harmless
+        # for free lanes (the next prefill overwrites from 0) but corrupting
+        # for parked prompt KV awaiting adoption and for mid-prefill slots.
+        n_input = np.zeros(W, dtype=np.int32)
+        starts = np.full(W, self.max_ctx, dtype=np.int32)
         active = np.zeros(W, dtype=bool)
         budgets = np.zeros(W, dtype=np.int32)
         proposed = np.zeros(W, dtype=np.int32)
         for slot, sl in self._slots.items():
-            if sl.parked:
+            if sl.parked or sl.prefilling:
                 continue
             d = drafts.get(slot, [])
             inputs[slot, 0] = self._last_tokens[slot]
@@ -2652,7 +3129,7 @@ class Engine:
         self.spec_dispatches += 1
         self._state_dirty = True  # host mirrors advanced; next block re-uploads
         for slot, sl in list(self._slots.items()):
-            if sl.parked:
+            if sl.parked or sl.prefilling:
                 continue
             n = int(n_emit[slot])
             prop = int(proposed[slot])
@@ -2699,6 +3176,21 @@ class Engine:
             # the future resolved when the slot parked; a finish now is a
             # cancel/stop/drain — release the lingering bookkeeping
             self._release_parked(slot)
+            return
+        if sl.prefilling:
+            # a finish can only reach a mid-prefill slot via cancel, a
+            # replicated deadline release, or shutdown drain — nothing was
+            # sampled, so there is no result to resolve: release the
+            # partial KV and fail like a never-admitted request
+            self._drop_prefilling_slot(slot)
+            req = sl.request
+            self._cancelled.discard(req.rid)
+            self._applied_cancels.discard(req.rid)
+            if not req.future.done():
+                if reason == "cancelled":
+                    req.future.cancel()
+                else:
+                    req.future.set_exception(RuntimeError("engine stopped"))
             return
         req = sl.request
         if reason in ("stop", "length"):
@@ -2935,7 +3427,10 @@ class Engine:
         return [(req, slot, pages, (None, {"cut": cut, "in_slot": True}))]
 
     def _n_active(self) -> int:
-        return len(self._slots) - self._parked_count
+        """Slots actively DECODING — parked slots linger without work and
+        mid-prefill slots haven't sampled yet (see _has_work for the
+        loop-level any-work predicate)."""
+        return len(self._slots) - self._parked_count - self._prefilling_count
 
     def _has_parked(self) -> bool:
         return self._parked_count > 0

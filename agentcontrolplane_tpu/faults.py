@@ -15,6 +15,13 @@ Engine sites (see ``engine/engine.py``):
   were at its cap (503 end to end).
 - ``engine.force_preempt`` — preempt the policy victim at the first decode
   block where ``decode_steps >= after_steps``.
+- ``engine.preempt_mid_prefill`` — force preemption to land on a
+  PARTIALLY-PREFILLED slot (chunked prefill): at the first scheduler round
+  where ``prefill_chunks >= after_steps``, the mid-prefill slot with the
+  most chunk progress is preempted — its partial prompt KV is released and
+  the request re-enters the chunk loop on re-admission (byte-identical;
+  nothing was sampled). Arm with ``after_steps=N`` to let N chunks land
+  first. Fires only while some slot is mid-prefill.
 - ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
   (released when disarmed/reset), shrinking the pool mid-serve.
 - ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:

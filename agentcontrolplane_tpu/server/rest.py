@@ -1201,6 +1201,19 @@ class RestServer:
                     help="mean tokens committed per decode model step "
                     "(> 1 means speculative decoding is paying)",
                 )
+                REGISTRY.gauge_set(
+                    "acp_engine_prefilling_slots",
+                    float(s.get("prefilling_slots", 0)),
+                    help="slots admitted but still mid-prefill under the "
+                    "chunked token-budget scheduler",
+                )
+                sched = s.get("scheduler", {})
+                REGISTRY.gauge_set(
+                    "acp_engine_token_budget_utilization",
+                    float(sched.get("budget_utilization_last", 0.0)),
+                    help="tokens dispatched last scheduler cycle / "
+                    "per-cycle token budget (chunked prefill mode)",
+                )
             except Exception:
                 pass  # a crashed engine must not take /metrics down
 

@@ -490,6 +490,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["ttft_first_toolcall_ms"] = val
             elif key == "tool_turn" and "tool_turn" not in doc:
                 doc["tool_turn"] = val
+            elif key == "hol" and "hol" not in doc:
+                doc["hol"] = val
             else:
                 return
             _flush_doc(doc)
@@ -502,6 +504,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
     ]
     if os.environ.get("ACP_BENCH_TOOL_TURN", "0") == "1":
         main_schedule.append(("RESULT tool_turn", 600))
+    if os.environ.get("ACP_BENCH_HOL", "0") == "1":
+        main_schedule.append(("RESULT hol", 900))
     if ttft_on:
         main_schedule.append(("RESULT ttft", ttft_timeout))
 
@@ -822,7 +826,11 @@ def _child(args: argparse.Namespace) -> None:
             drain_deadline = time.monotonic() + 120
             while time.monotonic() < drain_deadline:
                 s = engine.stats()
-                if s["active_slots"] == 0 and s["waiting"] == 0:
+                if (
+                    s["active_slots"] == 0
+                    and s["waiting"] == 0
+                    and s.get("prefilling_slots", 0) == 0
+                ):
                     break
                 time.sleep(0.2)
         return (total / elapsed) / max(n_chips, 1), total, elapsed, done
@@ -885,6 +893,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("tool_turn", _bench_tool_turn(engine))
         except Exception as e:  # the fixture must not lose the headline
             _result("tool_turn", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_HOL", "0") == "1"
+    ):
+        try:
+            _result("hol", _bench_hol())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("hol", {"error": str(e)})
 
     if ttft_on or args.only_ttft:
         try:
@@ -968,6 +985,136 @@ def _bench_tool_turn(engine) -> dict:
             "generated text byte-identical"
         ),
     }
+
+
+def _bench_hol() -> dict:
+    """Head-of-line-blocking fixture (chunked prefill): one long prompt is
+    admitted while N short slots decode. Chunked OFF reproduces the
+    monolithic at-admission prefill — every decoding slot stalls for the
+    whole prefill; chunked ON co-schedules prefill chunks with decode
+    blocks under the unified token budget, so each stall is one chunk
+    long. Reported per leg: the decoders' inter-commit decode-stall
+    p50/p99 and the latecomer's time-to-first-token. Generated tokens must
+    be byte-identical between the legs (chunking moves WHEN prompt KV is
+    written, never what is sampled).
+
+    Builds its own tiny-config engine so the ~4k-token prefill is
+    CPU-tractable; both legs share it (``prefill_chunk`` is a mutable
+    knob, and the chunk loop dispatches the same continuation shapes the
+    legacy spill path compiles — no cold compiles inside a measured leg
+    after the warm pass). Knobs: ACP_BENCH_HOL_PROMPT (default 4096),
+    ACP_BENCH_HOL_DECODERS (8), ACP_BENCH_HOL_CHUNK (256),
+    ACP_BENCH_HOL_TAIL_TOKENS (per-decoder budget, default 96),
+    ACP_BENCH_HOL_KV_LAYOUT (slot)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+
+    plen = int(os.environ.get("ACP_BENCH_HOL_PROMPT", "4096"))
+    n_dec = int(os.environ.get("ACP_BENCH_HOL_DECODERS", "8"))
+    chunk = int(os.environ.get("ACP_BENCH_HOL_CHUNK", "256"))
+    dec_budget = int(os.environ.get("ACP_BENCH_HOL_TAIL_TOKENS", "96"))
+    kv_layout = os.environ.get("ACP_BENCH_HOL_KV_LAYOUT", "slot")
+    max_ctx = plen + 2 * chunk
+    cfg = dataclasses.replace(PRESETS["tiny"], max_seq_len=max_ctx, vocab_size=512)
+    engine = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        max_slots=n_dec + 1,
+        max_ctx=max_ctx,
+        prefill_buckets=(64, chunk),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        page_size=16,
+        # the cache would let leg 2 skip the long prefill leg 1 measured
+        prefix_cache_entries=0,
+    )
+    engine.start()
+    try:
+        long_prompt = [1 + (i % 250) for i in range(plen)]
+        shorts = [[2 + ((i + j) % 200) for j in range(48)] for i in range(n_dec)]
+        dec_sp = SamplingParams(temperature=0.0, max_tokens=dec_budget)
+        one = SamplingParams(temperature=0.0, max_tokens=4)
+
+        # warm: compiles every shape both legs hit (short-burst prefill,
+        # all decay widths, the chunk/spill continuation at the chunk
+        # bucket, the long final) — stalls measured below are serving, not
+        # compiles
+        warm = [
+            engine.submit(list(s), SamplingParams(temperature=0.0, max_tokens=5))
+            for s in shorts
+        ]
+        warm.append(engine.submit(list(long_prompt), one))
+        for f in warm:
+            f.result(timeout=1800)
+
+        def leg(chunk_on: bool) -> dict:
+            engine.prefill_chunk = chunk if chunk_on else 0
+            arrivals: list[list[float]] = [[] for _ in range(n_dec)]
+            futs = [
+                engine.submit(
+                    list(shorts[i]), dec_sp,
+                    on_tokens=(
+                        lambda toks, a=arrivals[i]: a.append(time.monotonic())
+                    ),
+                )
+                for i in range(n_dec)
+            ]
+            deadline = time.monotonic() + 300
+            while any(not a for a in arrivals) and time.monotonic() < deadline:
+                time.sleep(0.002)  # all decoders streaming before the latecomer
+            t_sub = time.monotonic()
+            r_long = engine.submit(list(long_prompt), one).result(timeout=1800)
+            dec_results = [f.result(timeout=1800) for f in futs]
+            # stall percentiles over ONLY the gaps overlapping the
+            # latecomer's submit -> first-token window (its prefill) —
+            # pre-latecomer and post-prefill gaps are ordinary decode
+            # cadence and would dilute the p50 toward "no stall"
+            t_first = t_sub + r_long.ttft_ms / 1e3
+            gaps = sorted(
+                b - a
+                for arr in arrivals
+                for a, b in zip(arr, arr[1:])
+                if b > t_sub and a < t_first
+            )
+            pick = lambda q: (
+                gaps[min(len(gaps) - 1, int(q * len(gaps)))] if gaps else 0.0
+            )
+            return {
+                "stall_p50_ms": round(pick(0.50) * 1e3, 1),
+                "stall_p99_ms": round(pick(0.99) * 1e3, 1),
+                "latecomer_ttft_ms": round(r_long.ttft_ms, 1),
+                "tokens": [r.tokens for r in dec_results] + [r_long.tokens],
+            }
+
+        off = leg(chunk_on=False)
+        on = leg(chunk_on=True)
+        identical = on.pop("tokens") == off.pop("tokens")
+        reduction = (
+            round(off["stall_p99_ms"] / on["stall_p99_ms"], 2)
+            if on["stall_p99_ms"] > 0 else 0.0
+        )
+        return {
+            "prompt_tokens": plen,
+            "decoders": n_dec,
+            "chunk": chunk,
+            "kv_layout": kv_layout,
+            "chunked_off": off,
+            "chunked_on": on,
+            "stall_p99_reduction_x": reduction,
+            "byte_identical": identical,
+            "note": (
+                f"{plen}-token latecomer vs {n_dec} decoders: decode-stall "
+                f"p99 {off['stall_p99_ms']:.0f}ms chunked-off -> "
+                f"{on['stall_p99_ms']:.0f}ms chunked-on ({reduction}x); "
+                f"latecomer TTFT {off['latecomer_ttft_ms']:.0f}ms -> "
+                f"{on['latecomer_ttft_ms']:.0f}ms; byte-identical={identical}"
+            ),
+        }
+    finally:
+        engine.stop()
 
 
 def _bench_ttft(engine) -> dict:

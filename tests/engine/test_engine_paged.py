@@ -142,7 +142,9 @@ def test_pass1_reclaims_other_slots_lookahead_pages():
         strict0 = -(-(16 + K) // eng.page_size)  # slot 0's strict need
         import types
 
-        eng._slots[0] = types.SimpleNamespace(parked=False)  # white-box stub
+        eng._slots[0] = types.SimpleNamespace(  # white-box stub
+            parked=False, prefilling=False
+        )
         eng._seq_lens[0] = 16
         # hand slot 0 its strict pages plus the rest of the pool as lookahead
         table = eng._allocator.alloc(strict0)
