@@ -3,8 +3,8 @@
 
 PY ?= python
 
-.PHONY: test test-unit test-e2e test-stress bench run run-multi lint dryrun ci \
-	docker-build docker-run observability-up observability-down
+.PHONY: test test-unit test-e2e test-stress bench run run-multi lint lint-acp \
+	dryrun ci docker-build docker-run observability-up observability-down
 
 IMG ?= acp-tpu:dev
 JAX_EXTRA ?=
@@ -57,4 +57,7 @@ lint:
 		$(PY) -m compileall -q agentcontrolplane_tpu tests bench.py; \
 	fi
 
-ci: lint test dryrun
+lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness contracts
+	$(PY) -m agentcontrolplane_tpu.analysis agentcontrolplane_tpu tests bench.py
+
+ci: lint lint-acp test dryrun

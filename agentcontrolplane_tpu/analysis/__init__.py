@@ -1,0 +1,18 @@
+"""acplint: repo-custom static analysis for the engine's correctness
+contracts.
+
+Usage::
+
+    python -m agentcontrolplane_tpu.analysis            # lint the package
+    python -m agentcontrolplane_tpu.analysis tests/     # any tree
+    python -m agentcontrolplane_tpu.analysis --rule jit-purity path/
+
+Each pass encodes a rule extracted from a real shipped bug (the catalogue,
+with the motivating PRs and the suppression pragma, lives in
+docs/debugging-guide.md "Static analysis & invariant mode"). The package is
+stdlib-only so a bare CI checkout can run it without installing jax.
+"""
+
+from .core import LintPass, SourceFile, Violation, analyze
+
+__all__ = ["LintPass", "SourceFile", "Violation", "analyze"]

@@ -1,0 +1,55 @@
+"""``python -m agentcontrolplane_tpu.analysis`` — the acplint runner.
+
+Exit status: 0 when every pass is clean over the target tree, 1 when any
+violation survives suppression (CI gate; see ``make lint-acp``).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+from typing import Optional, Sequence
+
+from .core import analyze
+from .passes import RULES
+
+_PACKAGE_ROOT = Path(__file__).resolve().parent.parent
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m agentcontrolplane_tpu.analysis",
+        description="repo-custom static analysis (acplint)",
+    )
+    ap.add_argument(
+        "paths",
+        nargs="*",
+        help="files/directories to lint (default: the installed package)",
+    )
+    ap.add_argument(
+        "--rule",
+        action="append",
+        choices=RULES,
+        help="run only this rule (repeatable; default: all)",
+    )
+    ap.add_argument(
+        "-q", "--quiet", action="store_true", help="suppress the summary line"
+    )
+    args = ap.parse_args(argv)
+    paths = args.paths or [str(_PACKAGE_ROOT)]
+    violations = analyze(paths, rules=args.rule)
+    for v in violations:
+        print(v)
+    if not args.quiet:
+        names = ", ".join(args.rule) if args.rule else "all rules"
+        print(
+            f"acplint: {len(violations)} violation(s) over "
+            f"{', '.join(paths)} ({names})",
+            file=sys.stderr,
+        )
+    return 1 if violations else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,211 @@
+"""acplint core: source loading, marker/pragma parsing, the pass protocol.
+
+The pass pack (``analysis/passes/``) encodes this repo's load-bearing
+correctness contracts as machine-checked rules — each one extracted from a
+real shipped bug (see docs/debugging-guide.md "Static analysis & invariant
+mode" for the catalogue). This module is deliberately **stdlib-only** (ast +
+tokenize): the lint must run in a bare CI checkout with no jax installed.
+
+Declarations ride in comments so the contract lives next to the code it
+covers:
+
+- ``# acp: mirror`` — on an attribute assignment: this attribute is a
+  cross-thread-readable mirror (plain int/tuple replaced atomically, or an
+  immutable post-``__init__`` snapshot). The thread-ownership pass lets
+  declared cross-thread readers touch ONLY these.
+- ``# acp: cross-thread`` — on a ``def``: this function runs on non-engine
+  threads (stats()/scrape paths) and is held to the mirror registry.
+- ``# acp: leader-local`` — on a ``def``: this function makes wall-clock
+  scheduling decisions; it must carry the ``_coord_follower`` early-return
+  guard so followers never fork lockstep on local clocks.
+- ``# acp: dispatch-lanes a,b,c`` — on a ``def``: this function builds a
+  batched dispatch; every named lane buffer must be created with an
+  explicit-default constructor (``np.zeros``/``np.ones``/``np.full``).
+- ``# acp: budget-seam`` — on a ``def``: token-budget arithmetic is allowed
+  here (and nowhere else in the class).
+
+Suppression: a trailing ``# acp-lint: disable=<rule>[,<rule>...]`` on the
+flagged line silences that rule there. Every suppression should carry a
+justifying comment — the pragma is an auditable claim that the rule's
+assumption doesn't apply, not an escape hatch.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Iterable, Iterator, Optional
+
+MARKER_RE = re.compile(r"#\s*acp:\s*([\w-]+)\s*(.*)$")
+DISABLE_RE = re.compile(r"#\s*acp-lint:\s*disable=([\w,\s-]+)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str
+    line: int
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+class SourceFile:
+    """One parsed module: AST + a comment index for marker/pragma lookup."""
+
+    def __init__(self, path: str | Path, text: str, relpath: str = ""):
+        self.path = str(path)
+        # package-relative posix path ("engine/engine.py") for scope checks
+        self.relpath = (relpath or self.path).replace("\\", "/")
+        self.text = text
+        self.tree = ast.parse(text, filename=self.path)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    prev = self.comments.get(tok.start[0], "")
+                    self.comments[tok.start[0]] = (prev + " " + tok.string).strip()
+        except (tokenize.TokenError, IndentationError):
+            pass  # ast.parse succeeded; comment index is best-effort
+
+    # -- markers ---------------------------------------------------------
+
+    def markers_on(self, first: int, last: Optional[int] = None) -> dict[str, str]:
+        """``{marker-name: arg-string}`` for comments on lines [first, last]."""
+        out: dict[str, str] = {}
+        for line in range(first, (last or first) + 1):
+            comment = self.comments.get(line)
+            if not comment:
+                continue
+            m = MARKER_RE.search(comment)
+            if m:
+                out[m.group(1)] = m.group(2).strip()
+        return out
+
+    def _sig_region(self, fn: ast.AST) -> tuple[int, int]:
+        """The marker-bearing region of a def: the ``def`` line through the
+        line before the first body statement (markers sit on the signature,
+        including after a multi-line argument list's closing paren)."""
+        first = fn.lineno
+        last = max(first, fn.body[0].lineno - 1)
+        return first, last
+
+    def func_marker(self, fn: ast.AST, name: str) -> Optional[str]:
+        """The marker's argument string ('' for bare markers), or None."""
+        return self.markers_on(*self._sig_region(fn)).get(name)
+
+    def node_marker(self, node: ast.AST, name: str) -> Optional[str]:
+        """Marker on any line a (possibly multi-line) statement spans."""
+        return self.markers_on(
+            node.lineno, getattr(node, "end_lineno", node.lineno)
+        ).get(name)
+
+    # -- suppression -----------------------------------------------------
+
+    def disabled_rules(self, line: int) -> set[str]:
+        comment = self.comments.get(line)
+        if not comment:
+            return set()
+        m = DISABLE_RE.search(comment)
+        if not m:
+            return set()
+        return {r.strip() for r in m.group(1).split(",") if r.strip()}
+
+
+class LintPass:
+    """Base pass: subclasses set ``name`` and implement ``run``."""
+
+    name = "base"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:  # pragma: no cover
+        raise NotImplementedError
+
+    def violation(self, sf: SourceFile, node: ast.AST, message: str) -> Violation:
+        return Violation(self.name, sf.relpath, node.lineno, message)
+
+
+# -- helpers shared by passes ------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'time.monotonic' for ``time.monotonic`` / 'np.random.rand' for the
+    chained form; None when the chain doesn't root in a plain Name."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def is_self_attr(node: ast.AST) -> Optional[str]:
+    """The attribute name when ``node`` is ``self.<attr>``, else None."""
+    if (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.value, ast.Name)
+        and node.value.id == "self"
+    ):
+        return node.attr
+    return None
+
+
+# -- runner ------------------------------------------------------------------
+
+_SKIP_DIRS = {"__pycache__", ".git", ".venv", "node_modules"}
+
+
+def iter_py_files(paths: Iterable[str | Path]) -> Iterator[tuple[Path, str]]:
+    """(file, root-relative posix path) pairs, sorted for stable output."""
+    for p in paths:
+        p = Path(p)
+        if p.is_file():
+            # keep the FULL path as the scope key: path-scoped rules
+            # (server/, models/, ops/) must still bind when a file is
+            # linted directly, not just via its package directory
+            yield p, p.as_posix()
+            continue
+        for f in sorted(p.rglob("*.py")):
+            if any(part in _SKIP_DIRS for part in f.parts):
+                continue
+            yield f, f.relative_to(p).as_posix()
+
+
+def analyze(
+    paths: Iterable[str | Path], rules: Optional[Iterable[str]] = None
+) -> list[Violation]:
+    """Run the pass pack over files/directories; returns live (unsuppressed)
+    violations sorted by location. A file that fails to parse is itself a
+    violation (rule ``parse-error``) rather than a crash — the linter must
+    survive fixture trees."""
+    from .passes import ALL_PASSES
+
+    wanted = set(rules) if rules is not None else None
+    passes = [p for p in ALL_PASSES if wanted is None or p.name in wanted]
+    out: list[Violation] = []
+    paths = list(paths)
+    for p in paths:
+        if not Path(p).exists():
+            # a gate that silently lints nothing is no gate: a renamed
+            # target or Makefile/CI path typo must fail loudly
+            out.append(
+                Violation("missing-path", str(p), 1, "path does not exist")
+            )
+    for path, rel in iter_py_files(paths):
+        try:
+            text = path.read_text(encoding="utf-8")
+            sf = SourceFile(path, text, relpath=rel)
+        except (SyntaxError, UnicodeDecodeError) as e:
+            out.append(Violation("parse-error", rel, getattr(e, "lineno", 1) or 1, str(e)))
+            continue
+        for p in passes:
+            for v in p.run(sf):
+                if v.rule not in sf.disabled_rules(v.line):
+                    out.append(v)
+    return sorted(out, key=lambda v: (v.path, v.line, v.rule))

@@ -1,0 +1,36 @@
+"""The acplint pass pack: one pass per shipped-bug class.
+
+| rule             | contract                                         | origin |
+|------------------|--------------------------------------------------|--------|
+| thread-ownership | engine-private state is engine-thread-only       | PR 6   |
+| lane-defaults    | batched dispatches default every absent lane     | PR 7   |
+| jit-purity       | no host clock/RNG/global in traced/forward code  | PR 4   |
+| coord-wallclock  | wall-clock decisions are leader-local            | PR 4/7 |
+| budget-sharing   | token budgets computed only in the declared seam | PR 5   |
+"""
+
+from .budget_seam import BudgetSeamPass
+from .coord_wallclock import CoordWallclockPass
+from .jit_purity import JitPurityPass
+from .lane_defaults import LaneDefaultsPass
+from .thread_ownership import ThreadOwnershipPass
+
+ALL_PASSES = [
+    ThreadOwnershipPass(),
+    LaneDefaultsPass(),
+    JitPurityPass(),
+    CoordWallclockPass(),
+    BudgetSeamPass(),
+]
+
+RULES = tuple(p.name for p in ALL_PASSES)
+
+__all__ = [
+    "ALL_PASSES",
+    "RULES",
+    "BudgetSeamPass",
+    "CoordWallclockPass",
+    "JitPurityPass",
+    "LaneDefaultsPass",
+    "ThreadOwnershipPass",
+]

@@ -1,0 +1,68 @@
+"""budget-sharing: token-budget arithmetic lives in the declared seam only.
+
+Bug class (PR 5 review): the decode block and the speculative verify
+dispatch each computed "sampled tokens remaining" independently; any drift
+between the two numbers uploaded to the device breaks greedy byte-identity
+— the fix was the shared ``_slot_budget`` helper both paths must call. This
+pass pins that: in a class that declares a budget seam (a method marked
+``# acp: budget-seam``), any OTHER method doing arithmetic on a
+``.max_tokens`` read is recomputing the budget out-of-seam and is flagged.
+
+Comparisons (``>= s.max_tokens`` finish checks) and passing ``max_tokens``
+through calls are fine — only arithmetic (BinOp) over the budget source is
+the drift hazard.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation
+
+_BUDGET_ATTR = "max_tokens"
+
+
+class BudgetSeamPass(LintPass):
+    name = "budget-sharing"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+            methods = [
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            seams = {
+                m.name for m in methods if sf.func_marker(m, "budget-seam") is not None
+            }
+            if not seams:
+                continue
+            for fn in methods:
+                if fn.name in seams:
+                    continue
+                seen: set[tuple[int, int]] = set()
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.BinOp):
+                        continue
+                    reads = [
+                        n
+                        for n in ast.walk(node)
+                        if isinstance(n, ast.Attribute)
+                        and n.attr == _BUDGET_ATTR
+                        and isinstance(n.ctx, ast.Load)
+                    ]
+                    for read in reads:
+                        key = (read.lineno, read.col_offset)
+                        if key in seen:
+                            continue
+                        seen.add(key)
+                        yield self.violation(
+                            sf,
+                            read,
+                            f"token-budget arithmetic on .max_tokens in "
+                            f"{fn.name} — budget computation must go through "
+                            f"the declared seam ({', '.join(sorted(seams))}); "
+                            "independent recomputation drifts and breaks "
+                            "greedy byte-identity",
+                        )

@@ -1,0 +1,168 @@
+"""coord-wallclock: wall-clock decisions in coordinated classes are
+leader-local.
+
+Bug class (PRs 4-7, pinned repeatedly in review): under multi-host lockstep
+serving, every rank must make IDENTICAL admission/expiry decisions — a
+comparison against ``time.monotonic()`` is rank-local state, so deadline
+expiry, park expiry and every other wall-clock branch must run on the
+leader only and replicate through the frame stream (the repo's standing
+"deadlines are leader-local wall clock" rule).
+
+The rule, applied to methods of any class that carries coordination state
+(references ``self._coord_follower``):
+
+- a comparison whose operands involve a wall-clock read — a direct
+  ``time.monotonic()`` / ``time.time()`` call, or a local variable assigned
+  from one — is only legal inside a method declared ``# acp: leader-local``;
+- a method so declared must actually contain the follower guard (an ``if``
+  on ``self._coord_follower`` whose body returns/raises), otherwise the
+  declaration is a lie and is itself flagged.
+
+Metric/latency arithmetic (``now - t0`` fed to a histogram) never compares,
+so observability code passes untouched; only decisions are gated.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation, dotted_name
+
+_CLOCKS = {"time.time", "time.monotonic", "time.perf_counter", "time.time_ns"}
+
+
+def _is_clock_call(node: ast.AST) -> bool:
+    return isinstance(node, ast.Call) and dotted_name(node.func) in _CLOCKS
+
+
+def _mentions_coord(cls: ast.ClassDef) -> bool:
+    return any(
+        isinstance(n, ast.Attribute) and n.attr == "_coord_follower"
+        for n in ast.walk(cls)
+    )
+
+
+def _affirmative_follower_ref(expr: ast.AST, negated: bool = False) -> bool:
+    """True when ``expr`` contains a NON-negated ``*._coord_follower`` read
+    — ``if self._coord_follower: return`` guards; the inverted
+    ``if not self._coord_follower: return`` (returns on the LEADER, runs on
+    followers) must not count."""
+    if isinstance(expr, ast.Attribute) and expr.attr == "_coord_follower":
+        return not negated
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        return _affirmative_follower_ref(expr.operand, not negated)
+    return any(
+        _affirmative_follower_ref(child, negated)
+        for child in ast.iter_child_nodes(expr)
+    )
+
+
+def _binding_names(target: ast.AST):
+    """Plain local names a target BINDS. ``obj.field = now`` stores the
+    clock value into a field — it does not make ``obj`` itself a clock
+    value, so Attribute/Subscript bases are deliberately excluded (tainting
+    ``self`` would flag every comparison in the method)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from _binding_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from _binding_names(target.value)
+
+
+def _has_follower_guard(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if not isinstance(node, ast.If):
+            continue
+        if _affirmative_follower_ref(node.test) and any(
+            isinstance(b, (ast.Return, ast.Raise)) for b in node.body
+        ):
+            return True
+    return False
+
+
+class CoordWallclockPass(LintPass):
+    name = "coord-wallclock"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+            if not _mentions_coord(cls):
+                continue
+            for fn in (
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ):
+                yield from self._check_method(sf, fn)
+
+    def _check_method(self, sf: SourceFile, fn: ast.AST) -> Iterator[Violation]:
+        leader_local = sf.func_marker(fn, "leader-local") is not None
+        guarded = _has_follower_guard(fn)
+        if leader_local and not guarded:
+            yield self.violation(
+                sf,
+                fn,
+                f"{fn.name} is declared '# acp: leader-local' but has no "
+                "follower guard (if self._coord_follower: return) — "
+                "followers would fork lockstep on their local clock",
+            )
+            return
+        # taint: locals carrying a wall-clock value, propagated to a
+        # fixpoint through derived assignments ('now = time.monotonic();
+        # age = now - t0' taints 'age' too — single-hop taint would let
+        # the derived comparison evade the rule)
+        tainted: set[str] = set()
+        while True:
+            def carries_clock(expr: ast.AST) -> bool:
+                return any(
+                    _is_clock_call(n)
+                    or (isinstance(n, ast.Name) and n.id in tainted)
+                    for n in ast.walk(expr)
+                )
+
+            grew = False
+            for node in ast.walk(fn):
+                targets: list[ast.AST] = []
+                if isinstance(node, ast.Assign) and carries_clock(node.value):
+                    targets = list(node.targets)
+                elif isinstance(node, ast.NamedExpr) and carries_clock(node.value):
+                    targets = [node.target]
+                elif (
+                    isinstance(node, ast.AugAssign)
+                    and carries_clock(node.value)
+                ):
+                    targets = [node.target]
+                for t in targets:
+                    for name in _binding_names(t):
+                        if name not in tainted:
+                            tainted.add(name)
+                            grew = True
+            if not grew:
+                break
+
+        def wallclock_in(expr: ast.AST) -> bool:
+            return any(
+                _is_clock_call(n)
+                or (isinstance(n, ast.Name) and n.id in tainted)
+                for n in ast.walk(expr)
+            )
+
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Compare):
+                continue
+            if not (
+                wallclock_in(node.left)
+                or any(wallclock_in(c) for c in node.comparators)
+            ):
+                continue
+            if leader_local and guarded:
+                continue
+            yield self.violation(
+                sf,
+                node,
+                f"wall-clock comparison in {fn.name}, which is not declared "
+                "'# acp: leader-local' — coordinated ranks would diverge on "
+                "local clocks (route the decision through the leader seam)",
+            )

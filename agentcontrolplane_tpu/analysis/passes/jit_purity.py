@@ -1,0 +1,116 @@
+"""jit-purity: no host clocks, host RNG, or global mutation in traced code.
+
+A function handed to ``jax.jit`` / ``shard_map`` runs ONCE at trace time;
+``time.time()`` / ``random.random()`` / ``np.random`` calls inside it bake a
+single stale value into the compiled program (or, worse, differ per rank in
+a multi-host trace and fork lockstep), and ``global`` mutation from a traced
+body executes at trace time, not per step. The same discipline applies to
+everything under ``models/`` and ``ops/``: those are forward bodies by
+contract — host-side policy (clocks, RNG seeds, env) belongs in the engine.
+
+The rule:
+
+- every function defined in a ``models/`` or ``ops/`` module, and
+- every locally-resolvable function passed to ``jax.jit`` / ``jit`` /
+  ``pjit`` / ``shard_map`` (by name or as an inline lambda) anywhere
+
+must not call ``time.*``, ``random.*``, ``np.random.*`` / ``numpy.random.*``,
+``datetime.*.now``, read/write ``os.environ``, or use a ``global``
+statement. ``jax.random`` is fine — it is functional and traceable.
+
+Resolution is local by design (same module, by name): a cross-module escape
+would need whole-program analysis for marginal gain; the models/ops blanket
+covers the real kernels.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import LintPass, SourceFile, Violation, dotted_name
+
+_JIT_NAMES = {"jit", "pjit", "shard_map"}
+_BANNED_ROOTS = {"time", "random"}
+_BANNED_PREFIXES = ("np.random.", "numpy.random.", "os.environ")
+
+
+def _jit_target(call: ast.Call) -> Optional[ast.AST]:
+    """The function expression handed to a jit/shard_map wrapper, if any."""
+    name = dotted_name(call.func)
+    if name is None:
+        return None
+    leaf = name.rsplit(".", 1)[-1]
+    if leaf not in _JIT_NAMES:
+        return None
+    if call.args:
+        return call.args[0]
+    for kw in call.keywords:
+        if kw.arg in ("fun", "f", "func"):
+            return kw.value
+    return None
+
+
+def _impure_nodes(fn: ast.AST) -> Iterator[tuple[ast.AST, str]]:
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Global):
+            yield node, "'global' statement (trace-time mutation)"
+        name = None
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+        elif isinstance(node, ast.Attribute):
+            name = dotted_name(node)
+        if not name:
+            continue
+        root = name.split(".", 1)[0]
+        if isinstance(node, ast.Call) and root in _BANNED_ROOTS:
+            yield node, f"host call {name}() in traced/forward code"
+        elif isinstance(node, ast.Call) and name.startswith(_BANNED_PREFIXES):
+            yield node, f"host call {name}() in traced/forward code"
+        elif name.startswith("os.environ"):
+            yield node, "os.environ access in traced/forward code"
+        elif isinstance(node, ast.Call) and name.endswith(".now") and root == "datetime":
+            yield node, f"host clock {name}() in traced/forward code"
+
+
+class JitPurityPass(LintPass):
+    name = "jit-purity"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        rel = sf.relpath
+        in_kernel_pkg = rel.startswith(("models/", "ops/")) or (
+            "/models/" in rel or "/ops/" in rel
+        )
+        defs_by_name: dict[str, list[ast.AST]] = {}
+        for node in ast.walk(sf.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                defs_by_name.setdefault(node.name, []).append(node)
+
+        checked: set[int] = set()
+
+        def check(fn: ast.AST, context: str) -> Iterator[Violation]:
+            if id(fn) in checked:
+                return
+            checked.add(id(fn))
+            for node, why in _impure_nodes(fn):
+                yield self.violation(sf, node, f"{why} ({context})")
+
+        if in_kernel_pkg:
+            for fns in defs_by_name.values():
+                for fn in fns:
+                    yield from check(fn, f"def {fn.name} in a models/ops module")
+
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            target = _jit_target(node)
+            if target is None:
+                continue
+            if isinstance(target, ast.Lambda):
+                for sub, why in _impure_nodes(target):
+                    yield self.violation(
+                        sf, sub, f"{why} (lambda passed to jit/shard_map)"
+                    )
+            elif isinstance(target, ast.Name):
+                for fn in defs_by_name.get(target.id, []):
+                    yield from check(fn, f"'{target.id}' passed to jit/shard_map")

@@ -1,0 +1,107 @@
+"""lane-defaults: batched dispatch builders must default every lane.
+
+Bug class (PR 7): the speculative verify dispatch left its lane defaults at
+``n_input=1, starts=0`` for lanes NOT in the dispatch, scattering one
+garbage K/V row into position 0 of every free/parked/mid-prefill lane —
+silently corrupting parked prompt KV awaiting adoption. The defaults a
+width-W dispatch uploads for absent lanes are load-bearing.
+
+The rule: a function declared ``# acp: dispatch-lanes a,b,c`` builds a
+batched dispatch; every named lane buffer must be created by an
+explicit-default constructor — ``np.zeros`` / ``np.ones`` / ``np.full`` (a
+``np.full`` forces the author to SPELL the default; zeros/ones are explicit
+by construction). Violations:
+
+- a declared lane never assigned from such a constructor (missing, or built
+  some other way the reader can't audit for absent-lane safety);
+- ``np.empty`` anywhere in a dispatch builder — uninitialized memory IS the
+  garbage-lane bug, whatever the variable is called.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation, dotted_name
+
+_CTORS = {"zeros", "ones", "full", "full_like", "zeros_like", "ones_like"}
+_NP_ROOTS = {"np", "numpy", "jnp"}
+
+
+def _is_ctor(call: ast.Call) -> bool:
+    name = dotted_name(call.func)
+    if not name or "." not in name:
+        return False
+    root, _, leaf = name.rpartition(".")
+    return leaf in _CTORS and root.split(".")[0] in _NP_ROOTS
+
+
+def _contains_ctor(expr: ast.AST) -> bool:
+    return any(
+        isinstance(n, ast.Call) and _is_ctor(n) for n in ast.walk(expr)
+    )
+
+
+class LaneDefaultsPass(LintPass):
+    name = "lane-defaults"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for fn in (
+            n
+            for n in ast.walk(sf.tree)
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        ):
+            arg = sf.func_marker(fn, "dispatch-lanes")
+            if arg is None:
+                continue
+            declared = [
+                f for f in arg.replace(",", " ").split() if f
+            ]
+            if not declared:
+                yield self.violation(
+                    sf, fn, "dispatch-lanes marker declares no lane fields"
+                )
+                continue
+            initialized: set[str] = set()
+            for node in ast.walk(fn):
+                if isinstance(node, ast.Assign):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            if _contains_ctor(node.value):
+                                initialized.add(target.id)
+                        elif isinstance(target, ast.Tuple):
+                            # 'toks, starts = np.zeros(...), np.zeros(...)':
+                            # pair element-wise when the RHS is a matching
+                            # tuple, else credit all names if the RHS holds
+                            # a constructor at all
+                            elts = target.elts
+                            values = (
+                                node.value.elts
+                                if isinstance(node.value, ast.Tuple)
+                                and len(node.value.elts) == len(elts)
+                                else [node.value] * len(elts)
+                            )
+                            for t, v in zip(elts, values):
+                                if isinstance(t, ast.Name) and _contains_ctor(v):
+                                    initialized.add(t.id)
+                if isinstance(node, ast.Call) and dotted_name(node.func) in {
+                    f"{r}.empty" for r in _NP_ROOTS
+                }:
+                    yield self.violation(
+                        sf,
+                        node,
+                        "np.empty in a dispatch builder: uninitialized lane "
+                        "memory is the garbage-lane bug class — use "
+                        "np.zeros/np.full with an explicit absent-lane default",
+                    )
+            for field in declared:
+                if field not in initialized:
+                    yield self.violation(
+                        sf,
+                        fn,
+                        f"declared dispatch lane '{field}' is never built "
+                        "with an explicit-default constructor "
+                        "(np.zeros/np.ones/np.full) — absent lanes would "
+                        "carry unaudited defaults",
+                    )

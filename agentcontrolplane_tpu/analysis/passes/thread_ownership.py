@@ -1,0 +1,203 @@
+"""thread-ownership: ``Engine._*`` mutable state is engine-thread-only.
+
+Bug class (PR 6): ``stats()`` iterated the engine-thread-mutated slot dict
+from REST scrape threads; the fix was a plain-int mirror
+(``_parked_count``). This pass turns that review rule into a machine check:
+
+Inside a class, functions declared ``# acp: cross-thread`` (the stats/scrape
+surface) may touch underscore attributes ONLY when one of these holds:
+
+- the attribute is declared ``# acp: mirror`` on an assignment (atomic
+  scalar/tuple replacement, or an immutable post-``__init__`` snapshot);
+- the attribute is a recognized lock (assigned ``threading.Lock()`` /
+  ``RLock()``), and anything INSIDE a ``with self.<lock>:`` block is fine —
+  the lock serializes against the engine thread;
+- the access is exactly ``len(self._x)`` — CPython lens are atomic and the
+  repo's stats contract is explicitly "racy-but-safe: ints/lens only";
+- it is a CALL of another method itself declared cross-thread (the
+  constraint composes transitively instead of requiring whole-program
+  analysis).
+
+Public (non-underscore) attributes are the deliberate stats surface and are
+always readable. Any WRITE to engine state from a cross-thread function is
+flagged unless lock-guarded.
+
+Separately, in ``server/`` modules (the scrape side), reaching into
+``engine._anything`` is flagged outright — REST code must consume
+``stats()`` and public counters, never engine internals. Test files are
+exempt (white-box by design).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation, is_self_attr
+
+_LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
+
+
+def _collect_registry(cls: ast.ClassDef, sf: SourceFile):
+    """(mirrors, locks, cross_thread_methods, all_method_names)."""
+    mirrors: set[str] = set()
+    locks: set[str] = set()
+    cross: set[str] = set()
+    methods: set[str] = set()
+    for fn in (n for n in cls.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
+        methods.add(fn.name)
+        if sf.func_marker(fn, "cross-thread") is not None:
+            cross.add(fn.name)
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+            names = [a for t in targets if (a := is_self_attr(t))]
+            if not names:
+                continue
+            if sf.node_marker(node, "mirror") is not None:
+                mirrors.update(names)
+            value = node.value
+            if (
+                isinstance(value, ast.Call)
+                and isinstance(value.func, (ast.Name, ast.Attribute))
+                and (
+                    value.func.attr
+                    if isinstance(value.func, ast.Attribute)
+                    else value.func.id
+                )
+                in _LOCK_FACTORIES
+            ):
+                locks.update(names)
+    return mirrors, locks, cross, methods
+
+
+class _Checker(ast.NodeVisitor):
+    def __init__(self, pass_, sf, mirrors, locks, cross, methods):
+        self.pass_ = pass_
+        self.sf = sf
+        self.mirrors = mirrors
+        self.locks = locks
+        self.cross = cross
+        self.methods = methods
+        self.lock_depth = 0
+        self.out: list[Violation] = []
+
+    def visit_With(self, node: ast.With) -> None:
+        held = any(
+            (a := is_self_attr(item.context_expr)) and a in self.locks
+            for item in node.items
+        )
+        if held:
+            self.lock_depth += 1
+        self.generic_visit(node)
+        if held:
+            self.lock_depth -= 1
+
+    def visit_Call(self, node: ast.Call) -> None:
+        # len(self._x): sanctioned atomic read — visit args EXCEPT the
+        # attribute itself
+        if (
+            isinstance(node.func, ast.Name)
+            and node.func.id == "len"
+            and len(node.args) == 1
+            and is_self_attr(node.args[0])
+        ):
+            return
+        attr = is_self_attr(node.func)
+        if attr is not None and attr.startswith("_") and attr in self.methods:
+            if self.lock_depth == 0 and attr not in self.cross:
+                self.out.append(
+                    self.pass_.violation(
+                        self.sf,
+                        node.func,
+                        f"cross-thread function calls self.{attr}(), which is "
+                        "not declared '# acp: cross-thread' — engine-private "
+                        "helpers may not run on scrape threads",
+                    )
+                )
+            # the func attribute itself is vetted; check only the arguments
+            for arg in node.args:
+                self.visit(arg)
+            for kw in node.keywords:
+                self.visit(kw.value)
+            return
+        # NOT a method of this class (instance-attr callable, inherited
+        # method): fall through — the self._attr load itself is then held
+        # to the mirror/lock rules like any other read
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        attr = is_self_attr(node)
+        if attr is None or not attr.startswith("_") or attr.startswith("__"):
+            self.generic_visit(node)
+            return
+        if self.lock_depth > 0:
+            return
+        if isinstance(node.ctx, ast.Load):
+            if attr in self.mirrors or attr in self.locks:
+                return
+            self.out.append(
+                self.pass_.violation(
+                    self.sf,
+                    node,
+                    f"cross-thread read of engine-private self.{attr} — "
+                    "declare a '# acp: mirror' counter, take the owning "
+                    "lock, or read via len()",
+                )
+            )
+        else:
+            # writes are engine-thread-only even for declared mirrors —
+            # the mirror contract is atomic engine-side REPLACEMENT read
+            # by other threads, never scrape-side mutation
+            self.out.append(
+                self.pass_.violation(
+                    self.sf,
+                    node,
+                    f"cross-thread WRITE to self.{attr} — engine state is "
+                    "engine-thread-only (mutate under a lock or move the "
+                    "write to the engine loop)",
+                )
+            )
+
+
+class ThreadOwnershipPass(LintPass):
+    name = "thread-ownership"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+            mirrors, locks, cross, methods = _collect_registry(cls, sf)
+            if not cross:
+                continue
+            for fn in (
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name in cross
+            ):
+                checker = _Checker(self, sf, mirrors, locks, cross, methods)
+                for stmt in fn.body:
+                    checker.visit(stmt)
+                yield from checker.out
+        yield from self._check_server_scope(sf)
+
+    def _check_server_scope(self, sf: SourceFile) -> Iterator[Violation]:
+        rel = sf.relpath
+        base = rel.rsplit("/", 1)[-1]
+        if not (rel.startswith("server/") or "/server/" in rel):
+            return
+        if base.startswith(("test_", "conftest")):
+            return  # tests are white-box by design
+        for node in ast.walk(sf.tree):
+            if (
+                isinstance(node, ast.Attribute)
+                and node.attr.startswith("_")
+                and not node.attr.startswith("__")
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "engine"
+            ):
+                yield self.violation(
+                    sf,
+                    node,
+                    f"server code reaches into engine.{node.attr} — the "
+                    "scrape surface is stats() and public counters only",
+                )

@@ -164,7 +164,7 @@ class TPUEngineClient(LLMClient):
             result = await self._await_result(future)
         except asyncio.TimeoutError as e:
             self.engine.cancel(future)  # free the slot; don't decode for a dead request
-            raise LLMRequestError(504, str(e) or "TPU engine request timed out")
+            raise LLMRequestError(504, str(e) or "TPU engine request timed out") from e
         except asyncio.CancelledError:
             # caller torn down mid-generation (operator shutdown, lease loss):
             # free the slot instead of decoding to max_tokens for a dead caller
@@ -173,11 +173,11 @@ class TPUEngineClient(LLMClient):
         except EngineOverloadedError as e:
             # 503: non-terminal — the task controller retries with jittered
             # backoff instead of failing the Task
-            raise LLMRequestError(503, f"TPU engine overloaded: {e}")
+            raise LLMRequestError(503, f"TPU engine overloaded: {e}") from e
         except DeadlineExceededError as e:
-            raise LLMRequestError(504, f"TPU engine queue deadline: {e}")
+            raise LLMRequestError(504, f"TPU engine queue deadline: {e}") from e
         except Exception as e:
-            raise LLMRequestError(500, f"TPU engine failure: {e}")
+            raise LLMRequestError(500, f"TPU engine failure: {e}") from e
         return to_message(result.text, allowed)
 
     async def _await_result(self, future):
@@ -217,4 +217,4 @@ class TPUEngineClient(LLMClient):
             raise asyncio.TimeoutError(
                 "TPU engine generation timed out "
                 f"{self.request_timeout_s:.0f}s after slot admission"
-            )
+            ) from None

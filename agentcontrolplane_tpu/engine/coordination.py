@@ -393,12 +393,12 @@ class CoordinationFollower:
             if n > _MAX_HELLO:
                 raise ConnectionError("oversized hello reply")
             reply = json.loads(_recv_exact(self._sock, n))
-        except (OSError, ValueError, ConnectionError):
+        except (OSError, ValueError, ConnectionError) as e:
             self._sock.close()
             raise ConnectionError(
                 "coordination leader rejected the hello (wrong token, rank 0, "
                 "or a TLS/plaintext mismatch)"
-            )
+            ) from e
         if not reply.get("hello_ok"):
             self._sock.close()
             raise ConnectionError(f"coordination hello refused: {reply}")

@@ -30,6 +30,7 @@ from __future__ import annotations
 import contextlib
 import heapq
 import logging
+import os
 import queue
 import threading
 import time
@@ -290,6 +291,14 @@ class Engine:
         # disabled under multi-host coordination — the expiry decision is
         # wall-clock and would fork lockstep (same rule as deadlines).
         park_max_s: float = 30.0,
+        # armed runtime invariant checker (engine/invariants.py): audit the
+        # engine's host-side bookkeeping — page-accounting conservation,
+        # mirror counters vs recomputed truth, slot state legality — after
+        # every dispatch cycle, crashing the engine on the first violation
+        # instead of serving corrupt state. None reads $ACP_INVARIANTS; off
+        # by default and one plain-bool branch per loop iteration when
+        # disarmed (the fault seam's near-free posture).
+        check_invariants: Optional[bool] = None,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
         seed: int = 0,
         # Multi-host lockstep serving (engine/coordination.py): rank 0
@@ -495,8 +504,8 @@ class Engine:
         # O(new tokens) instead of O(whole conversation).
         import collections as _collections
 
-        self._prefix_enabled = prefix_cache_entries > 0
-        self._prefix_cache_entries = prefix_cache_entries
+        self._prefix_enabled = prefix_cache_entries > 0  # acp: mirror (immutable)
+        self._prefix_cache_entries = prefix_cache_entries  # acp: mirror (immutable)
         # HBM accounting: per cached token one K+V row per layer
         # (L * H_kv * d * 2 * dtype bytes); the token bound keeps worst-case
         # cache HBM explicit instead of silently scaling with bucket sizes
@@ -560,12 +569,14 @@ class Engine:
         # legacy spill path already compiles).
         self.prefill_chunk = max(0, int(prefill_chunk))
         self.token_budget = max(0, int(token_budget))
-        self._prefilling_count = 0  # int mirror for cross-thread stats()
+        self._prefilling_count = 0  # acp: mirror — int mirror for cross-thread stats()
         self.prefill_chunks = 0  # chunk dispatches (per-slot chunks)
         self.hol_wait_s = 0.0  # decode-stall seconds attributable to prefill
-        self._budget_last = (0, 0)  # (budget, tokens spent) last cycle
-        self._budget_spent_total = 0
-        self._budget_total = 0
+        # (budget, tokens spent) last cycle — replaced atomically as a whole
+        # tuple, never mutated in place, so scrape reads are torn-free
+        self._budget_last = (0, 0)  # acp: mirror
+        self._budget_spent_total = 0  # acp: mirror
+        self._budget_total = 0  # acp: mirror
         # speculative decoding state/counters (see _decode_spec)
         self.spec_len = max(0, int(spec_len))
         self.spec_ngram = max(1, int(spec_ngram))
@@ -576,7 +587,7 @@ class Engine:
         # a plain int mirror of "slots in _slots with parked=True" so
         # cross-thread readers (stats()) never iterate the engine-mutated
         # dict — same racy-but-safe ints-only contract as the other stats.
-        self._parked_count = 0
+        self._parked_count = 0  # acp: mirror
         self.park_max_s = 0.0 if coordination is not None else max(0.0, park_max_s)
         self.tool_calls_early = 0  # calls emitted before generation ended
         self.tool_overlap_saved_s = 0.0  # sum of (finish - emit) per early call
@@ -589,6 +600,11 @@ class Engine:
         from ..faults import FAULTS as _faults
 
         self._faults = _faults
+        self.check_invariants = (
+            bool(check_invariants)
+            if check_invariants is not None
+            else os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+        )
 
         self._build_jitted()
 
@@ -1172,7 +1188,7 @@ class Engine:
                         # burst formation depends on queue-drain timing: verify
                         # the batch size actually DISPATCHED and retry, rather
                         # than assuming the b submits landed in one group
-                        for attempt in range(5):
+                        for _attempt in range(5):
                             with self.hold_admission():
                                 futs = [
                                     self.submit([1] * seed_len + [2] * (8 + i), one)
@@ -1236,9 +1252,10 @@ class Engine:
         """Synchronous helper (tests/benchmarks). Requires a started engine."""
         return self.submit(prompt, sampling).result(timeout=600)
 
-    def stats(self) -> dict:
+    def stats(self) -> dict:  # acp: cross-thread
         """Point-in-time status snapshot (served at /v1/engine). Reads of
-        engine-thread state are racy-but-safe: ints/lens only."""
+        engine-thread state are racy-but-safe: ints/lens only (enforced by
+        the acplint thread-ownership pass against the mirror registry)."""
         out = {
             "model": {
                 "dim": self.config.dim,
@@ -1314,7 +1331,10 @@ class Engine:
         if self.kv_layout == "paged":
             out["kv_pages"] = {
                 "total": self.num_pages - 1,
-                "free": self._allocator.free_count,
+                # free_count is len() of the allocator's free list — the
+                # same atomic-len contract as len(self._waiting) below, just
+                # behind a property the AST pass can't see through
+                "free": self._allocator.free_count,  # acp-lint: disable=thread-ownership
                 "page_size": self.page_size,
                 "table_uploads": self.table_uploads,
             }
@@ -1329,7 +1349,7 @@ class Engine:
                 }
         return out
 
-    def _preempted_waiting(self) -> int:
+    def _preempted_waiting(self) -> int:  # acp: cross-thread
         """Requeued-after-preemption count; tolerant of cross-thread reads
         (the engine thread mutates the deque while stats() iterates).
         Preempted requests are only ever requeued at the FRONT and fresh
@@ -1338,7 +1358,9 @@ class Engine:
         walking a potentially deep backlog every decode block."""
         n = 0
         try:
-            for r in self._waiting:
+            # deque iteration raises (caught below) instead of tearing —
+            # the one sanctioned non-len cross-thread read in the engine
+            for r in self._waiting:  # acp-lint: disable=thread-ownership
                 if not r.preempt_count:
                     break
                 n += 1
@@ -1366,6 +1388,16 @@ class Engine:
                     if not admitted:
                         continue
                 self._dispatch_once()
+                if self.check_invariants:
+                    if self._faults.enabled and self._faults.pop(
+                        "engine.invariant_break"
+                    ) is not None:
+                        # deterministic mirror corruption: prove the armed
+                        # checker trips end to end (see faults.py)
+                        self._parked_count += 1
+                    from .invariants import check_engine_invariants
+
+                    check_engine_invariants(self)
         except Exception as e:  # an engine crash must not hang callers
             log.exception("engine loop crashed")
             self._slots.clear()
@@ -1426,7 +1458,7 @@ class Engine:
             except (ConnectionError, OSError) as e:
                 if self._stopping:  # local stop() closed the channel
                     return False
-                raise RuntimeError(f"serving coordination channel lost: {e}")
+                raise RuntimeError(f"serving coordination channel lost: {e}") from e
             if frame["stop"]:
                 self._stopping = True
                 return False
@@ -1544,7 +1576,7 @@ class Engine:
             return False
         return self._fill_slots()
 
-    def _expire_deadlines(self) -> None:
+    def _expire_deadlines(self) -> None:  # acp: leader-local
         """Fail queued requests whose deadline passed — fast, before any
         prefill is spent on them. Single-host: fail in place. Coordinated
         leader: route through the replicated cancel stream (wall-clock
@@ -1663,7 +1695,7 @@ class Engine:
                     )
         return admitted
 
-    def _spill_long_chunks(self, enriched: list[list]) -> None:
+    def _spill_long_chunks(self, enriched: list[list]) -> None:  # acp: dispatch-lanes toks,starts,slots,page_ids
         """Chunked prefill, batched across the admission group: round-robin
         one largest-bucket chunk per long request per dispatch (KV writes
         only; the sampled token is discarded) until every remainder fits one
@@ -1853,7 +1885,7 @@ class Engine:
             if sl.request.rid in self._applied_cancels:
                 self._finish(slot, "cancelled")
 
-    def _expire_prefilling(self) -> None:
+    def _expire_prefilling(self) -> None:  # acp: leader-local
         """Deadline expiry for mid-prefill slots: release the partial KV
         and fail the request — spending more chunks on a dead deadline is
         pure waste. Same coordination discipline as _expire_deadlines:
@@ -1979,7 +2011,9 @@ class Engine:
         )
         return spent
 
-    def _chunk_dispatch(self, batch: list[tuple[int, "_Slot", int, int]]) -> None:
+    def _chunk_dispatch(  # acp: dispatch-lanes toks,lengths,starts,slots,page_ids
+        self, batch: list[tuple[int, "_Slot", int, int]]
+    ) -> None:
         """One batched KV-only chunk dispatch (the per-cycle analogue of
         _spill_long_chunks' rounds): each row runs tokens [start, start+n)
         of its slot's prefill row through the continuation program, writing
@@ -2311,6 +2345,10 @@ class Engine:
         chunk: list[tuple[_Request, int, Optional[list[int]]]],
         starts_np: Optional[np.ndarray] = None,
     ) -> None:
+        # acp: dispatch-lanes tokens,lengths,slots,temps,top_ks,top_ps,con_states0,constrained0,budgets,full_lens,page_ids
+        # acp: budget-seam — the ONE admission-time budget computation (the
+        # +1-for-the-first-token form); decode/verify recomputation goes
+        # through _slot_budget
         """One batched prefill dispatch for B already-reserved requests
         (B = power of two <= prefill_batch_max). Burst admissions no longer
         serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
@@ -2393,7 +2431,7 @@ class Engine:
             # slot pages / block tables were installed at admission (they
             # must exist before spill chunks reference them)
             page_ids = np.full((B, bucket // P), TRASH_PAGE, dtype=np.int32)
-            for i, (req, slot, pages, _m) in enumerate(chunk):
+            for i, (_req, _slot, pages, _m) in enumerate(chunk):
                 assert pages is not None
                 fresh = pages[int(starts[i]) // P :]
                 page_ids[i, : len(fresh)] = fresh
@@ -2426,7 +2464,7 @@ class Engine:
         # their rows/tables now hold the FULL prompt KV, so the next turn can
         # reuse this whole context, not just the old prefix.
         if self._prefix_enabled:
-            for i, (req, slot, _, _m) in enumerate(chunk):
+            for req, slot, _, _m in chunk:
                 if not req.truncated:
                     self._save_prefix(self._full_row(req), len(req.prompt), slot)
         # one combined round trip (see _decode_once; the tunnel RTT floor
@@ -2973,7 +3011,7 @@ class Engine:
             "token-budget scheduler",
         )
 
-    def _slot_budget(self, slot: int, sl: _Slot) -> int:
+    def _slot_budget(self, slot: int, sl: _Slot) -> int:  # acp: budget-seam
         """Sampled tokens this slot may still emit — min of its remaining
         ``max_tokens`` and the context edge (the device deactivates a slot
         after the token that lands it at max_ctx-1). The decode block and
@@ -3005,6 +3043,7 @@ class Engine:
         return sl.ctx_buf[:total]
 
     def _decode_spec(self) -> bool:
+        # acp: dispatch-lanes inputs,n_input,starts,active,budgets,proposed
         """One speculative decode iteration: draft host-side (n-gram prompt
         lookup over prompt + generated-so-far), verify every position in a
         single batched dispatch, commit the accepted prefix + one corrected
@@ -3347,7 +3386,10 @@ class Engine:
         now = time.monotonic()
         expired = [
             s for s, sl in self._slots.items()
-            if sl.parked and now - sl.parked_at > self.park_max_s
+            # wall-clock expiry is safe here WITHOUT the leader seam: the
+            # constructor forces park_max_s=0 under coordination (parking
+            # disabled entirely), so this compare never runs in lockstep
+            if sl.parked and now - sl.parked_at > self.park_max_s  # acp-lint: disable=coord-wallclock
         ]
         for slot in expired:
             self._release_parked(slot)
@@ -3426,7 +3468,7 @@ class Engine:
         self._waiting.popleft()
         return [(req, slot, pages, (None, {"cut": cut, "in_slot": True}))]
 
-    def _n_active(self) -> int:
+    def _n_active(self) -> int:  # acp: cross-thread
         """Slots actively DECODING — parked slots linger without work and
         mid-prefill slots haven't sampled yet (see _has_work for the
         loop-level any-work predicate)."""

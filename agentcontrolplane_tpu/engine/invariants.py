@@ -1,0 +1,275 @@
+"""Armed runtime invariant checker for the serving engine.
+
+Every recent PR shipped a latent state-corruption bug that only a new
+stress test happened to trip — PR 7's verify-dispatch lane defaults
+scattered garbage K/V into parked prompt KV, PR 6's ``stats()`` iterated an
+engine-mutated dict cross-thread, PR 5's reclaim stripped an in-flight
+dispatch's pages. This module turns the engine's host-side bookkeeping
+contracts into an executable audit, run after every ``_dispatch_once`` when
+armed (``ACP_INVARIANTS=1`` or ``Engine(check_invariants=True)``):
+
+- **slot conservation** — every slot id is exactly one of {free, occupied};
+  no duplicates; free slots' host mirrors are zeroed.
+- **slot state machine** — a slot is exactly one of PREFILLING / ACTIVE /
+  PARKED; parked slots have resolved futures and ``seq_len == park_cut``;
+  prefilling slots have ``seq_len == prefill_pos`` within the row.
+- **mirror counters** — ``_parked_count`` / ``_prefilling_count`` equal the
+  truth recomputed from the slot dict (the PR 6 drift class).
+- **budget agreement** — an active slot's sampled-token count and sequence
+  length are consistent with its request (``seq == prompt + generated - 1``,
+  ``sampled <= max_tokens``, below the context edge), so the decode block
+  and speculative verify — which both derive their uploads from these via
+  ``_slot_budget`` — cannot disagree.
+- **page-accounting conservation** (paged layout) — free + referenced +
+  trash == total pages; refcounts positive; every reference is owned by
+  exactly refcount holders across slot tables, prefix-cache entries and
+  fault-held pages (a page owned by two slots MUST be refcounted-shared;
+  a refcount with no owner is a leak — the PR 5 class); parked slots hold
+  exactly their prompt-covering pages (the PR 7 garbage-lane class, in its
+  host-observable form); block-table rows mirror the page lists.
+
+``verify_engine`` returns the violations as strings (tests corrupt state
+and assert on them); ``check_engine_invariants`` raises
+:class:`InvariantViolation`, which crashes the engine loop — a corrupt
+engine must fail loudly, not serve garbage. Both run on the engine thread
+(or an idle engine) and only READ state; when disarmed the hot loop pays a
+single plain-bool branch (see ``Engine._run``).
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+
+from ..observability.metrics import REGISTRY
+
+
+class InvariantViolation(RuntimeError):
+    """The engine's host-side bookkeeping broke one of its contracts."""
+
+
+def verify_engine(engine) -> list[str]:
+    """Audit ``engine``'s host-side state; returns problem descriptions
+    (empty = healthy). Read-only; engine-thread or idle-engine callers."""
+    problems: list[str] = []
+    slots = dict(engine._slots)
+    free = list(engine._free)
+
+    # -- slot conservation ------------------------------------------------
+    if len(free) != len(set(free)):
+        problems.append("free-slot heap holds duplicate slot ids")
+    overlap = set(free) & set(slots)
+    if overlap:
+        problems.append(f"slot ids both free and occupied: {sorted(overlap)}")
+    if len(set(free)) + len(slots) != engine.max_slots:
+        problems.append(
+            f"slot conservation broken: {len(set(free))} free + "
+            f"{len(slots)} occupied != max_slots {engine.max_slots}"
+        )
+    for s in free:
+        if int(engine._seq_lens[s]) != 0:
+            problems.append(
+                f"free slot {s} has non-zero seq_len {int(engine._seq_lens[s])} "
+                "(host mirror not reset on release)"
+            )
+
+    # -- slot state machine + per-state bookkeeping -----------------------
+    parked_truth = 0
+    prefilling_truth = 0
+    for slot, sl in slots.items():
+        seq = int(engine._seq_lens[slot])
+        if sl.parked and sl.prefilling:
+            problems.append(f"slot {slot} is both PARKED and PREFILLING")
+            continue
+        if sl.parked:
+            parked_truth += 1
+            if not sl.request.future.done():
+                problems.append(
+                    f"parked slot {slot} has an unresolved future (park "
+                    "must resolve the caller before lingering)"
+                )
+            if seq != sl.park_cut:
+                problems.append(
+                    f"parked slot {slot}: seq_len {seq} != park_cut "
+                    f"{sl.park_cut} — adoption would prefill against rows "
+                    "that aren't the intact prompt KV"
+                )
+        elif sl.prefilling:
+            prefilling_truth += 1
+            row_len = len(sl.prefill_row or [])
+            if not 0 <= sl.prefill_pos <= row_len:
+                problems.append(
+                    f"prefilling slot {slot}: prefill_pos {sl.prefill_pos} "
+                    f"outside [0, {row_len}]"
+                )
+            if seq != sl.prefill_pos:
+                problems.append(
+                    f"prefilling slot {slot}: seq_len {seq} != prefill_pos "
+                    f"{sl.prefill_pos}"
+                )
+        else:  # ACTIVE (decoding)
+            want = sl.prompt_len + len(sl.generated) - 1
+            if seq != want:
+                problems.append(
+                    f"active slot {slot}: seq_len {seq} != prompt_len + "
+                    f"len(generated) - 1 = {want} — KV rows and host "
+                    "bookkeeping have diverged"
+                )
+            sampled = len(sl.generated) - sl.prefix_len
+            cap = sl.request.sampling.max_tokens
+            if sampled > cap:
+                problems.append(
+                    f"active slot {slot}: sampled {sampled} tokens past its "
+                    f"max_tokens {cap} — the budget seam was bypassed"
+                )
+            if seq >= engine.max_ctx:
+                problems.append(
+                    f"active slot {slot}: seq_len {seq} at/over max_ctx "
+                    f"{engine.max_ctx} — the context edge no longer "
+                    "deactivates this lane"
+                )
+
+    # -- mirror counters vs recomputed truth ------------------------------
+    if parked_truth != engine._parked_count:
+        problems.append(
+            f"mirror drift: _parked_count {engine._parked_count} != "
+            f"{parked_truth} parked slots recomputed from the slot dict"
+        )
+    if prefilling_truth != engine._prefilling_count:
+        problems.append(
+            f"mirror drift: _prefilling_count {engine._prefilling_count} != "
+            f"{prefilling_truth} prefilling slots recomputed from the slot dict"
+        )
+
+    if engine.kv_layout == "paged":
+        problems.extend(_verify_pages(engine, slots))
+    return problems
+
+
+def _verify_pages(engine, slots: dict) -> list[str]:
+    problems: list[str] = []
+    P = engine.page_size
+    alloc = engine._allocator
+    free_pages, refs = alloc.audit()
+    free_set = set(free_pages)
+
+    # conservation: free + referenced + trash == total, no page in both
+    if len(free_set) != len(free_pages):
+        problems.append("page allocator free list holds duplicate pages")
+    both = free_set & set(refs)
+    if both:
+        problems.append(
+            f"pages both free and referenced: {sorted(both)[:8]} — a "
+            "double-free pooled a live page"
+        )
+    lost = set(range(1, alloc.num_pages)) - free_set - set(refs)
+    if lost:
+        problems.append(
+            f"pages vanished from accounting: {sorted(lost)[:8]} "
+            "(free + allocated + trash != total)"
+        )
+    negative = {pg: r for pg, r in refs.items() if r <= 0}
+    if negative:
+        problems.append(f"non-positive refcounts: {negative}")
+
+    # ownership audit: every reference is held by exactly refcount owners
+    owners: Counter = Counter()
+    for slot, pages in engine._slot_pages.items():
+        if slot not in slots:
+            problems.append(f"page table exists for unoccupied slot {slot}")
+        for pg in pages:
+            owners[pg] += 1
+    with engine._prefix_lock:
+        for entry in engine._prefix_cache.values():
+            for pg in entry.get("pages", ()):
+                owners[pg] += 1
+    for pg in engine._faults.held_pages(alloc):
+        owners[pg] += 1
+    for pg, n in owners.items():
+        r = refs.get(pg, 0)
+        if n > r:
+            problems.append(
+                f"page {pg}: {n} owners but refcount {r} — unshared "
+                "multi-ownership (two sequences would write one page)"
+            )
+        elif n < r:
+            problems.append(
+                f"page {pg}: refcount {r} but only {n} owners — refcount "
+                "leak (the page can never return to the pool)"
+            )
+    orphaned = set(refs) - set(owners)
+    if orphaned:
+        problems.append(
+            f"pages referenced but owned by nothing: {sorted(orphaned)[:8]} "
+            "— refcount leak"
+        )
+
+    # per-slot coverage + block-table mirror
+    from ..ops.paged import TRASH_PAGE
+
+    for slot, sl in slots.items():
+        pages = engine._slot_pages.get(slot)
+        if pages is None:
+            problems.append(f"occupied slot {slot} has no page table")
+            continue
+        seq = int(engine._seq_lens[slot])
+        if sl.parked:
+            want = sl.park_cut // P
+            if len(pages) != want:
+                problems.append(
+                    f"parked slot {slot}: holds {len(pages)} pages, prompt "
+                    f"cut {sl.park_cut} needs exactly {want} — surplus pins "
+                    "the pool, deficit serves garbage KV on adoption"
+                )
+        elif sl.prefilling:
+            want = -(-len(sl.prefill_row or []) // P)
+            if len(pages) != want:
+                problems.append(
+                    f"prefilling slot {slot}: holds {len(pages)} pages but "
+                    f"its whole row needs {want} — the chunk loop never "
+                    "allocates, so the admission-time reservation must be "
+                    "complete"
+                )
+        else:
+            if len(pages) * P < seq:
+                problems.append(
+                    f"active slot {slot}: {len(pages)} pages cover "
+                    f"{len(pages) * P} rows < seq_len {seq} — KV was "
+                    "written past the owned pages"
+                )
+            if len(pages) > engine.max_pages_per_seq:
+                problems.append(
+                    f"active slot {slot}: {len(pages)} pages exceeds "
+                    f"max_pages_per_seq {engine.max_pages_per_seq}"
+                )
+        table_row = engine._block_tables[slot]
+        if list(table_row[: len(pages)]) != list(pages):
+            problems.append(
+                f"slot {slot}: block-table row diverges from its page list"
+            )
+        if any(int(x) != TRASH_PAGE for x in table_row[len(pages):]):
+            problems.append(
+                f"slot {slot}: block-table rows beyond the page list are "
+                "not TRASH_PAGE — a stale mapping could be read after the "
+                "page is reused"
+            )
+    return problems
+
+
+def check_engine_invariants(engine) -> None:
+    """Audit and raise on the first broken contract (armed mode)."""
+    REGISTRY.counter_add(
+        "acp_engine_invariant_checks_total",
+        1.0,
+        help="engine state audits run (ACP_INVARIANTS armed)",
+    )
+    problems = verify_engine(engine)
+    if problems:
+        REGISTRY.counter_add(
+            "acp_engine_invariant_violations_total",
+            float(len(problems)),
+            help="broken engine bookkeeping contracts detected by the "
+            "armed invariant checker",
+        )
+        raise InvariantViolation(
+            "engine invariant violation(s):\n  " + "\n  ".join(problems)
+        )

@@ -24,6 +24,12 @@ Engine sites (see ``engine/engine.py``):
   first. Fires only while some slot is mid-prefill.
 - ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
   (released when disarmed/reset), shrinking the pool mid-serve.
+- ``engine.invariant_break`` — corrupt a mirror counter (``_parked_count``)
+  right before the armed invariant checker runs, proving the
+  ``ACP_INVARIANTS`` audit trips end to end (engine crashes with
+  ``InvariantViolation``; callers' futures fail; ``ensure_running``
+  recovers). Gated on ``Engine.check_invariants`` so arming it against a
+  disarmed engine is a no-op instead of silent state corruption.
 - ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:
   for the next ``times=N`` verify dispatches every draft token is treated
   as mismatched (full rejection), so each dispatch commits exactly one
@@ -100,6 +106,14 @@ class FaultInjector:
             if spec["times"] <= 0:
                 del self._armed[site]
             return dict(spec)
+
+    def held_pages(self, allocator) -> list[int]:
+        """Pages ``engine.page_pressure`` is holding out of ``allocator``
+        — the invariant checker's ownership audit counts them as owned (a
+        held page is referenced on purpose, not leaked)."""
+        with self._lock:
+            ent = self._held.get(id(allocator))
+            return list(ent[1]) if ent else []
 
     def apply_page_pressure(self, allocator) -> None:
         """Converge the pages held from ``allocator`` toward the armed

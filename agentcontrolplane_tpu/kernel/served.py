@@ -544,7 +544,7 @@ class RemoteStore:
                 reply = json.loads(line) if line.strip() else {}
             except (OSError, ValueError) as e:
                 sock.close()
-                raise ConnectionError(f"store auth handshake failed: {e}")
+                raise ConnectionError(f"store auth handshake failed: {e}") from e
             if not line.strip():
                 # clean EOF mid-handshake = transport failure (owner
                 # restarting), NOT a rejected token — it must stay
@@ -698,13 +698,13 @@ class RemoteStore:
                         slot["epoch"] = self._conn_epoch
                         self._wfile.write(frame)
                         self._wfile.flush()
-                except OSError:
+                except OSError as e:
                     self._closed.set()  # conn died at write; op NOT sent
                     if attempt == 0 and not self._user_closed:
                         continue
                     raise ConnectionError(
                         f"store connection to {self.address} is closed"
-                    )
+                    ) from e
                 if not slot["event"].wait(self._timeout):
                     raise TimeoutError(
                         f"store op {op!r} timed out after {self._timeout}s"

@@ -36,7 +36,7 @@ def resolve_secret_key(store: Store, namespace: str, ref: Optional[SecretKeyRef]
     try:
         secret = store.get("Secret", ref.name, namespace)
     except NotFound:
-        raise Invalid(f'secret "{ref.name}" not found')
+        raise Invalid(f'secret "{ref.name}" not found') from None
     assert isinstance(secret, Secret)
     if ref.key not in secret.spec.data:
         raise Invalid(f'key "{ref.key}" not found in secret "{ref.name}"')

@@ -184,6 +184,15 @@ class PageAllocator:
     def free_count(self) -> int:
         return len(self._free)
 
+    def audit(self) -> tuple[list[int], dict[int, int]]:
+        """Snapshot ``(free pages, {page: refcount})`` for the runtime
+        invariant checker (engine/invariants.py): conservation demands the
+        two partition {1..num_pages-1} exactly, and every refcount must be
+        matched by that many live owners (slot tables, prefix-cache
+        entries, fault-held pages). Copies, so the caller can audit without
+        aliasing allocator internals."""
+        return list(self._free), dict(self._refs)
+
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
             raise MemoryError(f"out of KV pages: need {n}, have {len(self._free)}")

@@ -80,7 +80,7 @@ def validate_contact_channel_ref(store: Store, task: Task) -> None:
     try:
         channel = store.get("ContactChannel", ref.name, task.namespace)
     except NotFound:
-        raise Invalid(f'referenced ContactChannel "{ref.name}" not found')
+        raise Invalid(f'referenced ContactChannel "{ref.name}" not found') from None
     if not channel.status.ready:
         raise Invalid(
             f'referenced ContactChannel "{ref.name}" is not ready '

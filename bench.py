@@ -53,6 +53,13 @@ ACP_BENCH_BUILD_TIMEOUT_S, ACP_BENCH_WARM_TIMEOUT_S,
 ACP_BENCH_TTFT=0 / ACP_BENCH_TTFT_TASKS / ACP_BENCH_TTFT_DEADLINE_S /
 ACP_BENCH_TTFT_TIMEOUT_S, ACP_BENCH_AB=0 / ACP_BENCH_AB_BUDGET_S,
 ACP_BENCH_TOTAL_BUDGET_S, ACP_BENCH_RETRIES.
+
+``ACP_INVARIANTS=1`` additionally arms the engine's runtime invariant
+checker (engine/invariants.py) for every bench engine — per-dispatch state
+audits ride the measured burst without changing the headline contract
+(slower, for soak/debug runs; leave unset for comparable numbers). The
+flag is registered explicitly on each Engine below so child processes and
+future refactors can't silently drop it.
 """
 
 from __future__ import annotations
@@ -736,6 +743,8 @@ def _child(args: argparse.Namespace) -> None:
         spec_len=spec_len,
         spec_ngram=spec_ngram,
         seed=0,
+        # opt-in per-dispatch state audits (see module docstring)
+        check_invariants=os.environ.get("ACP_INVARIANTS", "") not in ("", "0"),
     )
     if ttft_on or (args.phase == "ab" and os.environ.get("ACP_BENCH_TTFT", "1") != "0"):
         # build the constraint token table up front so EVERY program in this
@@ -1030,6 +1039,8 @@ def _bench_hol() -> dict:
         page_size=16,
         # the cache would let leg 2 skip the long prefill leg 1 measured
         prefix_cache_entries=0,
+        # opt-in per-dispatch state audits (see module docstring)
+        check_invariants=os.environ.get("ACP_INVARIANTS", "") not in ("", "0"),
     )
     engine.start()
     try:

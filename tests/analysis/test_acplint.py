@@ -1,0 +1,445 @@
+"""acplint: the repo-custom static-analysis pass pack.
+
+Two tier-1 gates plus per-rule negative fixtures:
+
+- the whole package must lint clean (every declared contract holds in the
+  shipped tree — this is the same gate ``make lint-acp`` / CI runs);
+- the tests tree must lint clean too (no false positives on white-box
+  test code);
+- each rule has a minimal fixture that MUST fire, proving the pass
+  actually detects its bug class (a lint that can't fail detects nothing).
+
+The fixtures are deliberately tiny distillations of the real shipped bugs
+each rule encodes (see docs/debugging-guide.md for the catalogue).
+"""
+
+import textwrap
+from pathlib import Path
+
+import agentcontrolplane_tpu
+from agentcontrolplane_tpu.analysis import analyze
+from agentcontrolplane_tpu.analysis.__main__ import main as lint_main
+
+PKG_ROOT = Path(agentcontrolplane_tpu.__file__).parent
+TESTS_ROOT = Path(__file__).resolve().parents[1]
+
+
+def _write(tmp_path: Path, rel: str, source: str) -> Path:
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(source))
+    return tmp_path
+
+
+def _rules(violations):
+    return sorted(v.rule for v in violations)
+
+
+# -- the two clean-tree gates -------------------------------------------------
+
+
+def test_package_lints_clean():
+    violations = analyze([PKG_ROOT])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_tests_tree_has_no_false_positives():
+    violations = analyze([TESTS_ROOT])
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_module_runner_exit_codes(tmp_path, capsys):
+    assert lint_main(["--quiet", str(PKG_ROOT / "analysis")]) == 0
+    root = _write(
+        tmp_path,
+        "models/bad.py",
+        """
+        import time
+
+        def forward(x):
+            return x * time.time()
+        """,
+    )
+    assert lint_main(["--quiet", str(root)]) == 1
+    out = capsys.readouterr().out
+    assert "jit-purity" in out and "models/bad.py" in out
+
+
+# -- rule: thread-ownership ---------------------------------------------------
+
+
+def test_thread_ownership_fires_on_undeclared_cross_thread_access(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import threading
+
+        class Engine:
+            def __init__(self):
+                self._ok = 0  # acp: mirror
+                self._hidden = {}
+                self._lock = threading.Lock()
+                self._guarded = []
+
+            def stats(self):  # acp: cross-thread
+                n = self._ok            # mirror: fine
+                m = len(self._hidden)   # atomic len: fine
+                with self._lock:
+                    g = list(self._guarded)  # lock held: fine
+                bad = self._hidden      # undeclared read
+                self._hidden = {}       # cross-thread write
+                self._helper()          # undeclared helper call
+                return n + m + len(g) + len(bad)
+
+            def _helper(self):
+                return 1
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"] * 3
+    messages = " | ".join(v.message for v in violations)
+    assert "read of engine-private self._hidden" in messages
+    assert "WRITE to self._hidden" in messages
+    assert "self._helper()" in messages
+
+
+def test_thread_ownership_flags_cross_thread_writes_even_to_mirrors(tmp_path):
+    """The mirror contract is atomic engine-side replacement, scrape-side
+    READ — a cross-thread write to a declared mirror is still a write."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self._count = 0  # acp: mirror
+
+            def stats(self):  # acp: cross-thread
+                self._count = 0
+                return self._count
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert "WRITE to self._count" in violations[0].message
+
+
+def test_missing_path_is_a_violation_not_a_silent_pass(tmp_path):
+    """A lint gate pointed at a renamed/mistyped target must fail loudly,
+    not exit 0 having linted nothing."""
+    violations = analyze([tmp_path / "does_not_exist.py"])
+    assert _rules(violations) == ["missing-path"]
+    assert lint_main(["--quiet", str(tmp_path / "nope")]) == 1
+
+
+def test_thread_ownership_fires_on_non_method_private_callable(tmp_path):
+    """A private callable that is NOT a def in the class (instance-attr
+    lambda, inherited method) can't be vetted as cross-thread — the
+    attribute read itself must be held to the mirror rules."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self._snapshot = lambda: {}
+
+            def stats(self):  # acp: cross-thread
+                return self._snapshot()
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert "self._snapshot" in violations[0].message
+
+
+def test_thread_ownership_fires_on_server_scope_engine_reach(tmp_path):
+    root = _write(
+        tmp_path,
+        "server/handlers.py",
+        """
+        def scrape(engine):
+            return len(engine._slots)
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert "stats() and public counters only" in violations[0].message
+
+
+# -- rule: lane-defaults ------------------------------------------------------
+
+
+def test_lane_defaults_fires_on_missing_and_uninitialized_lanes(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import numpy as np
+
+        class Engine:
+            def _verify_dispatch(self, W):  # acp: dispatch-lanes inputs,n_input,starts
+                inputs = np.zeros((W, 4), dtype=np.int32)
+                n_input = np.empty(W, dtype=np.int32)
+                return inputs, n_input
+        """,
+    )
+    violations = analyze([root])
+    # np.empty itself + n_input (not ctor-built) + starts (never built)
+    assert _rules(violations) == ["lane-defaults"] * 3
+    messages = " | ".join(v.message for v in violations)
+    assert "np.empty" in messages
+    assert "'starts'" in messages and "'n_input'" in messages
+
+
+def test_lane_defaults_accepts_tuple_assignments(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import numpy as np
+
+        class Engine:
+            def _dispatch(self, W):  # acp: dispatch-lanes toks,starts
+                toks, starts = np.zeros(W), np.full(W, 64)
+                return toks, starts
+        """,
+    )
+    assert analyze([root]) == []
+
+
+def test_lane_defaults_clean_when_all_lanes_defaulted(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import numpy as np
+
+        class Engine:
+            def _verify_dispatch(self, W):  # acp: dispatch-lanes inputs,n_input,starts
+                inputs = np.zeros((W, 4), dtype=np.int32)
+                n_input = np.zeros(W, dtype=np.int32)
+                starts = np.full(W, 64, dtype=np.int32)
+                return inputs, n_input, starts
+        """,
+    )
+    assert analyze([root]) == []
+
+
+# -- rule: jit-purity ---------------------------------------------------------
+
+
+def test_jit_purity_fires_in_models_scope(tmp_path):
+    root = _write(
+        tmp_path,
+        "models/net.py",
+        """
+        import time
+
+        def forward(params, x):
+            scale = time.monotonic()
+            return x * scale
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["jit-purity"]
+    assert "time.monotonic" in violations[0].message
+
+
+def test_path_scoped_rules_bind_on_direct_file_arguments(tmp_path):
+    """Linting a single file must keep its directory scope: a models/ file
+    passed directly still gets the forward-body blanket."""
+    root = _write(
+        tmp_path,
+        "models/net.py",
+        """
+        import time
+
+        def forward(params, x):
+            return x * time.time()
+        """,
+    )
+    violations = analyze([root / "models" / "net.py"])
+    assert _rules(violations) == ["jit-purity"]
+
+
+def test_jit_purity_fires_on_jitted_functions_anywhere(tmp_path):
+    root = _write(
+        tmp_path,
+        "anywhere.py",
+        """
+        import jax
+        import random
+
+        def impure(x):
+            return x + random.random()
+
+        f = jax.jit(impure)
+        g = jax.jit(lambda x: x * random.random())
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["jit-purity"] * 2
+
+
+# -- rule: coord-wallclock ----------------------------------------------------
+
+
+def test_coord_wallclock_fires_on_unmarked_and_unguarded(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import time
+
+        class Engine:
+            def __init__(self, coordination=None):
+                self._coord_follower = coordination is not None
+
+            def _expire(self, deadline):
+                return time.monotonic() > deadline
+
+            def _expire_marked(self, deadline):  # acp: leader-local
+                now = time.monotonic()
+                return now > deadline
+
+            def _expire_good(self, deadline):  # acp: leader-local
+                if self._coord_follower:
+                    return False
+                return time.monotonic() > deadline
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["coord-wallclock"] * 2
+    messages = " | ".join(v.message for v in violations)
+    assert "not declared" in messages  # _expire: unmarked comparison
+    assert "no follower guard" in messages  # _expire_marked: marker is a lie
+
+
+def test_coord_wallclock_taints_derived_values(tmp_path):
+    """'age = now - t0; if age > limit' is still a wall-clock decision —
+    taint must propagate through derived assignments, not just the
+    direct clock read."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import time
+
+        class Engine:
+            def __init__(self, coordination=None):
+                self._coord_follower = coordination is not None
+
+            def _expired(self, started_at, limit):
+                now = time.monotonic()
+                age = now - started_at
+                return age > limit
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["coord-wallclock"]
+
+
+def test_coord_wallclock_rejects_inverted_guard(tmp_path):
+    """``if not self._coord_follower: return`` returns on the LEADER and
+    runs the wall-clock decision on every follower — the exact divergence
+    the rule exists to stop. It must not satisfy the guard check."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import time
+
+        class Engine:
+            def __init__(self, coordination=None):
+                self._coord_follower = coordination is not None
+
+            def _expire(self, deadline):  # acp: leader-local
+                if not self._coord_follower:
+                    return False
+                return time.monotonic() > deadline
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["coord-wallclock"]
+    assert "no follower guard" in violations[0].message
+
+
+def test_coord_wallclock_ignores_uncoordinated_classes(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        import time
+
+        class Plain:
+            def expired(self, deadline):
+                return time.monotonic() > deadline
+        """,
+    )
+    assert analyze([root]) == []
+
+
+# -- rule: budget-sharing -----------------------------------------------------
+
+
+def test_budget_sharing_fires_outside_the_seam(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _slot_budget(self, sl):  # acp: budget-seam
+                return sl.sampling.max_tokens - len(sl.generated)
+
+            def _verify(self, sl):
+                budget = sl.sampling.max_tokens - 1
+                if len(sl.generated) >= sl.sampling.max_tokens:
+                    return 0
+                return budget
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["budget-sharing"]
+    assert "_verify" in violations[0].message
+
+
+# -- suppression pragma -------------------------------------------------------
+
+
+def test_inline_pragma_suppresses_a_rule(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _slot_budget(self, sl):  # acp: budget-seam
+                return sl.sampling.max_tokens - len(sl.generated)
+
+            def _verify(self, sl):
+                return sl.sampling.max_tokens - 1  # acp-lint: disable=budget-sharing
+        """,
+    )
+    assert analyze([root]) == []
+
+
+def test_pragma_only_suppresses_the_named_rule(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _slot_budget(self, sl):  # acp: budget-seam
+                return sl.sampling.max_tokens - len(sl.generated)
+
+            def _verify(self, sl):
+                return sl.sampling.max_tokens - 1  # acp-lint: disable=jit-purity
+        """,
+    )
+    assert _rules(analyze([root])) == ["budget-sharing"]
+
+
+def test_parse_error_is_a_violation_not_a_crash(tmp_path):
+    root = _write(tmp_path, "broken.py", "def f(:\n")
+    assert _rules(analyze([root])) == ["parse-error"]

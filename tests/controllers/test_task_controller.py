@@ -213,9 +213,10 @@ async def test_tool_calls_join_appends_results_in_order(harness):
     ]
     store.update_status(task)
     labels = {LABEL_TASK: "test-task", LABEL_TOOL_CALL_REQUEST: "req1234"}
-    for i, (name, result_text, phase) in enumerate(
-        [("tc-01", "result one", "Succeeded"), ("tc-02", "Rejected: no", "ToolCallRejected")]
-    ):
+    for name, result_text, phase in [
+        ("tc-01", "result one", "Succeeded"),
+        ("tc-02", "Rejected: no", "ToolCallRejected"),
+    ]:
         tc = make_toolcall(store, name=f"test-task-req1234-{name}", labels=labels)
         tc.status.phase = phase
         tc.status.status = "Succeeded"

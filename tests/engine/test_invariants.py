@@ -1,0 +1,216 @@
+"""Mutation harness for the armed runtime invariant checker
+(engine/invariants.py).
+
+A checker that never fires proves nothing: each test seeds one HISTORICAL
+bug class into a healthy engine's state and asserts the audit catches it —
+
+- **mirror drift** (the PR 6 class: stats counters diverging from the slot
+  dict) via direct corruption AND end-to-end via the
+  ``engine.invariant_break`` fault site (armed engine crashes with
+  ``InvariantViolation``, callers fail loudly, ``ensure_running`` recovers);
+- **refcount leak / conservation break** (the PR 5 class: reclaim stripping
+  pages an in-flight dispatch was granted);
+- **parked-KV coverage break** (the PR 7 garbage-lane class in its
+  host-observable form: a parked slot no longer holding exactly its
+  prompt-covering pages means adoption would resume over corrupt KV).
+
+Every corruption is reverted so the module-scoped engine stays healthy
+between tests; the audit itself is read-only.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.invariants import (
+    InvariantViolation,
+    check_engine_invariants,
+    verify_engine,
+)
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.metrics import REGISTRY
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2)
+
+
+def make_engine(**kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG,
+        tokenizer=TOK,
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout="paged",
+        page_size=8,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+def counter(name: str) -> float:
+    m = REGISTRY._metrics.get(name)
+    return 0.0 if m is None else m.values.get((), 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+@pytest.fixture(scope="module")
+def eng():
+    """One armed paged engine, warmed with real traffic that leaves a
+    parked slot and live prefix-cache entries behind — the audit must hold
+    on the REAL state shapes, not an empty engine."""
+    e = make_engine(spec_len=4, prefill_chunk=16)
+    sp = SamplingParams(temperature=0.0, max_tokens=10)
+    futs = [
+        e.submit(f"hello world {i} " * 3, sp, park=(i == 0)) for i in range(4)
+    ]
+    for f in futs:
+        assert f.result(timeout=600).finish_reason in ("stop", "length")
+    yield e
+    e.stop()
+
+
+def _settle(e: Engine) -> None:
+    """Let the engine loop drain to idle so test-thread reads don't race a
+    dispatch in flight."""
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (e._has_work() or len(e._waiting)):
+        time.sleep(0.01)
+    time.sleep(0.05)
+
+
+def test_clean_engine_audits_clean_and_counts_checks(eng):
+    _settle(eng)
+    assert eng._parked_count == 1  # the fixture's parked conversation
+    assert verify_engine(eng) == []
+    # the engine ran armed through the fixture's traffic: every dispatch
+    # cycle audited, none tripped
+    assert counter("acp_engine_invariant_checks_total") > 0
+    assert counter("acp_engine_invariant_violations_total") == 0.0
+
+
+def test_mirror_drift_is_detected(eng):
+    _settle(eng)
+    eng._parked_count += 1
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._parked_count -= 1
+    assert any("mirror drift" in p and "_parked_count" in p for p in problems)
+
+    eng._prefilling_count += 1
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._prefilling_count -= 1
+    assert any("_prefilling_count" in p for p in problems)
+    assert verify_engine(eng) == []
+
+
+def test_refcount_leak_and_conservation_break_are_detected(eng):
+    _settle(eng)
+    refs = eng._allocator._refs
+    page = next(iter(refs))
+    refs[page] += 1  # a reference nothing owns: the page can never pool
+    try:
+        problems = verify_engine(eng)
+    finally:
+        refs[page] -= 1
+    assert any("refcount leak" in p for p in problems)
+
+    stolen = eng._allocator._free.pop()  # page vanishes from accounting
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._allocator._free.append(stolen)
+    assert any("vanished from accounting" in p for p in problems)
+    assert verify_engine(eng) == []
+
+
+def test_parked_kv_coverage_break_is_detected(eng):
+    _settle(eng)
+    slot = next(s for s, sl in eng._slots.items() if sl.parked)
+
+    # page list no longer covers the prompt cut (the host-observable shape
+    # of the PR 7 garbage-lane corruption of parked prompt KV)
+    page = eng._slot_pages[slot].pop()
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._slot_pages[slot].append(page)
+    assert any("parked slot" in p for p in problems)
+
+    # seq_len mirror diverging from the adoption cut
+    cut = int(eng._seq_lens[slot])
+    eng._seq_lens[slot] = cut + 1
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._seq_lens[slot] = cut
+    assert any("park_cut" in p for p in problems)
+    assert verify_engine(eng) == []
+
+
+def test_check_raises_and_counts(eng):
+    _settle(eng)
+    check_engine_invariants(eng)  # healthy: no raise
+    before = counter("acp_engine_invariant_violations_total")
+    eng._parked_count += 1
+    try:
+        with pytest.raises(InvariantViolation, match="mirror drift"):
+            check_engine_invariants(eng)
+    finally:
+        eng._parked_count -= 1
+    assert counter("acp_engine_invariant_violations_total") > before
+
+
+def test_invariant_break_fault_trips_end_to_end():
+    """The deterministic fault site corrupts a mirror inside the engine
+    loop; the armed checker must crash the engine, fail the in-flight
+    caller loudly, and leave the engine recoverable."""
+    eng = make_engine()
+    try:
+        # healthy round trip first (also compiles the programs)
+        assert eng.generate("ab", SamplingParams(max_tokens=2)).tokens
+        FAULTS.arm("engine.invariant_break")
+        fut = eng.submit("hello there", SamplingParams(temperature=0.0, max_tokens=48))
+        with pytest.raises(RuntimeError, match="invariant"):
+            fut.result(timeout=600)
+        assert eng._crashed
+        # phase-machine posture: rebuild serving state and carry on
+        assert eng.ensure_running()
+        out = eng.generate("hello again", SamplingParams(max_tokens=4))
+        assert out.finish_reason in ("stop", "length")
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_disarmed_fault_site_is_inert():
+    """Arming engine.invariant_break against a DISARMED engine must not
+    corrupt anything: the site is gated on check_invariants."""
+    eng = make_engine(check_invariants=False)
+    try:
+        FAULTS.arm("engine.invariant_break")
+        out = eng.generate("hello", SamplingParams(temperature=0.0, max_tokens=8))
+        assert out.finish_reason in ("stop", "length")
+        assert verify_engine(eng) == []  # mirrors untouched
+    finally:
+        eng.stop()

@@ -34,6 +34,10 @@ CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv
 
 def make_engine(kv_layout="paged", **kw):
     mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    # ACP_INVARIANTS posture for the whole fault suite: every
+    # fault-injection run double-checks the engine's bookkeeping after
+    # each dispatch cycle (engine/invariants.py)
+    kw.setdefault("check_invariants", True)
     eng = Engine(
         config=CFG,
         tokenizer=TOK,

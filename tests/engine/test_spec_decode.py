@@ -45,6 +45,9 @@ TOOL_ECHO = '{"tool": "search", "args": {"q": "x"}} {"tool": "search", "args": {
 
 def make_engine(kv_layout="slot", spec_len=8, max_ctx=256, **kw):
     mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    # ACP_INVARIANTS posture for the whole stress suite: every run
+    # double-checks the engine's bookkeeping after each dispatch cycle
+    kw.setdefault("check_invariants", True)
     kw.setdefault("prefill_buckets", (64, 256))
     eng = Engine(
         config=CFG,

@@ -46,7 +46,7 @@ def test_pipeline_forward_matches_plain_forward():
         mesh = make_mesh(axes, devices=jax.devices()[:n])
         params_pp, tokens_j, _ = _setup(mesh)
         out = jax.jit(
-            lambda p, t: pipeline_forward(p, t, TINY, mesh)
+            lambda p, t, mesh=mesh: pipeline_forward(p, t, TINY, mesh)
         )(params_pp, tokens_j)
         np.testing.assert_allclose(
             np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4,
