@@ -58,6 +58,7 @@ lint:
 	fi
 
 lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness contracts
-	$(PY) -m agentcontrolplane_tpu.analysis agentcontrolplane_tpu tests bench.py
+	$(PY) -m agentcontrolplane_tpu.analysis --metrics-docs docs/observability.md \
+		agentcontrolplane_tpu tests bench.py
 
 ci: lint lint-acp test dryrun

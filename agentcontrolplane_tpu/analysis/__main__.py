@@ -36,9 +36,25 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     ap.add_argument(
         "-q", "--quiet", action="store_true", help="suppress the summary line"
     )
+    ap.add_argument(
+        "--metrics-docs",
+        metavar="DOC",
+        default=None,
+        help="also check the acp_* metric inventory in this doc against "
+        "every Registry call in the package (both drift directions fail)",
+    )
     args = ap.parse_args(argv)
     paths = args.paths or [str(_PACKAGE_ROOT)]
     violations = analyze(paths, rules=args.rule)
+    if args.metrics_docs and not args.rule:
+        # a run scoped to specific rules (--rule) must not fail on
+        # inventory drift the caller didn't ask about
+        from .metrics_docs import check_metrics_docs
+
+        violations = sorted(
+            violations + check_metrics_docs(_PACKAGE_ROOT, args.metrics_docs),
+            key=lambda v: (v.path, v.line, v.rule),
+        )
     for v in violations:
         print(v)
     if not args.quiet:

@@ -1,0 +1,127 @@
+"""metrics-docs: the ``acp_*`` metric inventory must not rot.
+
+PR 6–8 each added engine metrics by hand and the docs/observability.md
+inventory drifted (prefix-cache hit/miss counters and the restart counter
+were registered but never documented). This check makes the sync a CI
+gate, acplint-style:
+
+- **code side** — every metric name is harvested from the AST: string
+  literals passed as the first argument to a ``Registry`` method call
+  (``counter_add`` / ``gauge_set`` / ``observe`` / ``gauge_remove``).
+  A NON-literal first argument is itself a violation: a dynamically built
+  metric name can't be inventoried (and label values, not name suffixes,
+  are how this registry does cardinality).
+- **docs side** — every ``acp_[a-z0-9_]+`` token in the inventory doc.
+
+Every code-registered name must appear in the doc and vice versa; either
+direction of drift is a violation pointing at the registration site (or
+the doc line). Runs stdlib-only from a bare checkout, like the rest of
+``analysis/`` (``make lint-acp`` / the ci target wire it in via
+``python -m agentcontrolplane_tpu.analysis --metrics-docs <doc>``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import Violation, dotted_name, iter_py_files
+
+REGISTRY_METHODS = {"counter_add", "gauge_set", "observe", "gauge_remove"}
+METRIC_RE = re.compile(r"\bacp_[a-z0-9_]+\b")
+
+
+def _is_registry_call(node: ast.Call) -> bool:
+    """``REGISTRY.observe(...)`` / ``metrics.REGISTRY.counter_add(...)`` —
+    the receiver chain must end in ``REGISTRY``, so unrelated ``observe``
+    methods (e.g. the spec controller's) don't false-positive."""
+    if not (
+        isinstance(node.func, ast.Attribute)
+        and node.func.attr in REGISTRY_METHODS
+    ):
+        return False
+    recv = dotted_name(node.func.value)
+    return recv is not None and recv.rsplit(".", 1)[-1] == "REGISTRY"
+
+
+def code_metric_names(package_root: str | Path) -> tuple[dict[str, tuple[str, int]], list[Violation]]:
+    """Harvest ``{metric name: (relpath, line)}`` of first registration per
+    name from every module under ``package_root``, plus violations for
+    dynamic (un-inventoriable) metric names."""
+    names: dict[str, tuple[str, int]] = {}
+    problems: list[Violation] = []
+    for path, rel in iter_py_files([package_root]):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # the main lint already reports parse errors
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and _is_registry_call(node)
+                and node.args
+            ):
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                name = first.value
+                if name.startswith("acp_") and name not in names:
+                    names[name] = (rel, node.lineno)
+            else:
+                problems.append(
+                    Violation(
+                        "metrics-docs",
+                        rel,
+                        node.lineno,
+                        f"{node.func.attr}() called with a non-literal metric "
+                        "name — dynamic names can't be inventoried against "
+                        "docs/observability.md (use labels for cardinality)",
+                    )
+                )
+    return names, problems
+
+
+def doc_metric_names(doc_path: str | Path) -> dict[str, int]:
+    """``{metric name: first line number}`` mentioned in the inventory doc."""
+    out: dict[str, int] = {}
+    text = Path(doc_path).read_text(encoding="utf-8")
+    for lineno, line in enumerate(text.splitlines(), 1):
+        for m in METRIC_RE.finditer(line):
+            out.setdefault(m.group(0), lineno)
+    return out
+
+
+def check_metrics_docs(package_root: str | Path, doc_path: str | Path) -> list[Violation]:
+    """Violations for both drift directions (empty = inventory in sync)."""
+    doc_path = Path(doc_path)
+    if not doc_path.exists():
+        return [Violation("metrics-docs", str(doc_path), 1, "inventory doc does not exist")]
+    registered, problems = code_metric_names(package_root)
+    documented = doc_metric_names(doc_path)
+    doc_rel = doc_path.as_posix()
+    for name, (rel, line) in sorted(registered.items()):
+        if name not in documented:
+            problems.append(
+                Violation(
+                    "metrics-docs",
+                    rel,
+                    line,
+                    f"metric {name} is registered here but missing from "
+                    f"{doc_rel} — document it (the inventory is the "
+                    "operator's dashboard contract)",
+                )
+            )
+    for name, line in sorted(documented.items()):
+        if name not in registered:
+            problems.append(
+                Violation(
+                    "metrics-docs",
+                    doc_rel,
+                    line,
+                    f"metric {name} is documented but no longer registered "
+                    "anywhere in the package — delete the stale entry or "
+                    "restore the metric",
+                )
+            )
+    return problems

@@ -24,8 +24,14 @@ flagged unless lock-guarded.
 
 Separately, in ``server/`` modules (the scrape side), reaching into
 ``engine._anything`` is flagged outright — REST code must consume
-``stats()`` and public counters, never engine internals. Test files are
-exempt (white-box by design).
+``stats()`` and public counters, never engine internals. This covers
+CHAINED reaches too (``engine.flight._events``,
+``engine._allocator.audit()``): the flight recorder hangs off the engine
+as a public attribute, and its ring buffer / per-request index are just as
+engine-owned as the slot dict — server code must go through the
+recorder's declared cross-thread read methods (``events()`` /
+``timeline()`` / ``stats()``), never its privates. Test files are exempt
+(white-box by design).
 """
 
 from __future__ import annotations
@@ -192,12 +198,22 @@ class ThreadOwnershipPass(LintPass):
                 isinstance(node, ast.Attribute)
                 and node.attr.startswith("_")
                 and not node.attr.startswith("__")
-                and isinstance(node.value, ast.Name)
-                and node.value.id == "engine"
+                and self._rooted_in_engine(node.value)
             ):
                 yield self.violation(
                     sf,
                     node,
-                    f"server code reaches into engine.{node.attr} — the "
-                    "scrape surface is stats() and public counters only",
+                    f"server code reaches into engine...{node.attr} — the "
+                    "scrape surface is stats(), public counters, and the "
+                    "flight recorder's declared cross-thread read methods",
                 )
+
+    @staticmethod
+    def _rooted_in_engine(node: ast.AST) -> bool:
+        """True when an attribute chain's root Name is ``engine`` — catches
+        both the direct ``engine._slots`` reach and chained ones through
+        public handles (``engine.flight._events``: the recorder's privates
+        are engine-thread-written state just like the slot dict)."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id == "engine"

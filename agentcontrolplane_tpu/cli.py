@@ -13,6 +13,7 @@ operation replaces that with one binary:
   acp-tpu approvals [approve|reject <call-id> [--comment ...]]
   acp-tpu contacts [respond <call-id> <text>]
   acp-tpu task create <agent> <message> [--follow]
+  acp-tpu timeline [request-id]   (engine flight recorder)
 """
 
 from __future__ import annotations
@@ -671,6 +672,56 @@ def cmd_engine(args) -> int:
         return 0
 
 
+def cmd_timeline(args) -> int:
+    """Flight-recorder introspection: with a request id, replay that
+    request's full decision sequence (admit, chunks, preempts, park/adopt,
+    finish) with derived phase latencies; without one, show the recent
+    window and the request ids whose timelines are queryable."""
+    with _client(args) as http:
+        if not args.request_id:
+            resp = http.get("/v1/engine/flight", params={"last": str(args.last)})
+            if resp.status_code != 200:
+                print(f"error: {resp.text}", file=sys.stderr)
+                return 1
+            doc = resp.json()
+            print(
+                f"flight recorder: {doc['window_events']}/{doc['capacity']} "
+                f"events windowed, {doc['recorded_total']} recorded total, "
+                f"enabled={doc['enabled']}"
+            )
+            if doc.get("request_ids"):
+                print("recent request ids: " + " ".join(doc["request_ids"]))
+            for e in doc["events"]:
+                _print_flight_event(e)
+            return 0
+        resp = http.get(f"/v1/requests/{args.request_id}/timeline")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        doc = resp.json()
+        print(f"request {doc['request_id']}  total {doc['total_s'] * 1e3:.1f}ms")
+        for e in doc["events"]:
+            _print_flight_event(e, rel_key="t_rel")
+        if doc.get("phases"):
+            print("phases (sum ~ end-to-end; tool_overlap_hidden overlaps decode):")
+            for phase, dur in doc["phases"].items():
+                print(f"  {phase:<22}{dur * 1e3:>10.1f}ms")
+        return 0
+
+
+def _print_flight_event(e: dict, rel_key: str | None = None) -> None:
+    stamp = (
+        f"+{e[rel_key] * 1e3:9.1f}ms" if rel_key and rel_key in e
+        else f"t={e['t']:.3f}"
+    )
+    who = e.get("rid", "-")
+    slot = f"slot {e['slot']}" if "slot" in e else ""
+    detail = ""
+    if e.get("detail"):
+        detail = " ".join(f"{k}={v}" for k, v in e["detail"].items())
+    print(f"  {stamp}  {e['kind']:<20}{who:<10}{slot:<9}{detail}")
+
+
 def build_parser() -> argparse.ArgumentParser:
     p = argparse.ArgumentParser(prog="acp-tpu", description=__doc__)
     p.add_argument("--server", default=DEFAULT_SERVER, help="operator REST URL")
@@ -790,6 +841,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     eng = sub.add_parser("engine", help="TPU engine status")
     eng.set_defaults(fn=cmd_engine)
+
+    tl = sub.add_parser(
+        "timeline",
+        help="flight recorder: a request's lifecycle timeline (or, with no "
+        "id, the recent engine decision window)",
+    )
+    tl.add_argument("request_id", nargs="?", help="engine request id (rid)")
+    tl.add_argument(
+        "--last", type=int, default=50,
+        help="window events to show when no request id is given",
+    )
+    tl.set_defaults(fn=cmd_timeline)
 
     tr = sub.add_parser("train", help="LoRA fine-tune a checkpoint on a JSONL dataset")
     tr.add_argument("--checkpoint", required=True, help="HF checkpoint dir")

@@ -307,6 +307,11 @@ class TaskReconciler:
                 )
 
             send_kwargs["on_tool_call"] = _on_tool_call
+        if getattr(client, "supports_trace_context", False):
+            # provider: tpu — the engine's flight recorder exports its
+            # per-phase child spans under THIS LLMRequest span, so engine
+            # internals land in the Task's trace waterfall
+            send_kwargs["trace_context"] = span.context()
         try:
             response = await client.send_request(outbound, tools, **send_kwargs)
         except LLMRequestError as e:

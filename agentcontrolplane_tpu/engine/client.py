@@ -69,6 +69,11 @@ class TPUEngineClient(LLMClient):
         # execution starts, never what is generated.
         self.overlap_tool_calls = bool(overlap_tool_calls)
         self.supports_early_tool_calls = self.overlap_tool_calls
+        # the task controller passes its LLMRequest span context down
+        # (send_request trace_context=...); the engine's flight recorder
+        # then exports per-phase child spans under it, so engine internals
+        # appear in the Task's existing OTLP trace
+        self.supports_trace_context = True
         # LLM.spec.tpu.requestTimeoutSeconds — mirrors the reference's 30 s
         # LLMRequestTimeout (task_controller.go:25): a wedged generation
         # fails the request (5xx -> reconciler retry) instead of holding the
@@ -105,7 +110,11 @@ class TPUEngineClient(LLMClient):
         return forced_call_prefix(self.engine.tokenizer, tools, self.tool_choice)
 
     async def send_request(
-        self, messages: list[Message], tools: list[Tool], on_tool_call=None
+        self,
+        messages: list[Message],
+        tools: list[Tool],
+        on_tool_call=None,
+        trace_context=None,
     ) -> Message:
         """``on_tool_call`` (optional, honored when ``overlap_tool_calls``):
         called on the event loop as ``(index, MessageToolCall)`` for each
@@ -159,6 +168,9 @@ class TPUEngineClient(LLMClient):
             # (arriving as soon as the overlapped tools complete) adopts
             # it and prefills only the suffix
             park=overlap,
+            # engine phase spans (flight recorder) parent under the
+            # caller's LLMRequest span when one is provided
+            trace=trace_context,
         )
         try:
             result = await self._await_result(future)

@@ -151,6 +151,13 @@ class _Request:
     # wall-clock of the FIRST first-token (survives preemption: TTFT and
     # the ttft metric are observed once per request, not once per resume)
     first_token_at: float = 0.0
+    # OTLP trace linkage (SpanContext-like or {"trace_id","span_id"} dict):
+    # at finish, the flight recorder exports this request's phase windows
+    # as child spans under it — engine internals join the Task's trace
+    trace: Optional[object] = None
+    # prewarm requests skip per-request flight events and phase histograms
+    # (hundreds of synthetic requests would drown the real timelines)
+    prewarm: bool = False
     # completed (True) when the request takes a slot (prefill starts).
     # Clients key their generation timeout off this, so queue wait under
     # saturation doesn't eat the per-request budget (mirrored onto
@@ -600,6 +607,13 @@ class Engine:
         from ..faults import FAULTS as _faults
 
         self._faults = _faults
+        # flight recorder (observability/flight.py): ring-buffer record of
+        # every scheduler decision, always on (ACP_FLIGHT=0 disables for
+        # bench A/B). Public attribute: the REST/CLI introspection surface
+        # reads it via its own cross-thread-safe methods.
+        from ..observability.flight import FlightRecorder
+
+        self.flight = FlightRecorder()
         self.check_invariants = (
             bool(check_invariants)
             if check_invariants is not None
@@ -928,6 +942,7 @@ class Engine:
             self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
             self._thread.start()
             REGISTRY.counter_add("acp_engine_restarts_total", 1.0)
+            self.flight.record("restart")
             return True
 
     def submit(
@@ -938,6 +953,7 @@ class Engine:
         timeout_s: Optional[float] = None,
         on_tool_call=None,
         park: bool = False,
+        trace=None,
         _prewarm: bool = False,
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
@@ -982,12 +998,17 @@ class Engine:
             # next turn's prompt can never extend them, so parking would
             # pin pages that no adoption can ever use
             park=bool(park) and self.park_max_s > 0 and not truncated,
+            trace=trace,
+            prewarm=bool(_prewarm),
         )
         if on_tool_call is not None:
             from .toolparse import ToolStreamParser
 
             req.tool_parser = ToolStreamParser()
         req.future.early_tool_calls = req.early_calls  # type: ignore[attr-defined]
+        # rid rides the future from birth — cancel() keys on it, and a shed
+        # request's flight timeline is only findable through it
+        req.future.rid = req.rid  # type: ignore[attr-defined]
         if self._coord_follower:
             # any locally-originated request (prewarm included) would break
             # lockstep — followers only replay the leader's frame stream
@@ -999,6 +1020,11 @@ class Engine:
         if self._thread is None or self._stopping:
             req.future.set_exception(RuntimeError("engine is not running"))
             return req.future
+        if not _prewarm:
+            self.flight.record(
+                "submit", rid=req.rid, prompt_tokens=len(tokens),
+                timeout_s=timeout_s, park=req.park,
+            )
         # bounded admission: shed instead of queueing unboundedly. Depth is
         # a racy-but-safe over/under-count by at most the in-flight burst;
         # the cap is an overload valve, not an exact semaphore.
@@ -1009,6 +1035,7 @@ class Engine:
             depth = self._queue.qsize() + len(self._waiting)
             if forced_full or (self.max_queue and depth >= self.max_queue):
                 REGISTRY.counter_add("acp_engine_shed_requests_total", 1.0)
+                self.flight.record("shed", rid=req.rid, depth=depth)
                 req.future.set_exception(EngineOverloadedError(
                     f"admission queue full ({depth} waiting, cap "
                     f"{self.max_queue}); retry later",
@@ -1016,10 +1043,10 @@ class Engine:
                     # floored at 1s — advisory, clients may back off harder
                     retry_after_s=max(1.0, min(30.0, depth * 0.25)),
                 ))
+                self.flight.discard(req.rid)  # timeline ends at the shed
                 return req.future
         self._outstanding.add(req.future)
         req.future.add_done_callback(self._outstanding.discard)
-        req.future.rid = req.rid  # type: ignore[attr-defined]  # cancel() handle
         req.future.admitted = req.admitted  # type: ignore[attr-defined]
         self._queue.put(req)
         return req.future
@@ -1327,6 +1354,9 @@ class Engine:
                 name: int(size)
                 for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
             },
+            # flight recorder occupancy (the recorder's own methods take
+            # its lock; self.flight is a public attribute, never mutated)
+            "flight": self.flight.stats(),
         }
         if self.kv_layout == "paged":
             out["kv_pages"] = {
@@ -1400,6 +1430,12 @@ class Engine:
                     check_engine_invariants(self)
         except Exception as e:  # an engine crash must not hang callers
             log.exception("engine loop crashed")
+            # flight-record the crash and snapshot the black box BEFORE any
+            # state is torn down — the dump must show the engine as the
+            # crash found it (last-N events + stats + allocator audit);
+            # ACP_FLIGHT_DUMP_DIR unset (the default) skips the file
+            self.flight.record("crash", error=repr(e))
+            self.flight.dump_crash(self, e)
             self._slots.clear()
             self._parked_count = 0
             self._prefilling_count = 0
@@ -1424,6 +1460,9 @@ class Engine:
                 fut.set_exception(RuntimeError("engine stopped"))
         for slot in list(self._slots):
             self._finish(slot, "stop")
+        # drop whatever live timelines the drain didn't retire (the global
+        # window keeps the raw events — including for the crash dump above)
+        self.flight.discard_live()
 
     @contextlib.contextmanager
     def hold_admission(self):
@@ -1544,6 +1583,9 @@ class Engine:
                 if r.rid in self._applied_cancels:
                     self._applied_cancels.discard(r.rid)
                     r.future.cancel()
+                    if not r.prewarm:
+                        self.flight.record("cancel", rid=r.rid, where="queued")
+                        self.flight.discard(r.rid)
                 else:
                     kept.append(r)
             self._waiting = kept
@@ -1605,6 +1647,7 @@ class Engine:
                     self._expiry_message(r)
                 ))
                 REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+                self._record_expire(r, "queued")
                 self._cancelled.add(r.rid)  # rides the next published frame
             return
         gone = {id(r) for r in expired}
@@ -1613,6 +1656,15 @@ class Engine:
         for r in expired:
             r.future.set_exception(DeadlineExceededError(self._expiry_message(r)))
             REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+            self._record_expire(r, "queued")
+
+    def _record_expire(self, req: _Request, where: str) -> None:
+        """Flight-record a deadline expiry and retire the timeline (the
+        request is terminal; its phases end at the expiry)."""
+        if req.prewarm:
+            return
+        self.flight.record("expire", rid=req.rid, where=where)
+        self.flight.discard(req.rid)
 
     @staticmethod
     def _expiry_message(req: _Request) -> str:
@@ -1663,6 +1715,17 @@ class Engine:
                 elif self._prefix_enabled and not req.truncated:
                     self._prefix_misses += 1
                     REGISTRY.counter_add("acp_engine_prefix_cache_miss_requests", 1.0)
+                if not req.prewarm:
+                    # admit = the reservation decision: slot id (+ pages in
+                    # paged mode) taken, prefix-cache start resolved. In
+                    # chunked mode no model compute has run yet (reserve)
+                    self.flight.record(
+                        "admit", rid=req.rid, slot=slot,
+                        start=start, pages=len(_pages) if _pages else 0,
+                        resumed=req.preempt_count > 0,
+                        adopted=bool(match is not None and match[1].get("in_slot")),
+                        chunked=bool(self.prefill_chunk),
+                    )
                 enriched.append([item, start])
             if self.kv_layout == "paged":
                 # block tables must exist before spill chunks reference them
@@ -1908,6 +1971,7 @@ class Engine:
                 "deadline expired mid-prefill (partial prompt KV released)"
             ))
             REGISTRY.counter_add("acp_engine_deadline_expired_total", 1.0)
+            self._record_expire(req, "mid_prefill")
             if self._coordination is not None:
                 self._cancelled.add(req.rid)  # rides the next published frame
             else:
@@ -1953,7 +2017,7 @@ class Engine:
             )
             if spec is not None:
                 victim = max(pre, key=lambda t: (t[1].prefill_pos, t[0]))[0]
-                self._preempt(victim)
+                self._preempt(victim, reason="fault")
                 pre = [(s, sl) for s, sl in self._slots.items() if sl.prefilling]
                 if not pre:
                     return 0
@@ -2004,6 +2068,20 @@ class Engine:
             sl.prefill_pos = st + n
             self._seq_lens[slot] = sl.prefill_pos
         self.prefill_chunks += len(sched)
+        if self.flight.enabled:
+            # the EDF pick + budget spend this cycle: one event per chunk
+            # (tagged per request) plus the round's budget accounting
+            for slot, sl, st, n in sched:
+                if not sl.request.prewarm:
+                    self.flight.record(
+                        "prefill_chunk", rid=sl.request.rid, slot=slot,
+                        start=st, n=n,
+                        final=st + n >= len(sl.prefill_row or ()),
+                    )
+            self.flight.record(
+                "prefill_round", scheduled=len(sched), spent=spent,
+                budget=chunk_budget,
+            )
         REGISTRY.counter_add(
             "acp_engine_prefill_chunks_total", float(len(sched)),
             help="prefill chunk dispatches (per-slot chunks) under the "
@@ -2237,6 +2315,11 @@ class Engine:
                 # prefix can never complete, so fail it up front
                 if self._seed_con_state(s.forced_prefix) < 0:
                     self._waiting.popleft()
+                    if not req.prewarm:
+                        self.flight.record(
+                            "cancel", rid=req.rid, where="illegal_prefix"
+                        )
+                        self.flight.discard(req.rid)
                     req.future.set_exception(
                         RuntimeError("forced_prefix is not a legal JSON prefix")
                     )
@@ -2477,11 +2560,20 @@ class Engine:
             first_tok = int(firsts[i])
             self._con_states[slot] = int(con_states[i])
             self._constrained[slot] = bool(s.json_only)
-            if req.first_token_at == 0.0:
+            is_first = req.first_token_at == 0.0
+            if is_first:
                 req.first_token_at = now
                 REGISTRY.observe(
                     "acp_engine_ttft_seconds", now - req.enqueued,
                     help="time to first token",
+                )
+            if not req.prewarm:
+                # prefill complete: prompt KV resident, first token sampled.
+                # For a resumed request this is also the end of its
+                # preempt_stall window (phase attribution keys on it).
+                self.flight.record(
+                    "prefill_done", rid=req.rid, slot=slot,
+                    seq=int(full_lens[i]), first=is_first,
                 )
             prior = self._slots.get(slot)
             if prior is not None and prior.prefilling:
@@ -2702,7 +2794,7 @@ class Engine:
             ),
         )
 
-    def _preempt(self, slot: int) -> None:
+    def _preempt(self, slot: int, reason: str = "pool_pressure") -> None:
         """Evacuate an active slot under pool pressure WITHOUT finishing
         it: save its sampled-so-far tokens and scheduling state on the
         request, free its pages, and requeue it at the front of the
@@ -2712,7 +2804,7 @@ class Engine:
         if self._slots[slot].parked:
             # a parked slot has nothing to save or requeue — its future
             # resolved at park time; the "preemption" is a pure release
-            self._release_parked(slot)
+            self._release_parked(slot, reason=reason)
             return
         sl = self._slots.pop(slot)
         if sl.prefilling:
@@ -2739,6 +2831,13 @@ class Engine:
             "acp_engine_preemptions_total", 1.0,
             help="slots preempted (and requeued) under KV pool pressure",
         )
+        if not req.prewarm:
+            # the victim + why: the decision the post-mortem always wants
+            self.flight.record(
+                "preempt", rid=req.rid, slot=slot, reason=reason,
+                sampled=len(req.resume_tokens), count=req.preempt_count,
+                mid_prefill=sl.prefilling,
+            )
         # a request too big for the WHOLE pool can never be resumed — the
         # resume prefill itself would not fit. Finish honestly at current
         # length (this is real memory exhaustion, not contention; the old
@@ -2780,6 +2879,11 @@ class Engine:
             latency_ms=(now - req.enqueued) * 1e3,
             preempt_count=req.preempt_count,
         )
+        if not req.prewarm:
+            self.flight.finish(
+                req.rid, "length", trace=req.trace,
+                tokens=len(gen), preempts=req.preempt_count,
+            )
         if not req.future.done():
             req.future.set_result(result)
         REGISTRY.counter_add("acp_engine_requests_total", 1.0)
@@ -2801,7 +2905,7 @@ class Engine:
             if spec is not None:
                 victim = self._pick_victim()
                 if victim is not None:
-                    self._preempt(victim)
+                    self._preempt(victim, reason="fault")
         if not self._n_active():
             return
         # speculative decoding: when enabled and at least one slot has a
@@ -2899,6 +3003,9 @@ class Engine:
         # tok_block: [K, W]
         K = tok_block.shape[0]
         self.decode_steps += K
+        # one event per decode dispatch (batch-level, not per slot/token):
+        # a timeline reader sees the cadence, not a flood
+        self.flight.record("decode_block", width=W, steps=K, active=self._n_active())
         for slot, sl in list(self._slots.items()):
             if sl.parked or sl.prefilling:
                 continue  # parked/mid-prefill lanes were not in this dispatch
@@ -2977,6 +3084,12 @@ class Engine:
             idx = len(req.early_calls)
             req.early_calls.append((now, tc))
             self.tool_calls_early += 1
+            if not req.prewarm:
+                # the emit edge of this call's tool_overlap_hidden window
+                self.flight.record(
+                    "tool_call", rid=req.rid, index=idx,
+                    name=tc.function.name,
+                )
             REGISTRY.counter_add(
                 "acp_engine_tool_calls_early_total", 1.0,
                 help="tool calls emitted from the decode stream before "
@@ -3204,6 +3317,16 @@ class Engine:
                 )
             if n > 0:
                 self._consume_tokens(slot, sl, (int(t) for t in out_toks[slot, :n]))
+        if self.flight.enabled:
+            # one aggregate event per verify dispatch: the propose/verify/
+            # accept decision, with how much the drafts actually paid
+            self.flight.record(
+                "spec_verify",
+                slots=int(sum(1 for d in drafts.values() if d)),
+                proposed=int(sum(len(d) for d in drafts.values())),
+                emitted=int(sum(int(n_emit[s]) for s in drafts)),
+                forced_reject=force_reject,
+            )
         self._publish_decode_gauges()
         return True
 
@@ -3214,7 +3337,7 @@ class Engine:
         if sl.parked:
             # the future resolved when the slot parked; a finish now is a
             # cancel/stop/drain — release the lingering bookkeeping
-            self._release_parked(slot)
+            self._release_parked(slot, reason=reason)
             return
         if sl.prefilling:
             # a finish can only reach a mid-prefill slot via cancel, a
@@ -3225,6 +3348,12 @@ class Engine:
             req = sl.request
             self._cancelled.discard(req.rid)
             self._applied_cancels.discard(req.rid)
+            if not req.prewarm:
+                self.flight.record(
+                    "cancel", rid=req.rid, slot=slot, where="mid_prefill",
+                    reason=reason,
+                )
+                self.flight.discard(req.rid)
             if not req.future.done():
                 if reason == "cancelled":
                     req.future.cancel()
@@ -3267,9 +3396,9 @@ class Engine:
         if self.kv_layout == "paged":
             self._allocator.free(self._slot_pages.pop(slot, []))
             self._block_tables[slot, :] = TRASH_PAGE
-        self._resolve_result(sl, reason)
+        self._resolve_result(sl, reason, slot=slot)
 
-    def _resolve_result(self, sl: _Slot, reason: str) -> None:
+    def _resolve_result(self, sl: _Slot, reason: str, slot: int = -1) -> None:
         """Resolve a slot's future with its GenerationResult — shared by the
         normal finish and the park transition (a parked slot's caller gets
         its result immediately; only the KV bookkeeping lingers)."""
@@ -3286,6 +3415,16 @@ class Engine:
             latency_ms=(now - sl.request.enqueued) * 1e3,
             preempt_count=sl.request.preempt_count,
         )
+        if not sl.request.prewarm:
+            # terminal flight event + phase attribution export (histograms
+            # and, when the request carried a trace context, OTLP child
+            # spans under the Task's trace). BEFORE the future resolves, so
+            # a caller that immediately queries /timeline sees a complete
+            # record instead of racing the engine thread.
+            self.flight.finish(
+                sl.request.rid, reason, slot=slot, trace=sl.request.trace,
+                tokens=len(gen), preempts=sl.request.preempt_count,
+            )
         if not sl.request.future.done():
             sl.request.future.set_result(result)
         REGISTRY.counter_add("acp_engine_requests_total", 1.0)
@@ -3342,10 +3481,12 @@ class Engine:
             help="slots parked at generation end awaiting the "
             "conversation's next turn",
         )
+        if not req.prewarm:
+            self.flight.record("park", rid=req.rid, slot=slot, cut=cut)
         self._publish_park_gauge()
-        self._resolve_result(sl, reason)
+        self._resolve_result(sl, reason, slot=slot)
 
-    def _release_parked(self, slot: int) -> None:
+    def _release_parked(self, slot: int, reason: str = "pressure") -> None:
         """Free a parked slot entirely (pressure, expiry, stop, or a
         forced preemption landing on it). The future resolved at park
         time, so this is pure bookkeeping — the voluntary, no-victim-scan
@@ -3353,6 +3494,14 @@ class Engine:
         sl = self._slots.get(slot)
         if sl is None or not sl.parked:
             return
+        if not sl.request.prewarm:
+            self.flight.record(
+                "park_release", rid=sl.request.rid, slot=slot, reason=reason
+            )
+            # the rid's timeline was retired when the park resolved its
+            # future — retire the release event too (extends the finished
+            # timeline) instead of leaving an orphan live entry
+            self.flight.discard(sl.request.rid)
         self._slots.pop(slot)
         self._parked_count -= 1
         self._state_dirty = True
@@ -3392,7 +3541,7 @@ class Engine:
             if sl.parked and now - sl.parked_at > self.park_max_s  # acp-lint: disable=coord-wallclock
         ]
         for slot in expired:
-            self._release_parked(slot)
+            self._release_parked(slot, reason="expired")
 
     def _match_parked(self, req: _Request) -> Optional[int]:
         """Parked slot whose prompt KV covers the longest prefix of this
@@ -3421,6 +3570,12 @@ class Engine:
         if total_pages <= self._allocator.num_pages - 1:
             return False
         self._waiting.popleft()
+        if not req.prewarm:
+            self.flight.record(
+                "cancel", rid=req.rid, where="oversize",
+                pages_needed=total_pages,
+            )
+            self.flight.discard(req.rid)
         req.future.set_exception(
             RuntimeError(
                 f"prompt needs {total_pages} KV pages but the pool has "
@@ -3459,6 +3614,8 @@ class Engine:
         self._slots.pop(slot)  # the new turn takes the slot over in place
         self._parked_count -= 1
         self.park_adoptions += 1
+        if not req.prewarm:
+            self.flight.record("adopt", rid=req.rid, slot=slot, cut=cut)
         REGISTRY.counter_add(
             "acp_engine_park_adoptions_total", 1.0,
             help="parked slots adopted by their conversation's next turn "

@@ -270,6 +270,14 @@ def check_engine_invariants(engine) -> None:
             help="broken engine bookkeeping contracts detected by the "
             "armed invariant checker",
         )
+        # flight-record the violation itself so the crash dump (written by
+        # the engine loop's crash handler, flight.dump_crash) carries the
+        # violating event inline with the decisions that led to it
+        engine.flight.record(
+            "invariant_violation",
+            problems=len(problems),
+            first=problems[0][:200],
+        )
         raise InvariantViolation(
             "engine invariant violation(s):\n  " + "\n  ".join(problems)
         )

@@ -29,7 +29,12 @@ Engine sites (see ``engine/engine.py``):
   ``ACP_INVARIANTS`` audit trips end to end (engine crashes with
   ``InvariantViolation``; callers' futures fail; ``ensure_running``
   recovers). Gated on ``Engine.check_invariants`` so arming it against a
-  disarmed engine is a no-op instead of silent state corruption.
+  disarmed engine is a no-op instead of silent state corruption. With
+  ``$ACP_FLIGHT_DUMP_DIR`` set this site also proves the flight recorder's
+  crash-dump path end to end: the crash handler snapshots the last-N
+  flight events (including the ``invariant_violation`` event itself) +
+  ``Engine.stats()`` + the paged allocator audit to a JSON dump before the
+  loud crash (observability/flight.py, docs/debugging-guide.md).
 - ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:
   for the next ``times=N`` verify dispatches every draft token is treated
   as mismatched (full rejection), so each dispatch commits exactly one

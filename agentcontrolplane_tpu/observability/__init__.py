@@ -1,8 +1,10 @@
+from .flight import FlightRecorder, attribute_phases, phase_summaries
 from .metrics import REGISTRY, Registry
 from .otel_metrics import MetricsExporter
 from .tracing import NOOP_TRACER, Span, Tracer, new_span_id, new_trace_id
 
 __all__ = [
     "REGISTRY", "Registry", "MetricsExporter", "NOOP_TRACER", "Span", "Tracer",
-    "new_span_id", "new_trace_id",
+    "new_span_id", "new_trace_id", "FlightRecorder", "attribute_phases",
+    "phase_summaries",
 ]

@@ -104,7 +104,7 @@ class Registry:
         with self._lock:
             for m in self._metrics.values():
                 if m.help:
-                    out.append(f"# HELP {m.name} {m.help}")
+                    out.append(f"# HELP {m.name} {self._escape_help(m.help)}")
                 out.append(f"# TYPE {m.name} {m.type if m.type != 'histogram' else 'summary'}")
                 if m.type == "histogram":
                     for k, vals in self._hist_data.get(m.name, {}).items():
@@ -122,10 +122,31 @@ class Registry:
         return "\n".join(out) + "\n"
 
     @staticmethod
-    def _render_labels(k: tuple[tuple[str, str], ...]) -> str:
+    def _escape_label_value(value: str) -> str:
+        """Prometheus text-format label-value escaping: backslash, double
+        quote, and line feed must be escaped or a value like a model name
+        containing ``"`` (or a fault label carrying a newline) corrupts the
+        whole scrape — every series after it fails to parse."""
+        return (
+            str(value)
+            .replace("\\", "\\\\")
+            .replace('"', '\\"')
+            .replace("\n", "\\n")
+        )
+
+    @staticmethod
+    def _escape_help(text: str) -> str:
+        """HELP-line escaping per the text format: backslash and line feed
+        (a raw newline would split the HELP text into a garbage line)."""
+        return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+    @classmethod
+    def _render_labels(cls, k: tuple[tuple[str, str], ...]) -> str:
         if not k:
             return ""
-        inner = ",".join(f'{name}="{value}"' for name, value in k)
+        inner = ",".join(
+            f'{name}="{cls._escape_label_value(value)}"' for name, value in k
+        )
         return "{" + inner + "}"
 
     def snapshot(self) -> list[dict]:

@@ -101,8 +101,14 @@ class Tracer:
             attributes=dict(attributes or {}),
         )
 
-    def end_span(self, span: Span, status: str = "OK") -> None:
-        span.end_time = time.time()
+    def end_span(
+        self, span: Span, status: str = "OK", end_time: Optional[float] = None
+    ) -> None:
+        """Finish ``span`` (now, or at an explicit historical ``end_time``
+        — the flight recorder reconstructs engine phase spans from its
+        monotonic event stream after the fact, so both endpoints of those
+        spans are in the past)."""
+        span.end_time = time.time() if end_time is None else end_time
         span.status = status
         with self._lock:
             self.finished.append(span)

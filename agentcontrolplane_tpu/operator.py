@@ -113,6 +113,11 @@ class Operator:
         if isinstance(self.hl_factory, LocalHumanLayerClientFactory):
             self.human_backend = self.hl_factory.backend
         self.engine = self.options.engine
+        if self.engine is not None:
+            # flight-recorder OTLP linkage: finished requests' phase
+            # windows export as child spans through the operator's tracer
+            # (plain attribute replacement; None stays span-less)
+            self.engine.flight.tracer = self.tracer  # type: ignore[attr-defined]
         self.llm_factory = llm_factory or DefaultLLMClientFactory(engine=self.engine)
 
         self.manager = Manager(

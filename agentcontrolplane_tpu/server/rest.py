@@ -289,6 +289,8 @@ class RestServer:
         r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_get("/v1/models", self.list_models)
         r.add_get("/v1/engine", self.engine_status)
+        r.add_get("/v1/engine/flight", self.engine_flight)
+        r.add_get("/v1/requests/{rid}/timeline", self.request_timeline)
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
         r.add_get("/readyz", self.healthz)
@@ -1143,6 +1145,46 @@ class RestServer:
         if engine is None:
             return web.json_response({"configured": False})
         return web.json_response({"configured": True, **engine.stats()})
+
+    async def engine_flight(self, request: web.Request) -> web.Response:
+        """Flight-recorder window (token-authed like every non-health
+        route): the engine's recent scheduler decisions, last-N filterable
+        by event kind and/or request id. The recorder's read methods are
+        its cross-thread surface (they take the recorder lock)."""
+        engine = self.operator.engine
+        if engine is None:
+            return _json_error(503, "no TPU engine configured")
+        try:
+            last = int(request.query.get("last", "200"))
+        except ValueError:
+            return _json_error(400, "last must be an integer")
+        flight = engine.flight
+        return web.json_response({
+            **flight.stats(),
+            "request_ids": flight.request_ids(),
+            "events": flight.events(
+                last=last,
+                kind=request.query.get("kind") or None,
+                rid=request.query.get("rid") or None,
+            ),
+        })
+
+    async def request_timeline(self, request: web.Request) -> web.Response:
+        """One request's full lifecycle: every recorded scheduler decision
+        in monotonic order, plus the derived phase attribution
+        (queue_wait | prefill | decode | preempt_stall |
+        tool_overlap_hidden) whose durations sum to ~end-to-end latency."""
+        engine = self.operator.engine
+        if engine is None:
+            return _json_error(503, "no TPU engine configured")
+        doc = engine.flight.timeline_doc(request.match_info["rid"])
+        if doc is None:
+            return _json_error(
+                404,
+                "unknown request id (never recorded, or its timeline aged "
+                "out of the finished-request window)",
+            )
+        return web.json_response(doc)
 
     async def metrics(self, request: web.Request) -> web.Response:
         self._update_phase_gauges()

@@ -165,7 +165,60 @@ def test_thread_ownership_fires_on_server_scope_engine_reach(tmp_path):
     )
     violations = analyze([root])
     assert _rules(violations) == ["thread-ownership"]
-    assert "stats() and public counters only" in violations[0].message
+    assert "scrape surface is stats()" in violations[0].message
+
+
+def test_thread_ownership_fires_on_chained_server_scope_reach(tmp_path):
+    """The flight recorder extension: reaching a PRIVATE through a public
+    handle rooted at ``engine`` (engine.flight._events) is the same
+    ownership break as engine._slots — the recorder's ring buffer is
+    engine-written state and server code must use its declared
+    cross-thread read methods."""
+    root = _write(
+        tmp_path,
+        "server/handlers.py",
+        """
+        def scrape(engine):
+            raw = engine.flight._events       # chained private reach
+            ok = engine.flight.events()       # declared read method: fine
+            ok2 = engine.stats()              # public surface: fine
+            return len(raw), ok, ok2
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert "_events" in violations[0].message
+
+
+def test_flight_recorder_cross_thread_reads_lint_clean(tmp_path):
+    """The recorder's own posture — reads under its lock from methods
+    declared cross-thread — must pass the pass that polices it."""
+    root = _write(
+        tmp_path,
+        "flightish.py",
+        """
+        import threading
+
+        class Recorder:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._events = []
+
+            def record(self, kind):
+                with self._lock:
+                    self._events.append(kind)
+
+            def events(self):  # acp: cross-thread
+                with self._lock:
+                    return list(self._events)
+
+            def leaky(self):  # acp: cross-thread
+                return list(self._events)  # no lock: must fire
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert violations[0].line > 0 and "_events" in violations[0].message
 
 
 # -- rule: lane-defaults ------------------------------------------------------
@@ -443,3 +496,87 @@ def test_pragma_only_suppresses_the_named_rule(tmp_path):
 def test_parse_error_is_a_violation_not_a_crash(tmp_path):
     root = _write(tmp_path, "broken.py", "def f(:\n")
     assert _rules(analyze([root])) == ["parse-error"]
+
+
+# -- metrics-docs drift check -------------------------------------------------
+
+
+def test_metrics_docs_inventory_in_sync():
+    """The shipped tree's gate: every acp_* metric registered in the
+    package appears in docs/observability.md and vice versa (the same
+    check ``make lint-acp`` runs via --metrics-docs)."""
+    from agentcontrolplane_tpu.analysis.metrics_docs import check_metrics_docs
+
+    doc = PKG_ROOT.parent / "docs" / "observability.md"
+    violations = check_metrics_docs(PKG_ROOT, doc)
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_metrics_docs_fires_both_drift_directions(tmp_path):
+    from agentcontrolplane_tpu.analysis.metrics_docs import check_metrics_docs
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "from x import REGISTRY\n"
+        'REGISTRY.counter_add("acp_documented_total", 1.0)\n'
+        'REGISTRY.gauge_set("acp_undocumented_gauge", 2.0)\n'
+    )
+    doc = tmp_path / "inv.md"
+    doc.write_text("- `acp_documented_total` — fine.\n- `acp_ghost_total` — gone.\n")
+    rules = sorted(
+        (v.rule, "missing" if "missing from" in v.message else "stale")
+        for v in check_metrics_docs(pkg, doc)
+    )
+    assert rules == [("metrics-docs", "missing"), ("metrics-docs", "stale")]
+
+
+def test_metrics_docs_flags_dynamic_names_and_skips_non_registry(tmp_path):
+    from agentcontrolplane_tpu.analysis.metrics_docs import check_metrics_docs
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "m.py").write_text(
+        "from x import REGISTRY\n"
+        "name = 'acp_' + kind\n"
+        "REGISTRY.counter_add(name, 1.0)\n"      # dynamic: must fire
+        "controller.observe(prop, acc)\n"        # not REGISTRY: ignored
+    )
+    doc = tmp_path / "inv.md"
+    doc.write_text("nothing\n")
+    violations = check_metrics_docs(pkg, doc)
+    assert len(violations) == 1
+    assert "non-literal metric name" in violations[0].message
+
+
+def test_metrics_docs_missing_doc_is_a_violation(tmp_path):
+    from agentcontrolplane_tpu.analysis.metrics_docs import check_metrics_docs
+
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    violations = check_metrics_docs(pkg, tmp_path / "nope.md")
+    assert len(violations) == 1 and "does not exist" in violations[0].message
+
+
+def test_runner_metrics_docs_flag(tmp_path, capsys):
+    doc = PKG_ROOT.parent / "docs" / "observability.md"
+    assert lint_main([
+        "--quiet", "--metrics-docs", str(doc), str(PKG_ROOT / "analysis")
+    ]) == 0
+    stale = tmp_path / "stale.md"
+    stale.write_text("- `acp_engine_never_registered_total`\n")
+    assert lint_main([
+        "--quiet", "--metrics-docs", str(stale), str(PKG_ROOT / "analysis")
+    ]) == 1
+    assert "metrics-docs" in capsys.readouterr().out
+
+
+def test_rule_scoped_run_skips_metrics_docs(tmp_path, capsys):
+    """Review fix: --rule scoping must not fail on inventory drift the
+    caller didn't ask about."""
+    stale = tmp_path / "stale.md"
+    stale.write_text("- `acp_engine_never_registered_total`\n")
+    assert lint_main([
+        "--quiet", "--rule", "jit-purity", "--metrics-docs", str(stale),
+        str(PKG_ROOT / "analysis"),
+    ]) == 0

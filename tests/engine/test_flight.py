@@ -1,0 +1,442 @@
+"""Engine flight recorder: per-request lifecycle timelines, phase-latency
+attribution, OTLP child spans, and crash dumps.
+
+Acceptance contracts pinned here (ISSUE 10):
+
+- a request driven through preempt -> resume yields a timeline showing the
+  full decision sequence in monotonic order, its phase durations sum to
+  ~end-to-end latency, and the same phases appear as OTLP child spans
+  under the submitted trace context;
+- arming ``engine.invariant_break`` with ``ACP_FLIGHT_DUMP_DIR`` set
+  produces a crash dump containing the violating event's recent history;
+- ``ACP_FLIGHT=0`` / ``flight.enabled=False`` reduces recording to one
+  bool branch (no events).
+"""
+
+import dataclasses
+import glob
+import json
+import os
+import time
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.flight import (
+    FlightRecorder,
+    attribute_phases,
+)
+from agentcontrolplane_tpu.observability.tracing import Tracer
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2)
+
+
+def make_engine(kv_layout="paged", **kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG,
+        tokenizer=TOK,
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        page_size=8,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+class _Trace:
+    """SpanContext-shaped carrier without importing the API layer."""
+
+    def __init__(self, trace_id="ab" * 16, span_id="cd" * 8):
+        self.trace_id = trace_id
+        self.span_id = span_id
+
+
+def _wait_timeline(eng, rid, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        doc = eng.flight.timeline_doc(rid)
+        if doc is not None and any(e["kind"] == "finish" for e in doc["events"]):
+            return doc
+        time.sleep(0.02)
+    raise AssertionError(f"no finished timeline for rid {rid}")
+
+
+# -- the acceptance path: preempt -> resume ----------------------------------
+
+
+def test_preempt_resume_timeline_phases_and_spans():
+    """Force a preemption mid-decode; the victim's timeline must replay
+    submit -> admit -> prefill_done -> preempt -> (re)admit ->
+    prefill_done -> finish in monotonic order, its non-overlapping phase
+    durations (queue_wait + prefill + preempt_stall + decode) must sum to
+    ~its end-to-end latency, and the same phases must land as OTLP child
+    spans under the request's trace context."""
+    eng = make_engine(kv_pages=10)
+    tracer = Tracer(endpoint="")  # in-memory ring only
+    eng.flight.tracer = tracer
+    trace = _Trace()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcdef"]
+        futs = [eng.submit(p, sp, trace=trace) for p in prompts]
+        results = [f.result(timeout=120) for f in futs]
+        preempted = [f for f, r in zip(futs, results) if r.preempt_count > 0]
+        assert preempted, "tiny pool must have preempted at least one request"
+        fut = preempted[0]
+        doc = _wait_timeline(eng, fut.rid)
+
+        kinds = [e["kind"] for e in doc["events"]]
+        assert kinds[0] == "submit"
+        assert "preempt" in kinds
+        assert kinds[-1] == "finish"
+        # the full decision sequence: admitted, prefilled, preempted,
+        # re-admitted (resume), re-prefilled, finished
+        assert kinds.count("admit") >= 2
+        assert kinds.count("prefill_done") >= 2
+        assert kinds.index("admit") < kinds.index("preempt")
+        # monotonic ordering, both in seq and stamps
+        seqs = [e["seq"] for e in doc["events"]]
+        stamps = [e["t"] for e in doc["events"]]
+        assert seqs == sorted(seqs) and stamps == sorted(stamps)
+        # resume admission is marked as such
+        resumes = [
+            e for e in doc["events"]
+            if e["kind"] == "admit" and e["detail"].get("resumed")
+        ]
+        assert resumes, "the re-admission must be tagged resumed=True"
+
+        phases = doc["phases"]
+        assert phases.get("preempt_stall", 0.0) > 0.0
+        total = doc["total_s"]
+        summed = sum(v for k, v in phases.items() if k != "tool_overlap_hidden")
+        assert summed == pytest.approx(total, rel=0.05, abs=0.05)
+
+        spans = tracer.spans_for_trace(trace.trace_id)
+        rid_spans = [
+            s for s in spans if s.attributes.get("request_id") == fut.rid
+        ]
+        got = {s.name for s in rid_spans}
+        assert {"engine.queue_wait", "engine.prefill", "engine.decode",
+                "engine.preempt_stall"} <= got
+        for s in rid_spans:
+            assert s.parent_span_id == trace.span_id
+            assert s.end_time >= s.start_time
+    finally:
+        eng.stop()
+
+
+def test_plain_request_phases_sum_and_decode_blocks_recorded():
+    eng = make_engine(kv_layout="slot")
+    try:
+        fut = eng.submit("hello flight", SamplingParams(temperature=0.0, max_tokens=8))
+        fut.result(timeout=60)
+        doc = _wait_timeline(eng, fut.rid)
+        phases = doc["phases"]
+        assert set(phases) >= {"queue_wait", "prefill", "decode"}
+        summed = sum(v for k, v in phases.items() if k != "tool_overlap_hidden")
+        assert summed == pytest.approx(doc["total_s"], rel=0.05, abs=0.05)
+        # batch-level cadence events land in the window (not per-request)
+        assert eng.flight.events(kind="decode_block")
+    finally:
+        eng.stop()
+
+
+def test_park_adopt_timeline_and_window_filters():
+    """A parked turn and its adopting follow-up: the first request's
+    timeline ends in park + finish; the second's admission is tagged
+    adopted and the window exposes both events filterably."""
+    eng = make_engine(kv_layout="paged", park_max_s=30.0)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        first = eng.submit("conversation-prefix-" + "x" * 20, sp, park=True)
+        first.result(timeout=60)
+        doc1 = _wait_timeline(eng, first.rid)
+        kinds1 = [e["kind"] for e in doc1["events"]]
+        assert "park" in kinds1
+        # follow-up turn extends the parked prompt -> adoption
+        second = eng.submit(
+            "conversation-prefix-" + "x" * 20 + "more turn text", sp
+        )
+        second.result(timeout=60)
+        doc2 = _wait_timeline(eng, second.rid)
+        admit2 = [e for e in doc2["events"] if e["kind"] == "admit"]
+        adopted = any(e["detail"].get("adopted") for e in admit2)
+        adopt_events = eng.flight.events(kind="adopt")
+        assert adopted and adopt_events
+        assert all(e["kind"] == "adopt" for e in adopt_events)
+        # rid filter returns only that request's events
+        only = eng.flight.events(rid=second.rid, last=0)
+        assert only and all(e.get("rid") == second.rid for e in only)
+    finally:
+        eng.stop()
+
+
+def test_shed_and_deadline_expiry_recorded():
+    eng = make_engine(kv_layout="slot", max_queue=1)
+    try:
+        with eng.hold_admission():
+            sp = SamplingParams(temperature=0.0, max_tokens=4)
+            futs = [eng.submit("p" * 8, sp, timeout_s=60) for _ in range(4)]
+            shed = [f for f in futs if f.done() and f.exception() is not None]
+            assert shed
+            tl = eng.flight.timeline(shed[0].rid)
+            assert [e["kind"] for e in tl] == ["submit", "shed"]
+            # a queued request whose deadline passes fails fast and records
+            # (cap lifted so this one queues instead of shedding)
+            eng.max_queue = 0
+            doomed = eng.submit("q" * 8, SamplingParams(max_tokens=4), timeout_s=0.01)
+            time.sleep(0.05)
+        with pytest.raises(Exception):
+            doomed.result(timeout=30)
+        deadline = time.monotonic() + 10
+        tl = None
+        while time.monotonic() < deadline:
+            tl = eng.flight.timeline(doomed.rid)
+            if tl and tl[-1]["kind"] == "expire":
+                break
+            time.sleep(0.02)
+        assert tl and tl[-1]["kind"] == "expire"
+        assert tl[-1]["detail"]["where"] == "queued"
+        for f in futs:
+            if not f.done():
+                f.result(timeout=60)
+    finally:
+        eng.stop()
+
+
+# -- crash dumps -------------------------------------------------------------
+
+
+def test_invariant_break_produces_crash_dump_end_to_end(tmp_path, monkeypatch):
+    """faults.py's engine.invariant_break proves the dump path: the armed
+    checker trips, the crash handler writes the dump BEFORE failing
+    futures, and the dump holds the violating event's recent history +
+    engine stats + allocator audit; ensure_running then recovers."""
+    monkeypatch.setenv("ACP_FLIGHT_DUMP_DIR", str(tmp_path))
+    eng = make_engine(kv_layout="paged", check_invariants=True)
+    try:
+        eng.generate("warmup", SamplingParams(temperature=0.0, max_tokens=4))
+        FAULTS.arm("engine.invariant_break")
+        fut = eng.submit("boom", SamplingParams(temperature=0.0, max_tokens=8))
+        with pytest.raises(Exception, match="crash|invariant"):
+            fut.result(timeout=60)
+        dumps = sorted(glob.glob(str(tmp_path / "flightdump-*.json")))
+        assert dumps, "crash must write a flight dump when the dir is set"
+        doc = json.loads(open(dumps[-1]).read())
+        assert doc["error"]["type"] == "InvariantViolation"
+        kinds = [e["kind"] for e in doc["events"]]
+        assert "invariant_violation" in kinds
+        # the violating event sits inline with the request's history
+        assert "submit" in kinds and "admit" in kinds
+        assert "crash" in kinds
+        assert doc["engine_stats"]["max_slots"] == 4
+        audit = doc["allocator_audit"]
+        assert "free" in audit and "refcounts" in audit
+        # recovery: the engine serves again after ensure_running
+        assert eng.ensure_running()
+        r = eng.generate("after", SamplingParams(temperature=0.0, max_tokens=4))
+        assert r.tokens
+        assert any(e["kind"] == "restart" for e in eng.flight.events(kind="restart"))
+    finally:
+        eng.stop()
+
+
+def test_no_dump_dir_means_no_dump(tmp_path, monkeypatch):
+    monkeypatch.delenv("ACP_FLIGHT_DUMP_DIR", raising=False)
+    monkeypatch.chdir(tmp_path)
+    eng = make_engine(kv_layout="slot", check_invariants=True)
+    try:
+        FAULTS.arm("engine.crash")
+        fut = eng.submit("x" * 8, SamplingParams(temperature=0.0, max_tokens=4))
+        with pytest.raises(Exception):
+            fut.result(timeout=60)
+        assert not glob.glob(str(tmp_path / "flightdump-*.json"))
+    finally:
+        eng.stop()
+
+
+# -- recorder unit behavior --------------------------------------------------
+
+
+def test_disabled_recorder_records_nothing():
+    rec = FlightRecorder(enabled=False)
+    rec.record("submit", rid="r1")
+    assert rec.finish("r1", "stop") == {}
+    assert rec.events() == []
+    assert rec.timeline("r1") is None
+    assert rec.stats()["recorded_total"] == 0
+
+
+def test_env_knob_disables(monkeypatch):
+    monkeypatch.setenv("ACP_FLIGHT", "0")
+    assert FlightRecorder().enabled is False
+    monkeypatch.setenv("ACP_FLIGHT", "1")
+    assert FlightRecorder().enabled is True
+
+
+def test_window_capacity_and_finished_lru():
+    rec = FlightRecorder(capacity=16, finished_timelines=2)
+    for i in range(100):
+        rec.record("decode_block", width=i)
+    assert rec.stats()["window_events"] == 16
+    assert rec.stats()["recorded_total"] == 100
+    for rid in ("a", "b", "c"):
+        rec.record("submit", rid=rid)
+        rec.finish(rid, "stop")
+    assert rec.timeline("a") is None  # evicted from the finished LRU
+    assert rec.timeline("b") is not None and rec.timeline("c") is not None
+    assert rec.request_ids()[-2:] == ["b", "c"]
+
+
+def test_per_request_cap_bounds_timeline():
+    rec = FlightRecorder(capacity=4096, per_request_cap=8)
+    for _ in range(50):
+        rec.record("prefill_chunk", rid="big")
+    assert len(rec.timeline("big")) == 8
+
+
+def test_attribute_phases_tool_overlap_and_partial_histories():
+    evs = [
+        {"seq": 1, "t": 0.0, "kind": "submit"},
+        {"seq": 2, "t": 1.0, "kind": "admit"},
+        {"seq": 3, "t": 3.0, "kind": "prefill_done"},
+        {"seq": 4, "t": 4.0, "kind": "tool_call"},
+        {"seq": 5, "t": 9.0, "kind": "finish"},
+    ]
+    durations, windows = attribute_phases(evs)
+    assert durations["queue_wait"] == pytest.approx(1.0)
+    assert durations["prefill"] == pytest.approx(2.0)
+    assert durations["decode"] == pytest.approx(6.0)
+    assert durations["tool_overlap_hidden"] == pytest.approx(5.0)
+    assert ("tool_overlap_hidden", 4.0, 9.0) in windows
+    # partial: shed before admission -> no phases beyond the events
+    durations, _ = attribute_phases(
+        [{"seq": 1, "t": 0.0, "kind": "submit"},
+         {"seq": 2, "t": 0.5, "kind": "shed"}]
+    )
+    assert "prefill" not in durations and "decode" not in durations
+    # preempted and never resumed: the stall runs to the end
+    durations, _ = attribute_phases(
+        [{"seq": 1, "t": 0.0, "kind": "submit"},
+         {"seq": 2, "t": 1.0, "kind": "admit"},
+         {"seq": 3, "t": 2.0, "kind": "prefill_done"},
+         {"seq": 4, "t": 3.0, "kind": "preempt"},
+         {"seq": 5, "t": 7.0, "kind": "finish"}]
+    )
+    assert durations["preempt_stall"] == pytest.approx(4.0)
+    assert durations["decode"] == pytest.approx(1.0)
+
+
+def test_dump_crash_without_dir_returns_none(monkeypatch):
+    monkeypatch.delenv("ACP_FLIGHT_DUMP_DIR", raising=False)
+    rec = FlightRecorder()
+
+    class _E:
+        def stats(self):
+            return {}
+
+    assert rec.dump_crash(_E(), RuntimeError("x")) is None
+
+
+# -- trace propagation through the provider: tpu client ----------------------
+
+
+async def test_client_trace_context_yields_engine_child_spans():
+    """TPUEngineClient advertises supports_trace_context and threads the
+    caller's span context into Engine.submit — the finished request's
+    phase spans land under it (the Task-trace linkage the controller
+    uses)."""
+    from agentcontrolplane_tpu.api.resources import BaseConfig, Message, SpanContext
+    from agentcontrolplane_tpu.engine.client import TPUEngineClient
+
+    eng = make_engine(kv_layout="slot")
+    tracer = Tracer(endpoint="")
+    eng.flight.tracer = tracer
+    try:
+        client = TPUEngineClient(eng, BaseConfig(model="tiny", max_tokens=6))
+        assert client.supports_trace_context
+        ctx = SpanContext(trace_id="12" * 16, span_id="34" * 8)
+        msg = await client.send_request(
+            [Message(role="user", content="hi")], tools=[], trace_context=ctx
+        )
+        assert msg.role == "assistant"
+        # the future resolves before the engine thread exports spans
+        deadline = time.monotonic() + 10
+        spans = []
+        while time.monotonic() < deadline:
+            spans = tracer.spans_for_trace(ctx.trace_id)
+            if spans:
+                break
+            time.sleep(0.02)
+        names = {s.name for s in spans}
+        assert {"engine.queue_wait", "engine.prefill", "engine.decode"} <= names
+        assert all(s.parent_span_id == ctx.span_id for s in spans)
+    finally:
+        eng.stop()
+
+
+def test_park_release_extends_retired_timeline_without_orphan():
+    """Review fix: a park_release recorded AFTER the rid's timeline was
+    retired must extend the finished timeline (discard), never re-open a
+    live _by_rid entry — routine park expiries would otherwise leak one
+    orphan per release and shadow the finished timeline on /timeline."""
+    eng = make_engine(kv_layout="paged", park_max_s=0.05)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        fut = eng.submit("park-release-" + "y" * 20, sp, park=True)
+        fut.result(timeout=60)
+        _wait_timeline(eng, fut.rid)
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            tl = eng.flight.timeline(fut.rid)
+            if tl and tl[-1]["kind"] == "park_release":
+                break
+            time.sleep(0.02)
+        tl = eng.flight.timeline(fut.rid)
+        # the FULL lifecycle, finish and release both present — not a
+        # 1-event live entry shadowing the retired record
+        kinds = [e["kind"] for e in tl]
+        assert kinds[-1] == "park_release" and "finish" in kinds and "submit" in kinds
+        assert tl[-1]["detail"]["reason"] == "expired"
+        assert eng.flight.stats()["live_requests"] == 0
+    finally:
+        eng.stop()
+
+
+def test_attribute_phases_mid_prefill_stall_carves_prefill_not_decode():
+    """Review fix: a preemption BEFORE the first token closes its stall at
+    the first prefill_done — inside the prefill window — so the stall must
+    subtract from prefill, not decode (which it never overlapped)."""
+    durations, _ = attribute_phases(
+        [{"seq": 1, "t": 0.0, "kind": "submit"},
+         {"seq": 2, "t": 1.0, "kind": "admit"},
+         {"seq": 3, "t": 2.0, "kind": "preempt"},      # mid-prefill victim
+         {"seq": 4, "t": 12.0, "kind": "prefill_done"},  # resume's first token
+         {"seq": 5, "t": 15.0, "kind": "finish"}]
+    )
+    assert durations["queue_wait"] == pytest.approx(1.0)
+    assert durations["preempt_stall"] == pytest.approx(10.0)
+    assert durations["prefill"] == pytest.approx(1.0)   # 11 - 10 stall
+    assert durations["decode"] == pytest.approx(3.0)    # untouched
+    total = sum(v for k, v in durations.items() if k != "tool_overlap_hidden")
+    assert total == pytest.approx(15.0)

@@ -1,0 +1,65 @@
+"""Prometheus text-exposition escaping (ISSUE 10 satellite): label values
+containing ``\\``, ``"`` or newlines must render escaped per the text
+format spec — an unescaped model name or fault label corrupts the whole
+scrape (every series after it fails to parse)."""
+
+from agentcontrolplane_tpu.observability.metrics import Registry
+
+
+def _line_for(reg: Registry, name: str) -> str:
+    lines = [ln for ln in reg.render().splitlines() if ln.startswith(name + "{")]
+    assert len(lines) == 1, lines
+    return lines[0]
+
+
+def test_label_values_escape_backslash_quote_and_newline():
+    reg = Registry()
+    reg.gauge_set(
+        "acp_test_gauge", 1.0,
+        labels={"model": 'pa\\th"quoted"\nline2'},
+    )
+    line = _line_for(reg, "acp_test_gauge")
+    # escaped per spec: backslash first, then quote, then newline
+    assert '\\\\' in line and '\\"' in line and "\\n" in line
+    assert "\n" not in line  # one physical line — nothing raw leaked
+    assert line == 'acp_test_gauge{model="pa\\\\th\\"quoted\\"\\nline2"} 1.0'
+
+
+def test_histogram_series_labels_escaped_too():
+    reg = Registry()
+    reg.observe("acp_test_hist", 0.5, labels={"phase": 'pre"fill\n'})
+    rendered = reg.render()
+    for ln in rendered.splitlines():
+        if ln.startswith("acp_test_hist"):
+            assert '"pre\\"fill\\n"' in ln
+
+
+def test_help_text_newline_and_backslash_escaped():
+    reg = Registry()
+    reg.counter_add("acp_test_total", 1.0, help="line1\nline2 \\ tail")
+    help_lines = [
+        ln for ln in reg.render().splitlines() if ln.startswith("# HELP acp_test_total")
+    ]
+    assert help_lines == ["# HELP acp_test_total line1\\nline2 \\\\ tail"]
+
+
+def test_plain_values_unchanged():
+    reg = Registry()
+    reg.gauge_set("acp_plain", 2.0, labels={"kind": "Task", "phase": "Ready"})
+    assert _line_for(reg, "acp_plain") == 'acp_plain{kind="Task",phase="Ready"} 2.0'
+
+
+def test_scrape_stays_parseable_with_hostile_value():
+    """Every rendered line must still look like `name{labels} value` or a
+    comment — the corruption mode the escaping prevents is a label value
+    splitting one sample across physical lines."""
+    reg = Registry()
+    reg.gauge_set("acp_a", 1.0, labels={"v": 'x\n" 666\nacp_fake 1'})
+    reg.gauge_set("acp_b", 2.0)
+    lines = reg.render().strip().splitlines()
+    assert len(lines) == 4  # 2 TYPE comments + 2 samples
+    samples = [ln for ln in lines if not ln.startswith("#")]
+    assert len(samples) == 2
+    for ln in samples:
+        assert ln.rsplit(" ", 1)[1] in ("1.0", "2.0")
+    assert not any(ln.startswith("acp_fake") for ln in lines)

@@ -1,0 +1,159 @@
+"""Tracer coverage (ISSUE 10 satellite): the export loop, spans_for_trace,
+the max_finished eviction window, the no-endpoint graceful-degradation
+path, and the historical-end_time seam the flight recorder uses."""
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, HTTPServer
+
+import pytest
+
+from agentcontrolplane_tpu.api.resources import SpanContext
+from agentcontrolplane_tpu.observability.tracing import (
+    NOOP_TRACER,
+    Span,
+    Tracer,
+    new_span_id,
+    new_trace_id,
+)
+
+
+class _Collector:
+    """Tiny OTLP-HTTP sink capturing POSTed trace payloads."""
+
+    def __init__(self):
+        self.received: list[dict] = []
+        self.event = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (stdlib naming)
+                n = int(self.headers.get("Content-Length", 0))
+                outer.received.append(json.loads(self.rfile.read(n)))
+                outer.event.set()
+                self.send_response(200)
+                self.end_headers()
+
+            def log_message(self, *a):  # silence the test log
+                pass
+
+        self.server = HTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self.thread.start()
+        self.endpoint = f"http://127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+@pytest.fixture
+def collector():
+    c = _Collector()
+    yield c
+    c.close()
+
+
+def test_export_loop_posts_otlp_json(collector):
+    tracer = Tracer(endpoint=collector.endpoint)
+    span = tracer.start_span("Task", attributes={"task": "t1"})
+    child = tracer.start_span("LLMRequest", parent=span.context())
+    tracer.end_span(child)
+    tracer.end_span(span, "ERROR")
+    assert collector.event.wait(5.0), "export thread never delivered"
+    deadline = time.monotonic() + 5.0
+    while len(collector.received) < 2 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert len(collector.received) == 2
+    wire = collector.received[0]["resourceSpans"][0]["scopeSpans"][0]["spans"][0]
+    assert wire["name"] == "LLMRequest"
+    assert wire["traceId"] == span.trace_id
+    assert wire["parentSpanId"] == span.span_id
+    assert wire["endTimeUnixNano"] >= wire["startTimeUnixNano"]
+
+
+def test_no_endpoint_is_a_silent_noop():
+    tracer = Tracer(endpoint="")
+    span = tracer.start_span("Task")
+    tracer.end_span(span)  # must not raise, must not start an export thread
+    assert tracer._export_thread is None
+    assert tracer.spans_for_trace(span.trace_id) == [span]
+
+
+def test_unreachable_endpoint_degrades_silently():
+    tracer = Tracer(endpoint="http://127.0.0.1:1")  # nothing listens there
+    span = tracer.start_span("Task")
+    tracer.end_span(span)
+    time.sleep(0.2)  # the export thread swallows the connection error
+    assert tracer.spans_for_trace(span.trace_id) == [span]
+
+
+def test_spans_for_trace_filters_by_trace_id():
+    tracer = Tracer(endpoint="")
+    a = tracer.start_span("A")
+    b = tracer.start_span("B")
+    a_child = tracer.start_span("A.child", parent=a.context())
+    for s in (a, b, a_child):
+        tracer.end_span(s)
+    got = tracer.spans_for_trace(a.trace_id)
+    assert {s.name for s in got} == {"A", "A.child"}
+    assert tracer.spans_for_trace(new_trace_id()) == []
+
+
+def test_max_finished_eviction_window():
+    tracer = Tracer(max_finished=4, endpoint="")
+    spans = [tracer.start_span(f"s{i}") for i in range(8)]
+    for s in spans:
+        tracer.end_span(s)
+    kept = list(tracer.finished)
+    assert len(kept) == 4
+    assert [s.name for s in kept] == ["s4", "s5", "s6", "s7"]
+
+
+def test_end_span_historical_end_time():
+    """The flight recorder reconstructs phase spans after the fact — both
+    endpoints must be settable in the past."""
+    tracer = Tracer(endpoint="")
+    t0 = time.time() - 10.0
+    span = Span(
+        name="engine.prefill",
+        trace_id=new_trace_id(),
+        span_id=new_span_id(),
+        parent_span_id=new_span_id(),
+        start_time=t0,
+    )
+    tracer.end_span(span, end_time=t0 + 2.5)
+    assert span.end_time == pytest.approx(t0 + 2.5)
+    assert span.duration == pytest.approx(2.5)
+    assert tracer.spans_for_trace(span.trace_id) == [span]
+
+
+def test_parent_context_continuity():
+    tracer = Tracer(endpoint="")
+    root = tracer.start_span("Task")
+    ctx = SpanContext(trace_id=root.trace_id, span_id=root.span_id)
+    child = tracer.start_span("LLMRequest", parent=ctx)
+    assert child.trace_id == root.trace_id
+    assert child.parent_span_id == root.span_id
+    # empty parent context starts a fresh trace
+    fresh = tracer.start_span("X", parent=SpanContext(trace_id="", span_id=""))
+    assert fresh.trace_id != root.trace_id and fresh.parent_span_id == ""
+
+
+def test_noop_tracer_ignores_env(monkeypatch, collector):
+    monkeypatch.setenv("OTEL_EXPORTER_OTLP_ENDPOINT", collector.endpoint)
+    assert NOOP_TRACER.endpoint == ""  # constructed with explicit disable
+    tracer = Tracer()  # a fresh default tracer DOES read the env
+    assert tracer.endpoint == collector.endpoint
+
+
+def test_export_queue_full_drops_instead_of_blocking(collector):
+    tracer = Tracer(endpoint=collector.endpoint)
+    # wedge the queue by never letting the worker drain: stuff it directly
+    tracer._ensure_export_thread()
+    for _ in range(2000):
+        span = tracer.start_span("flood")
+        tracer.end_span(span)  # queue.Full path drops silently
+    # liveness is the contract: end_span never blocked; spans all finished
+    assert len(tracer.finished) >= 2000 or len(tracer.finished) == tracer.finished.maxlen

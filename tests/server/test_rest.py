@@ -716,3 +716,129 @@ async def test_chat_completions_sse_streams_early_tool_call_deltas():
             assert not any(d.get("content") for d in deltas)
     finally:
         eng.stop()
+
+
+async def test_flight_recorder_endpoints_and_auth():
+    """ISSUE 10: GET /v1/engine/flight and /v1/requests/{id}/timeline —
+    token-authed introspection over the engine flight recorder, with the
+    timeline's phase attribution summing to ~end-to-end latency."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    try:
+        fut = eng.submit("flight over rest", SamplingParams(temperature=0.0, max_tokens=6))
+        fut.result(timeout=60)
+        rid = fut.rid
+        for _ in range(100):
+            doc = eng.flight.timeline_doc(rid)
+            if doc and any(e["kind"] == "finish" for e in doc["events"]):
+                break
+            await asyncio.sleep(0.02)
+        h = RestHarness(api_token="sekret")
+        h.operator.engine = eng
+        async with h:
+            # token required (not a health route)
+            resp = await h.http.get(f"{h.base}/v1/engine/flight")
+            assert resp.status == 401
+            hdr = {"Authorization": "Bearer sekret"}
+            resp = await h.http.get(f"{h.base}/v1/engine/flight", headers=hdr)
+            assert resp.status == 200
+            flight = await resp.json()
+            assert flight["enabled"] is True and flight["window_events"] > 0
+            assert rid in flight["request_ids"]
+            kinds = {e["kind"] for e in flight["events"]}
+            assert {"submit", "admit", "prefill_done", "finish"} <= kinds
+            # last-N + kind filters
+            resp = await h.http.get(
+                f"{h.base}/v1/engine/flight?last=1&kind=finish", headers=hdr
+            )
+            filtered = (await resp.json())["events"]
+            assert len(filtered) == 1 and filtered[0]["kind"] == "finish"
+            resp = await h.http.get(
+                f"{h.base}/v1/engine/flight?last=bogus", headers=hdr
+            )
+            assert resp.status == 400
+            # per-request timeline with phase attribution
+            resp = await h.http.get(f"{h.base}/v1/requests/{rid}/timeline", headers=hdr)
+            assert resp.status == 200
+            tl = await resp.json()
+            assert tl["request_id"] == rid
+            assert [e["kind"] for e in tl["events"]][0] == "submit"
+            assert all(
+                a["seq"] < b["seq"]
+                for a, b in zip(tl["events"], tl["events"][1:])
+            )
+            summed = sum(
+                v for k, v in tl["phases"].items() if k != "tool_overlap_hidden"
+            )
+            assert abs(summed - tl["total_s"]) < 0.05
+            resp = await h.http.get(f"{h.base}/v1/requests/nope/timeline", headers=hdr)
+            assert resp.status == 404
+    finally:
+        eng.stop()
+
+
+async def test_flight_endpoints_503_without_engine():
+    async with RestHarness() as h:
+        assert (await h.http.get(f"{h.base}/v1/engine/flight")).status == 503
+        assert (await h.http.get(f"{h.base}/v1/requests/x/timeline")).status == 503
+
+
+async def test_cli_timeline_against_live_server(capsys):
+    """`acp-tpu timeline` (no arg: the window; with a rid: the lifecycle +
+    phase table) against a live server with a tiny engine."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.cli import main as cli_main
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    try:
+        fut = eng.submit("cli timeline drive", SamplingParams(temperature=0.0, max_tokens=6))
+        fut.result(timeout=60)
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            # blocking httpx must not run on the serving loop
+            rc = await asyncio.to_thread(cli_main, ["--server", h.base, "timeline"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "flight recorder:" in out and fut.rid in out
+            rc = await asyncio.to_thread(
+                cli_main, ["--server", h.base, "timeline", fut.rid]
+            )
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert f"request {fut.rid}" in out
+            assert "prefill_done" in out and "phases" in out
+            assert "decode" in out
+            rc = await asyncio.to_thread(
+                cli_main, ["--server", h.base, "timeline", "ghost"]
+            )
+            assert rc == 1
+    finally:
+        eng.stop()
