@@ -165,6 +165,16 @@ class TPUProviderConfig(APIModel):
     # (decode always dispatches; one chunk per mid-prefill slot rides
     # along). Only meaningful with prefill_chunk > 0; CLI: --tpu-token-budget.
     token_budget: int = Field(default=0, ge=0)
+    # Host-RAM KV offload tier budget (bytes). > 0 makes preemption, park
+    # expiry, and mid-prefill deadline drops swap their written KV rows to
+    # a bounded host pool instead of discarding them; re-admission swaps
+    # the rows back (a host->HBM copy) rather than re-running the whole
+    # prefill, and swap-ins are metered through the same token-budget
+    # scheduler as prefill chunks. Greedy outputs are byte-identical swap
+    # on or off. 0 = off (discard and recompute) — the engine-side
+    # default; serve-time CLI: --tpu-host-kv-bytes. See
+    # docs/serving-engine.md "KV memory tiers".
+    host_kv_bytes: int = Field(default=0, ge=0)
 
 
 class OpenAIProviderConfig(APIModel):

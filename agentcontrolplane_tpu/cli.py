@@ -98,6 +98,22 @@ def _add_tpu_flags(p) -> None:
         "along); only meaningful with --tpu-prefill-chunk",
     )
     p.add_argument(
+        "--tpu-host-kv-bytes", type=int, default=0,
+        help="host-RAM KV offload tier budget in bytes: preemption, park "
+        "expiry, and mid-prefill deadline drops swap their written KV to "
+        "host RAM and re-admission swaps it back instead of re-running "
+        "prefill (greedy outputs byte-identical; see docs/serving-engine.md "
+        "'KV memory tiers'); 0 = off (discard and recompute)",
+    )
+    p.add_argument(
+        "--tpu-prefix-dedup", type=int, default=1,
+        help="cross-request shared-prefix page dedup (paged KV layout): "
+        "requests whose page-aligned prompt prefix matches a live slot "
+        "refcount-share its pages instead of materializing a private copy "
+        "— N concurrent tasks on one agent persona hold 1 copy, not N; "
+        "0 disables (byte-identical either way)",
+    )
+    p.add_argument(
         "--tpu-park-max-s", type=float, default=30.0,
         help="overlapped tool execution: seconds a slot parked at "
         "generation end (prompt KV resident) waits for the conversation's "
@@ -124,6 +140,8 @@ def _build_engine(args, coordination=None):
         park_max_s=args.tpu_park_max_s,
         prefill_chunk=args.tpu_prefill_chunk,
         token_budget=args.tpu_token_budget,
+        host_kv_bytes=args.tpu_host_kv_bytes,
+        prefix_dedup=bool(args.tpu_prefix_dedup),
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:

@@ -213,6 +213,21 @@ class _Slot:
     prefilling: bool = False
     prefill_pos: int = 0
     prefill_row: Optional[list] = None
+    # host-tier swap-in: the HostKVEntry whose rows are being restored into
+    # this slot's KV through the token-budget loop (one restore chunk per
+    # scheduler cycle, budget-costed like a prefill chunk). Cleared when
+    # prefill_pos reaches the entry's cut; the model prefill then resumes
+    # from there. swap_stall_s accumulates the engine-thread seconds spent
+    # blocked inside host->device restore copies (the host_stall phase).
+    swap_entry: Optional[object] = None
+    swap_stall_s: float = 0.0
+    # cross-request shared-prefix dedup: (leader slot, leader rid, cut) —
+    # this slot's rows [0, cut) are the leader's refcount-shared pages. A
+    # follower admitted while its leader was still mid-prefill WAITS (no
+    # chunks dispatched) until the leader has written the shared rows;
+    # a leader dying mid-prefill rewinds its followers to the rows it
+    # actually wrote (see _unshare_followers). None once the wait clears.
+    share_of: Optional[tuple] = None
 
 
 def _next_bucket(n: int, buckets: Sequence[int]) -> int:
@@ -220,6 +235,23 @@ def _next_bucket(n: int, buckets: Sequence[int]) -> int:
         if n <= b:
             return b
     return buckets[-1]
+
+
+def _pow2_sizes(n: int) -> list[int]:
+    """Greedy power-of-two decomposition (7 -> [4, 2, 1]) — the swap
+    extract/restore dispatch sizes, so each is a bounded jit cache entry
+    and no dispatch ever pads past real data (a padded write could clobber
+    neighboring live KV rows)."""
+    out: list[int] = []
+    b = 1
+    while b * 2 <= n:
+        b *= 2
+    while n:
+        while b > n:
+            b //= 2
+        out.append(b)
+        n -= b
+    return out
 
 
 def _pow2_chunks(items: list, max_chunk: int) -> list[list]:
@@ -298,6 +330,27 @@ class Engine:
         # disabled under multi-host coordination — the expiry decision is
         # wall-clock and would fork lockstep (same rule as deadlines).
         park_max_s: float = 30.0,
+        # host-RAM KV offload tier (ops/paged.py HostKVPool): > 0 bounds a
+        # host pool that preemption, park expiry, and mid-prefill deadline
+        # drops swap their written KV rows into INSTEAD of discarding them
+        # — re-admission swaps the rows back (a device->host->device copy)
+        # rather than re-running the whole prefill. Entries are matched by
+        # rid (preempt -> resume) or by token-prefix (a later request
+        # re-sending the same conversation/persona). Greedy outputs are
+        # byte-identical swap on or off (restored KV is a bit-exact copy of
+        # what recompute would produce). 0 = off: exactly today's
+        # discard-and-recompute behavior. CLI: --tpu-host-kv-bytes.
+        host_kv_bytes: int = 0,
+        # cross-request shared-prefix page dedup (paged layout only): at
+        # admission, a request whose page-aligned prompt prefix matches a
+        # live slot's row (or an earlier member of the same admission
+        # group) refcount-SHARES those prompt pages instead of allocating
+        # a private copy — N concurrent tasks on one agent persona hold 1
+        # copy of its pages, not N. Writes past the shared prefix go to
+        # fresh pages, so decode never mutates a shared page; greedy
+        # outputs are byte-identical dedup on or off. Inert in the slot
+        # layout (per-slot context rows cannot be shared).
+        prefix_dedup: bool = True,
         # armed runtime invariant checker (engine/invariants.py): audit the
         # engine's host-side bookkeeping — page-accounting conservation,
         # mirror counters vs recomputed truth, slot state legality — after
@@ -596,6 +649,28 @@ class Engine:
         # dict — same racy-but-safe ints-only contract as the other stats.
         self._parked_count = 0  # acp: mirror
         self.park_max_s = 0.0 if coordination is not None else max(0.0, park_max_s)
+        # KV memory tiers (see _swap_out/_swap_in_rows and _collect_group's
+        # dedup-leader scan). The host pool and allocator are engine-thread
+        # -owned; stats() reads the mirror ints below instead.
+        from ..ops.paged import HostKVPool
+
+        self.host_kv_bytes = max(0, int(host_kv_bytes))
+        self._host_pool = (
+            HostKVPool(self.host_kv_bytes) if self.host_kv_bytes else None
+        )
+        self.prefix_dedup = bool(prefix_dedup)
+        self.kv_swap_outs = 0  # KV rows offloaded to the host tier (events)
+        self.kv_swap_ins = 0  # host-tier restores (swap-in completions)
+        self.prefix_shares = 0  # admissions that refcount-shared prompt pages
+        self._host_kv_used = 0  # acp: mirror — host pool bytes in use
+        self._host_kv_entries = 0  # acp: mirror — host pool entry count
+        self._prefix_shared_pages = 0  # acp: mirror — pages with refcount > 1
+        # jitted swap helpers, keyed by power-of-two size so compile counts
+        # stay logarithmic (extract/restore decompose into pow2 chunks)
+        self._jit_swap_gather: dict[int, Any] = {}  # paged: page gather
+        self._jit_swap_scatter: dict[int, Any] = {}  # paged: page scatter
+        self._jit_swap_extract: dict[int, Any] = {}  # slot: row slice out
+        self._jit_swap_restore: dict[int, Any] = {}  # slot: row slice in
         self.tool_calls_early = 0  # calls emitted before generation ended
         self.tool_overlap_saved_s = 0.0  # sum of (finish - emit) per early call
         self.parks = 0  # slots parked at generation end
@@ -937,6 +1012,10 @@ class Engine:
             self._budgets[:] = 0
             with self._prefix_lock:
                 self._prefix_cache.clear()  # entries reference the old arrays only; safe either way
+            # host-tier entries SURVIVE a crash rebuild: they are token-
+            # derived KV copies, valid against the fresh cache — a
+            # control-plane retry of a failed request prefix-matches them
+            self._publish_memory_state()
             self._crashed = False
             self._stopping = False
             self._thread = threading.Thread(target=self._run, name="tpu-engine", daemon=True)
@@ -1203,8 +1282,13 @@ class Engine:
             if self._prefix_enabled:
                 # phase-d requests ride the REAL submit path (non-
                 # _prewarm, to exercise the cache) — lift the admission
-                # cap so a small max_queue can't shed prewarm's own burst
+                # cap so a small max_queue can't shed prewarm's own burst.
+                # Dedup is paused too: its leader scan would intercept the
+                # same-prefix burst before the cache could, and the
+                # continuation batch shapes this phase exists to compile
+                # would never form.
                 cap, self.max_queue = self.max_queue, 0
+                dd, self.prefix_dedup = self.prefix_dedup, False
                 try:
                     seed_len = self.prefill_buckets[0] + 1
                     one = SamplingParams(temperature=0.0, max_tokens=1, json_only=json_only)
@@ -1240,6 +1324,7 @@ class Engine:
                         self._prefix_misses = max(0, self._prefix_misses - 1)
                 finally:
                     self.max_queue = cap
+                    self.prefix_dedup = dd
             # phase e: chunked-prefill SPILL shapes (configs whose largest
             # bucket is below max_ctx): long prompts at every power-of-two
             # batch size, with the same verified-dispatch retry as phase d
@@ -1350,6 +1435,24 @@ class Engine:
                 ),
                 "verify_dispatches": self.spec_dispatches,
             },
+            # KV memory tiers: host-RAM offload pool occupancy + cross-
+            # request shared-prefix dedup payoff (mirror ints, engine-side
+            # refreshed by _publish_memory_state after every cycle)
+            "memory": {
+                "host_kv": {
+                    "enabled": self.host_kv_bytes > 0,
+                    "max_bytes": self.host_kv_bytes,
+                    "used_bytes": self._host_kv_used,
+                    "entries": self._host_kv_entries,
+                    "swap_outs": self.kv_swap_outs,
+                    "swap_ins": self.kv_swap_ins,
+                },
+                "prefix_dedup": {
+                    "enabled": self.prefix_dedup and self.kv_layout == "paged",
+                    "shares": self.prefix_shares,
+                    "shared_pages": self._prefix_shared_pages,
+                },
+            },
             "mesh": {
                 name: int(size)
                 for name, size in zip(self.mesh.axis_names, self.mesh.devices.shape)
@@ -1416,8 +1519,15 @@ class Engine:
                 self._sweep_parked()
                 if not self._has_work():
                     if not admitted:
+                        # park sweeps / admission pressure can free shared
+                        # pages or swap KV without a dispatch following —
+                        # keep the memory mirrors fresh on the idle path too
+                        self._publish_memory_state()
                         continue
                 self._dispatch_once()
+                # memory-tier mirrors/gauges refresh BEFORE the armed audit
+                # below, so mirror-vs-truth checks see post-cycle state
+                self._publish_memory_state()
                 if self.check_invariants:
                     if self._faults.enabled and self._faults.pop(
                         "engine.invariant_break"
@@ -1696,14 +1806,29 @@ class Engine:
             # assembly already happened in _collect_group), then spill any
             # overlong remainder through intermediate continuation chunks
             # (chunked prefill — both layouts)
-            enriched: list[list] = []  # [item, start] (start mutated by spill)
+            enriched: list[list] = []  # [item, start, swap_entry, share_of]
             for item in group:
                 req, slot, _pages, match = item
                 start = 0
+                swap = None
+                share = None
                 if match is not None and match[1].get("in_slot"):
                     # adopted parked slot: the prompt KV is already resident
                     # in THIS slot — no copy, just a suffix start offset
                     start = match[1]["cut"]
+                elif match is not None and match[1].get("swap") is not None:
+                    # host-tier restore: rows swap back in chunk by chunk
+                    # through the budget loop (start stays 0 — prefill_pos
+                    # advances as restored rows land)
+                    swap = match[1]["swap"]
+                elif match is not None and match[1].get("share_of") is not None:
+                    # dedup follower: rows [0, cut) are the leader's
+                    # refcount-shared pages — nothing to copy, but the
+                    # model prefill may have to WAIT for the leader to
+                    # write them (mid-prefill leader), so the follower is
+                    # admitted through the prefilling path in every mode
+                    start = match[1]["cut"]
+                    share = (*match[1]["share_of"], start)
                 elif match is not None:
                     if self.kv_layout == "slot":
                         self._copy_prefix_into_slot(slot, match[1])
@@ -1725,8 +1850,9 @@ class Engine:
                         resumed=req.preempt_count > 0,
                         adopted=bool(match is not None and match[1].get("in_slot")),
                         chunked=bool(self.prefill_chunk),
+                        swapped=swap is not None, shared=share is not None,
                     )
-                enriched.append([item, start])
+                enriched.append([item, start, swap, share])
             if self.kv_layout == "paged":
                 # block tables must exist before spill chunks reference them
                 for item in group:
@@ -1741,20 +1867,31 @@ class Engine:
                 # per dispatch cycle in _prefill_chunks, interleaved with
                 # decode — a long prompt never stalls decoding slots for its
                 # whole prefill
-                for item, start in enriched:
+                for item, start, swap, share in enriched:
                     req, slot, _pages, _m = item
-                    self._begin_chunked_prefill(req, slot, start)
+                    self._begin_chunked_prefill(
+                        req, slot, start, swap=swap, share_of=share
+                    )
                 continue
+            # host restores and dedup followers go through the prefilling
+            # path even with chunking off: a restore is budget-metered and
+            # a follower may wait on its leader — both drain through the
+            # chunk loop (keyed on _prefilling_count, not the knob)
+            deferred = [e for e in enriched if e[2] is not None or e[3] is not None]
+            direct = [e for e in enriched if e[2] is None and e[3] is None]
+            for item, start, swap, share in deferred:
+                req, slot, _pages, _m = item
+                self._begin_chunked_prefill(req, slot, start, swap=swap, share_of=share)
             with self._hol_clock():
-                self._spill_long_chunks(enriched)
-                plain = [e for e in enriched if e[1] == 0]  # cheaper causal program
-                conts = [e for e in enriched if e[1] > 0]  # suffix continuation
+                self._spill_long_chunks(direct)
+                plain = [e for e in direct if e[1] == 0]  # cheaper causal program
+                conts = [e for e in direct if e[1] > 0]  # suffix continuation
                 for chunk in _pow2_chunks(plain, self.prefill_batch_max):
-                    self._prefill_group([it for it, _ in chunk])
+                    self._prefill_group([e[0] for e in chunk])
                 for chunk in _pow2_chunks(conts, self.prefill_batch_max):
                     self._prefill_group(
-                        [it for it, _ in chunk],
-                        starts_np=np.asarray([s for _, s in chunk], dtype=np.int32),
+                        [e[0] for e in chunk],
+                        starts_np=np.asarray([e[1] for e in chunk], dtype=np.int32),
                     )
         return admitted
 
@@ -1777,8 +1914,8 @@ class Engine:
                 toks = np.zeros((B, CH), dtype=np.int32)
                 starts = np.zeros(B, dtype=np.int32)
                 slots = np.zeros(B, dtype=np.int32)
-                for i, (item, start) in enumerate(batch):
-                    req, slot, _, _m = item
+                for i, e in enumerate(batch):
+                    (req, slot, _, _m), start = e[0], e[1]
                     toks[i] = self._full_row(req)[start : start + CH]
                     starts[i] = start
                     slots[i] = slot
@@ -1797,8 +1934,8 @@ class Engine:
                 if self.kv_layout == "paged":
                     P = self.page_size
                     page_ids = np.zeros((B, CH // P), dtype=np.int32)
-                    for i, (item, start) in enumerate(batch):
-                        _req, slot, _, _m = item
+                    for i, e in enumerate(batch):
+                        slot, start = e[0][1], e[1]
                         page_ids[i] = self._slot_pages[slot][start // P : (start + CH) // P]
                     block_tables = self._put(
                         self._block_tables[[it[0][1] for it in batch]]
@@ -1867,12 +2004,22 @@ class Engine:
             ch = -(-ch // self.page_size) * self.page_size
         return max(1, ch)
 
-    def _begin_chunked_prefill(self, req: _Request, slot: int, start: int) -> None:
+    def _begin_chunked_prefill(
+        self,
+        req: _Request,
+        slot: int,
+        start: int,
+        swap: Optional[object] = None,
+        share_of: Optional[tuple] = None,
+    ) -> None:
         """Admit a request as a PREFILLING slot: the slot id and (paged) KV
         pages are reserved and the prefix-cache start resolved, but no model
         compute has run — the unified scheduler advances it chunk by chunk.
         ``start`` rows of KV are already valid (prefix-cache copy, shared
-        pages, or an adopted parked slot's resident prompt)."""
+        pages, or an adopted parked slot's resident prompt). ``swap`` is a
+        host-tier entry whose rows restore through the budget loop before
+        any model chunk; ``share_of`` marks a dedup follower that may wait
+        on its leader's prefill (see _prefill_chunks)."""
         self._admit_seq += 1
         sl = _Slot(
             request=req,
@@ -1883,6 +2030,8 @@ class Engine:
             prefill_pos=start,
         )
         sl.prefill_row = self._full_row(req)
+        sl.swap_entry = swap
+        sl.share_of = share_of
         self._slots[slot] = sl
         self._prefilling_count += 1
         self._seq_lens[slot] = start
@@ -1975,6 +2124,9 @@ class Engine:
             if self._coordination is not None:
                 self._cancelled.add(req.rid)  # rides the next published frame
             else:
+                # offload the partial prompt KV before it is dropped — a
+                # control-plane retry of the same task prefix-matches it
+                self._swap_out(slot, sl, reason="expire")
                 self._drop_prefilling_slot(slot)
 
     def _drop_prefilling_slot(self, slot: int) -> _Slot:
@@ -1983,6 +2135,7 @@ class Engine:
         request."""
         sl = self._slots.pop(slot)
         self._prefilling_count -= 1
+        self._unshare_followers(slot, sl)
         self._state_dirty = True
         self._seq_lens[slot] = 0
         self._last_tokens[slot] = 0
@@ -2029,18 +2182,74 @@ class Engine:
             ))
         else:
             pre.sort(key=lambda t: t[1].admit_seq)
+        # dedup followers whose leader hasn't written the shared rows yet
+        # WAIT (no chunk, no budget) — dispatching their suffix would read
+        # garbage below the cut. A leader that finished its prefill (or
+        # whose death already rewound this follower) clears the latch.
+        ready: list[tuple[int, _Slot]] = []
+        for slot, sl in pre:
+            if sl.share_of is not None:
+                lead = self._slots.get(sl.share_of[0])
+                if (
+                    lead is not None
+                    and lead.prefilling
+                    and lead.request.rid == sl.share_of[1]
+                    and lead.prefill_pos < sl.share_of[2]
+                ):
+                    continue
+                sl.share_of = None  # shared rows written; follower proceeds
+            ready.append((slot, sl))
+        pre = ready
+        if not pre:
+            return 0
         CHK = self._chunk_tokens()
         sched: list[tuple[int, _Slot, int, int]] = []  # (slot, sl, start, n)
         spent = 0
         for slot, sl in pre:
-            n = min(CHK, len(sl.prefill_row) - sl.prefill_pos)
+            if sl.swap_entry is not None:
+                # a swapped chunk costs budget like a prefill chunk (EDF-
+                # ordered with them): the restore copy competes for the
+                # same cycle the model chunks would
+                n = min(CHK, self._swap_in_cut(sl) - sl.prefill_pos)
+            else:
+                n = min(CHK, len(sl.prefill_row) - sl.prefill_pos)
             if sched and spent + n > chunk_budget:
                 break  # budget spent; later (EDF-ordered) slots wait a cycle
             sched.append((slot, sl, sl.prefill_pos, n))
             spent += n
-        mids = [c for c in sched if c[2] + c[3] < len(c[1].prefill_row)]
-        finals = [c for c in sched if c[2] + c[3] >= len(c[1].prefill_row)]
+        restores = [c for c in sched if c[1].swap_entry is not None]
+        restore_slots = {c[0] for c in restores}
+        aborted_slots: set[int] = set()  # restores the fault site cancelled
+        model = [c for c in sched if c[1].swap_entry is None]
+        mids = [c for c in model if c[2] + c[3] < len(c[1].prefill_row)]
+        finals = [c for c in model if c[2] + c[3] >= len(c[1].prefill_row)]
         with self._hol_clock():
+            for slot, sl, st, n in restores:
+                if self._faults.enabled and st == 0:
+                    spec = self._faults.pop("engine.host_swap_slow")
+                    if spec is not None:
+                        slow = float(spec.get("seconds", 0.05))
+                        time.sleep(slow)
+                        sl.swap_stall_s += slow  # attributed as host_stall
+                    if self._faults.pop("engine.host_swap_error") is not None:
+                        # restore "failed" before any rows landed: fall
+                        # back to recomputing the whole prefill (the entry
+                        # was consumed; byte-identity is unaffected). The
+                        # chunk never dispatched — keep it out of the
+                        # round's flight/counter record too.
+                        self.flight.record(
+                            "swap_in", rid=sl.request.rid, slot=slot,
+                            error=True,
+                        )
+                        sl.swap_entry = None
+                        aborted_slots.add(slot)
+                        spent -= n  # nothing dispatched; refund the budget
+                        continue
+                sl.swap_stall_s += self._swap_in_rows(slot, sl.swap_entry, st, n)
+                sl.prefill_pos = st + n
+                self._seq_lens[slot] = sl.prefill_pos
+                if sl.prefill_pos >= self._swap_in_cut(sl):
+                    self._finish_swap_in(slot, sl)
             for batch in _pow2_chunks(mids, self.prefill_batch_max):
                 self._chunk_dispatch(batch)
             # finals whose whole row fits one chunk (start 0) take the plain
@@ -2067,23 +2276,27 @@ class Engine:
         for slot, sl, st, n in mids:
             sl.prefill_pos = st + n
             self._seq_lens[slot] = sl.prefill_pos
-        self.prefill_chunks += len(sched)
+        landed = [c for c in sched if c[0] not in aborted_slots]
+        self.prefill_chunks += len(landed)
         if self.flight.enabled:
             # the EDF pick + budget spend this cycle: one event per chunk
-            # (tagged per request) plus the round's budget accounting
-            for slot, sl, st, n in sched:
+            # that actually dispatched (an aborted restore already recorded
+            # its swap_in error and advanced nothing) plus the round's
+            # budget accounting
+            for slot, sl, st, n in landed:
                 if not sl.request.prewarm:
                     self.flight.record(
                         "prefill_chunk", rid=sl.request.rid, slot=slot,
                         start=st, n=n,
                         final=st + n >= len(sl.prefill_row or ()),
+                        swap=slot in restore_slots,
                     )
             self.flight.record(
-                "prefill_round", scheduled=len(sched), spent=spent,
+                "prefill_round", scheduled=len(landed), spent=spent,
                 budget=chunk_budget,
             )
         REGISTRY.counter_add(
-            "acp_engine_prefill_chunks_total", float(len(sched)),
+            "acp_engine_prefill_chunks_total", float(len(landed)),
             help="prefill chunk dispatches (per-slot chunks) under the "
             "unified token-budget scheduler",
         )
@@ -2327,35 +2540,87 @@ class Engine:
             match: Optional[tuple] = None
             if self._prefix_enabled and not req.truncated:
                 match = self._match_prefix(req)
+            full = self._full_row(req)
+            # host-tier candidate: an exact-rid entry (preempt -> resume)
+            # or the longest token-prefix entry (park expiry / deadline
+            # drop whose conversation came back). Peek only — reservation
+            # may still fail, so consumption waits for the commit below.
+            host_e = None
+            host_cut = 0
+            if self._host_pool is not None and not req.truncated:
+                host_e = self._host_pool.get(req.rid)
+                if host_e is not None and not (
+                    0 < host_e.cut < len(full)
+                    and tuple(full[: host_e.cut]) == host_e.tokens
+                ):
+                    host_e = None
+                if host_e is None:
+                    host_e = self._host_pool.match_prefix(full)
+                if host_e is not None:
+                    host_cut = min(host_e.cut, len(full) - 1)
+                    if self.kv_layout == "paged":
+                        host_cut = (host_cut // self.page_size) * self.page_size
+                    if host_cut < self._swap_min_rows():
+                        host_e, host_cut = None, 0
+            # dedup candidate: share a live slot's (or an earlier group
+            # member's) prompt pages instead of materializing a copy
+            dedup = self._match_dedup_leader(full, group) if not req.truncated else None
             # parked-slot adoption: a slot parked by this conversation's
             # previous turn holds its prompt KV in place — resume there
-            # (suffix-only prefill, no copy) unless a cache entry covers
-            # strictly more of the row
+            # (suffix-only prefill, no copy). Candidate selection is by
+            # covered rows, ties broken by mechanism cost: in-place
+            # adoption beats a zero-copy cache share beats a dedup share
+            # (which may wait on its leader) beats a host restore (which
+            # pays a host->device copy).
             adopt = self._match_parked(req)
-            if (
-                adopt is not None
-                and match is not None
-                and match[1]["cut"] > self._slots[adopt].park_cut
-            ):
-                adopt = None
-            if adopt is not None:
+            best_cut, _prio, kind = max(
+                (self._slots[adopt].park_cut if adopt is not None else 0, 3, "adopt"),
+                (match[1]["cut"] if match is not None else 0, 2, "cache"),
+                (dedup[2] if dedup is not None else 0, 1, "dedup"),
+                (host_cut, 0, "host"),
+            )
+            if best_cut <= 0:
+                kind = None
+            if kind == "adopt":
                 item = self._adopt_parked(req, adopt)
                 if item is None:
                     break  # pages short even after yielding; head waits (FIFO)
                 if item:
                     group.append(item[0])
                 continue  # oversize-prompt rejection popped the head
-            # no adoption possible: parked capacity yields a free slot
-            if not self._free and not self._release_lru_parked():
-                break
+            # no adoption possible: parked capacity yields a free slot —
+            # preferring NOT to release the dedup leader itself (its pages
+            # are the share). If the leader is the only parked capacity,
+            # release it anyway; the dedup branch below demotes a vanished
+            # leader to a plain undeduped admission.
+            if not self._free and not self._release_lru_parked(
+                exclude=dedup[0] if dedup is not None else None
+            ):
+                if not self._release_lru_parked():
+                    break
             pages: Optional[list[int]] = None
+            shared: list[int] = []
             if self.kv_layout == "paged":
-                total_pages = -(-len(self._full_row(req)) // self.page_size)
+                total_pages = -(-len(full) // self.page_size)
                 if self._reject_oversize_head(req, total_pages):
                     continue
-                shared: list[int] = []
-                if match is not None:
+                if kind == "cache":
                     shared = list(match[1]["pages"])
+                elif kind == "dedup":
+                    leader_pages = self._slot_pages.get(dedup[0])
+                    if leader_pages is None:  # leader reserved in THIS group
+                        leader_pages = next(
+                            (it[2] for it in group if it[1] == dedup[0]), None
+                        )
+                    if leader_pages is None:
+                        # the leader vanished between selection and
+                        # reservation (released for its slot id above):
+                        # admit undeduped rather than crash or mis-share
+                        kind = None
+                    else:
+                        shared = list(
+                            leader_pages[: best_cut // self.page_size]
+                        )
                 # take the share FIRST: if allocation pressure evicts the
                 # matched entry below, our reference keeps its pages alive
                 self._allocator.share(shared)
@@ -2377,6 +2642,21 @@ class Engine:
                     self._allocator.free(shared)  # undo; head waits (FIFO)
                     break
                 pages = shared + fresh
+            if kind == "dedup":
+                match = (None, {"cut": best_cut, "share_of": (dedup[0], dedup[1])})
+                self.prefix_shares += 1
+                if not req.prewarm:
+                    self.flight.record(
+                        "prefix_share", rid=req.rid, cut=best_cut,
+                        leader=dedup[1], pages=len(shared),
+                    )
+            elif kind == "host":
+                # reservation held: consume the entry (its bytes return to
+                # the host budget; the restore is scheduled chunk by chunk)
+                self._host_pool.pop(host_e.rid)
+                match = (None, {"cut": best_cut, "swap": host_e})
+            elif kind is None:
+                match = None
             self._waiting.popleft()
             # lowest-index slot first: keeps active slots compacted at low
             # indices so decode width bucketing stays narrow
@@ -2593,6 +2873,15 @@ class Engine:
                 first_token_at=req.first_token_at,
                 admit_seq=admit_seq,
             )
+            # active slots keep their prefill row too when the dedup
+            # leader scan (its only consumer) is live: it compares token
+            # prefixes against live slots on every admission, and
+            # rebuilding prompt+prefix+resume per scan is O(slots x row)
+            # on the engine thread. Gated so inert configs don't pin an
+            # O(row) list per slot for nothing; the scan falls back to
+            # _full_row for slots admitted while the knob was off.
+            if self.prefix_dedup and self.kv_layout == "paged":
+                sl.prefill_row = self._full_row(req)
             if self.spec_len:
                 from .spec import SpecState
 
@@ -2807,14 +3096,24 @@ class Engine:
             self._release_parked(slot, reason=reason)
             return
         sl = self._slots.pop(slot)
-        if sl.prefilling:
-            # mid-prefill victim: no sampled tokens to save — the partial
-            # prompt KV is released with the pages and the request re-enters
-            # the chunk loop from its (fresh) prefix-cache start on
-            # re-admission. Byte-identical: nothing was sampled yet.
-            self._prefilling_count -= 1
         req = sl.request
-        req.resume_tokens = list(sl.generated[sl.prefix_len:])
+        if sl.prefilling:
+            # mid-prefill victim: no NEW sampled tokens to save — the
+            # partial prompt KV is released with the pages and the request
+            # re-enters the chunk loop from its (fresh) prefix-cache start
+            # on re-admission. Byte-identical: nothing was sampled in THIS
+            # admission. req.resume_tokens is left UNTOUCHED: a resumed
+            # request preempted again mid-resume-prefill keeps its earlier
+            # progress (its ``generated`` list is empty while prefilling —
+            # overwriting from it here silently wiped the resume state and
+            # re-streamed the whole generation after the second resume).
+            self._prefilling_count -= 1
+            self._unshare_followers(slot, sl)
+        else:
+            req.resume_tokens = list(sl.generated[sl.prefix_len:])
+        # host KV tier: offload the written rows before the pages go —
+        # re-admission then swaps them back instead of re-running prefill
+        self._swap_out(slot, sl, reason="preempt")
         req.preempt_count += 1
         self.preemptions += 1
         self._state_dirty = True
@@ -3494,6 +3793,11 @@ class Engine:
         sl = self._slots.get(slot)
         if sl is None or not sl.parked:
             return
+        if reason in ("pressure", "expired", "pool_pressure", "fault"):
+            # the prompt KV is still reusable (same persona/conversation
+            # re-arriving later): offload it before the pages go, so the
+            # host tier's prefix match can restore instead of re-prefilling
+            self._swap_out(slot, sl, reason=f"park_{reason}")
         if not sl.request.prewarm:
             self.flight.record(
                 "park_release", rid=sl.request.rid, slot=slot, reason=reason
@@ -3611,6 +3915,14 @@ class Engine:
             if fresh is None:
                 return None
             pages = kept + fresh
+            # keep _slot_pages coherent IMMEDIATELY (the block-table
+            # install in _fill_slots re-writes it identically later): a
+            # dedup follower in this same admission group may pick the
+            # adopter as its leader, and reading the parked slot's stale
+            # kept-only list here would truncate its share — rows between
+            # the park cut and the share cut would map to never-written
+            # follower pages and decode over garbage KV
+            self._slot_pages[slot] = list(pages)
         self._slots.pop(slot)  # the new turn takes the slot over in place
         self._parked_count -= 1
         self.park_adoptions += 1
@@ -3641,3 +3953,383 @@ class Engine:
             help="slots parked at generation end, prompt KV resident, "
             "awaiting the conversation's next turn",
         )
+
+    # -- KV memory tiers: host-RAM offload + shared-prefix dedup ----------
+
+    def set_host_kv_bytes(self, n: int) -> None:
+        """Resize (0 = disable) the host KV tier. Idle-engine callers only
+        (benches/tests A/B the knob on one warmed engine); shrinking LRU-
+        evicts entries beyond the new budget."""
+        from ..ops.paged import HostKVPool
+
+        self.host_kv_bytes = max(0, int(n))
+        if not self.host_kv_bytes:
+            self._host_pool = None
+        elif self._host_pool is None:
+            self._host_pool = HostKVPool(self.host_kv_bytes)
+        else:
+            pool = self._host_pool
+            pool.max_bytes = self.host_kv_bytes
+            while pool.used_bytes > pool.max_bytes and len(pool):
+                pool.pop(next(iter(pool._entries)))
+        self._publish_memory_state()
+
+    def _swap_min_rows(self) -> int:
+        """Rows below this aren't worth a host round trip. One page (the
+        paged grain) — a swap replaces a model forward over the rows, so
+        even small KV wins; recompute only beats the copy near zero rows."""
+        return self.page_size if self.kv_layout == "paged" else 8
+
+    def _swap_out(self, slot: int, sl: _Slot, reason: str) -> bool:
+        """Offload a slot's written KV rows to the host pool right before
+        its HBM pages are released (preemption, park expiry, mid-prefill
+        deadline). The entry holds a bit-exact copy of rows [0, cut), so a
+        later swap-in reproduces exactly what recompute would — greedy
+        byte-identity is preserved by construction. Returns True when an
+        entry landed; every failure path (pool off, rows too short, entry
+        over budget, injected fault) degrades to today's discard-and-
+        recompute behavior."""
+        pool = self._host_pool
+        if pool is None or self._stopping:
+            return False
+        req = sl.request
+        if req.prewarm or req.truncated or sl.share_of is not None:
+            # a waiting dedup follower's shared rows may not be written yet
+            return False
+        if sl.prefilling:
+            rows = sl.prefill_pos
+        elif sl.parked:
+            rows = sl.park_cut
+        else:
+            rows = int(self._seq_lens[slot])
+        row = self._full_row(req)
+        cut = min(rows, len(row) - 1)  # strict prefix: resume must model >= 1 token
+        if self.kv_layout == "paged":
+            cut = (cut // self.page_size) * self.page_size
+        if cut < self._swap_min_rows() and not (
+            sl.prefilling and sl.swap_entry is not None
+        ):
+            # too few written rows to be worth a copy — except mid-restore,
+            # where the consumed host entry can be re-put without any copy
+            return False
+        t0 = time.monotonic()
+        if self._faults.enabled:
+            if self._faults.pop("engine.host_swap_error") is not None:
+                # the copy "failed": no entry lands, resume recomputes
+                self.flight.record(
+                    "swap_out", rid=req.rid, slot=slot, reason=reason,
+                    error=True,
+                )
+                return False
+            spec = self._faults.pop("engine.host_swap_slow")
+            if spec is not None:
+                # inside the timed window: the injected slowness IS the
+                # host_stall the flight recorder should attribute
+                time.sleep(float(spec.get("seconds", 0.05)))
+        from ..ops.paged import HostKVEntry
+
+        if sl.prefilling and sl.swap_entry is not None:
+            # mid-restore victim: the WHOLE consumed entry is still in host
+            # RAM — re-put it (zero copy, re-keyed to this request's rid so
+            # the exact-match resume finds it) instead of re-extracting
+            # only the rows that happened to land before the preemption.
+            entry = sl.swap_entry
+            if entry.rid != req.rid:
+                entry = HostKVEntry(
+                    rid=req.rid, tokens=entry.tokens, k=entry.k, v=entry.v
+                )
+            cut = entry.cut
+        else:
+            if self.kv_layout == "paged":
+                k_np, v_np = self._extract_pages(
+                    self._slot_pages[slot][: cut // self.page_size]
+                )
+                k_np, v_np = k_np[:, :cut], v_np[:, :cut]
+            else:
+                k_np, v_np = self._extract_rows(slot, cut)
+            entry = HostKVEntry(
+                rid=req.rid, tokens=tuple(row[:cut]), k=k_np, v=v_np
+            )
+        if not pool.put(entry):
+            return False  # bigger than the whole budget: recompute instead
+        stall = time.monotonic() - t0
+        self.kv_swap_outs += 1
+        REGISTRY.counter_add(
+            "acp_engine_kv_swap_out_total", 1.0,
+            help="KV offloads to the host-RAM tier (preemption, park "
+            "expiry, and mid-prefill deadline drops that would otherwise "
+            "discard written KV)",
+        )
+        if not req.prewarm:
+            self.flight.record(
+                "swap_out", rid=req.rid, slot=slot, reason=reason,
+                tokens=cut, bytes=entry.nbytes, stall_s=round(stall, 6),
+            )
+        self._publish_memory_state()
+        return True
+
+    def _extract_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+        """Gather paged KV pages to host numpy, token-major [L, nP, H, d].
+        Dispatches decompose into pow2 page counts (bounded jit entries);
+        the device->host copies are issued async and joined at the end so
+        the DMA overlaps the remaining gathers."""
+        P = self.page_size
+        cfg = self.config
+        chunks: list[tuple] = []
+        i = 0
+        for n in _pow2_sizes(len(pages)):
+            fn = self._jit_swap_gather.get(n)
+            if fn is None:
+                fn = jax.jit(lambda c, ids: (c["k"][:, ids], c["v"][:, ids]))
+                self._jit_swap_gather[n] = fn
+            ids = np.asarray(pages[i : i + n], dtype=np.int32)
+            chunks.append(fn(self.cache, self._put(ids)))
+            i += n
+        for k, v in chunks:
+            for a in (k, v):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+        ks = [np.asarray(k) for k, _ in chunks]
+        vs = [np.asarray(v) for _, v in chunks]
+        T = len(pages) * P
+        shape = (cfg.n_layers, T, cfg.n_kv_heads, cfg.head_dim)
+        return (
+            np.concatenate(ks, axis=1).reshape(shape),
+            np.concatenate(vs, axis=1).reshape(shape),
+        )
+
+    def _extract_rows(self, slot: int, cut: int) -> tuple[np.ndarray, np.ndarray]:
+        """Slot layout: slice rows [0, cut) of ``slot`` out of the cache to
+        host numpy [L, cut, H, d] (pow2 sub-slices; async fetch)."""
+        L, Hkv, d = self.config.n_layers, self.config.n_kv_heads, self.config.head_dim
+        chunks: list[tuple] = []
+        start = 0
+        for n in _pow2_sizes(cut):
+            fn = self._jit_swap_extract.get(n)
+            if fn is None:
+
+                def extract(cache, slot_, start_, n=n):
+                    ek = jax.lax.dynamic_slice(
+                        cache["k"], (0, slot_, start_, 0, 0), (L, 1, n, Hkv, d)
+                    )[:, 0]
+                    ev = jax.lax.dynamic_slice(
+                        cache["v"], (0, slot_, start_, 0, 0), (L, 1, n, Hkv, d)
+                    )[:, 0]
+                    return ek, ev
+
+                fn = jax.jit(extract)  # read-only: cache NOT donated
+                self._jit_swap_extract[n] = fn
+            chunks.append(fn(self.cache, jnp.int32(slot), jnp.int32(start)))
+            start += n
+        for k, v in chunks:
+            for a in (k, v):
+                if hasattr(a, "copy_to_host_async"):
+                    a.copy_to_host_async()
+        return (
+            np.concatenate([np.asarray(k) for k, _ in chunks], axis=1),
+            np.concatenate([np.asarray(v) for _, v in chunks], axis=1),
+        )
+
+    def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:
+        """Restore rows [start, start+n) of a host entry into ``slot``'s
+        KV (page-aligned in paged mode — callers schedule page-grain
+        chunks). Returns the engine-thread seconds spent blocked in the
+        host->device copies (the host_stall phase input)."""
+        t0 = time.monotonic()
+        if self.kv_layout == "paged":
+            P = self.page_size
+            pages = self._slot_pages[slot][start // P : (start + n) // P]
+            i = 0
+            for m in _pow2_sizes(len(pages)):
+                fn = self._jit_swap_scatter.get(m)
+                if fn is None:
+                    fn = jax.jit(
+                        lambda c, ids, kb, vb: {
+                            "k": c["k"].at[:, ids].set(kb),
+                            "v": c["v"].at[:, ids].set(vb),
+                        },
+                        donate_argnums=(0,),
+                    )
+                    self._jit_swap_scatter[m] = fn
+                ids = np.asarray(pages[i : i + m], dtype=np.int32)
+                lo = start + i * P
+                kb = entry.k[:, lo : lo + m * P].reshape(
+                    entry.k.shape[0], m, P, *entry.k.shape[2:]
+                )
+                vb = entry.v[:, lo : lo + m * P].reshape(
+                    entry.v.shape[0], m, P, *entry.v.shape[2:]
+                )
+                self.cache = fn(
+                    self.cache, self._put(ids), self._put(kb), self._put(vb)
+                )
+                i += m
+        else:
+            L, Hkv, d = (
+                self.config.n_layers, self.config.n_kv_heads, self.config.head_dim,
+            )
+            pos = start
+            while pos < start + n:
+                m = _pow2_sizes(start + n - pos)[0]
+                fn = self._jit_swap_restore.get(m)
+                if fn is None:
+
+                    def restore(cache, slot_, start_, kb, vb):
+                        k = jax.lax.dynamic_update_slice(
+                            cache["k"], kb[:, None], (0, slot_, start_, 0, 0)
+                        )
+                        v = jax.lax.dynamic_update_slice(
+                            cache["v"], vb[:, None], (0, slot_, start_, 0, 0)
+                        )
+                        return {"k": k, "v": v}
+
+                    fn = jax.jit(restore, donate_argnums=(0,))
+                    self._jit_swap_restore[m] = fn
+                self.cache = fn(
+                    self.cache, jnp.int32(slot), jnp.int32(pos),
+                    self._put(entry.k[:, pos : pos + m]),
+                    self._put(entry.v[:, pos : pos + m]),
+                )
+                pos += m
+        return time.monotonic() - t0
+
+    def _swap_in_cut(self, sl: _Slot) -> int:
+        """Rows a mid-restore slot will take from its host entry — the
+        entry's cut, never past the strict-prefix edge of this row."""
+        cut = min(sl.swap_entry.cut, len(sl.prefill_row) - 1)
+        if self.kv_layout == "paged":
+            cut = (cut // self.page_size) * self.page_size
+        return cut
+
+    def _finish_swap_in(self, slot: int, sl: _Slot) -> None:
+        """The restore reached its cut: the slot becomes a plain mid-
+        prefill slot (model chunks take over for the remaining suffix)."""
+        req = sl.request
+        self.kv_swap_ins += 1
+        REGISTRY.counter_add(
+            "acp_engine_kv_swap_in_total", 1.0,
+            help="host-tier KV restores completed (re-admissions that "
+            "swapped rows back in instead of re-running prefill)",
+        )
+        if not req.prewarm:
+            self.flight.record(
+                "swap_in", rid=req.rid, slot=slot, tokens=sl.prefill_pos,
+                stall_s=round(sl.swap_stall_s, 6),
+            )
+        sl.swap_entry = None
+        self._publish_memory_state()
+
+    def _unshare_followers(self, leader_slot: int, leader_sl: _Slot) -> None:
+        """A mid-prefill dedup leader is leaving (preempt/expire/cancel):
+        rewind every waiting follower to the page-aligned rows the leader
+        actually wrote. The shared pages survive (followers hold refs), so
+        rows below the rewind stay valid; each follower then recomputes
+        the gap itself — multiple followers write bit-identical KV into
+        the shared pages, so redundant writes are harmless."""
+        if not leader_sl.prefilling:
+            return  # leader finished its prefill: every shared row is written
+        pos = (leader_sl.prefill_pos // self.page_size) * self.page_size
+        rid = leader_sl.request.rid
+        for s, sl in self._slots.items():
+            if (
+                sl.prefilling
+                and sl.share_of is not None
+                and sl.share_of[0] == leader_slot
+                and sl.share_of[1] == rid
+            ):
+                if sl.prefill_pos > pos:
+                    sl.prefill_pos = pos
+                    self._seq_lens[s] = pos
+                sl.share_of = None
+
+    def _match_dedup_leader(
+        self, full: list[int], group: Optional[list] = None
+    ) -> Optional[tuple]:
+        """Longest page-aligned common prefix between ``full`` and a live
+        slot's row — or an earlier member of the admission group being
+        formed (the burst case: N same-persona tasks arriving at once,
+        before any prefill could seed the cache). Returns
+        ``(leader_slot, leader_rid, cut)`` or None. Parked leaders share up
+        to their park cut (rows resident); active/prefilling leaders up to
+        their whole row — a follower behind a still-prefilling leader
+        waits for the shared rows to be written (see _prefill_chunks).
+        Slots that are themselves waiting dedup followers are skipped
+        (their prefill_pos counts rows their OWN leader hasn't written, so
+        the follower-wait test would lie); ties keep the first candidate,
+        so a burst chains every follower to the one root writer."""
+        if self.kv_layout != "paged" or not self.prefix_dedup:
+            return None
+        best: Optional[tuple] = None
+        for s, sl in self._slots.items():
+            if sl.share_of is not None:
+                continue
+            # avoid rebuilding rows per scan in the admission path: parked
+            # slots compare against the prompt capped at the park cut, and
+            # every other slot carries its row as prefill_row (kept after
+            # the prefill flip precisely so hot paths don't reconstruct it)
+            if sl.parked:
+                other, limit = sl.request.prompt, sl.park_cut
+            else:
+                other = (
+                    sl.prefill_row
+                    if sl.prefill_row is not None
+                    else self._full_row(sl.request)
+                )
+                limit = len(other)
+            cut = self._common_cut(full, other, limit)
+            if cut >= self._swap_min_rows() and (best is None or cut > best[2]):
+                best = (s, sl.request.rid, cut)
+        for g_req, g_slot, _g_pages, g_match in group or ():
+            if g_match is not None and (
+                g_match[1].get("share_of") is not None
+                or g_match[1].get("swap") is not None
+            ):
+                continue  # follower/mid-restore: not a safe root writer
+            cut = self._common_cut(full, self._full_row(g_req))
+            if cut >= self._swap_min_rows() and (best is None or cut > best[2]):
+                best = (g_slot, g_req.rid, cut)
+        return best
+
+    def _common_cut(
+        self, full: list[int], other: list[int], limit: Optional[int] = None
+    ) -> int:
+        """Page-aligned length of the longest shared token prefix, capped
+        strictly below ``full``'s end (suffix tokens must remain) and at
+        ``limit`` (e.g. a parked leader's resident rows). Compared a page
+        at a time (C-speed list-slice equality) — the result is rounded
+        down to a page boundary anyway, and a per-token Python loop over
+        multi-k prefixes would tax the engine thread exactly during the
+        admission bursts dedup exists to speed up."""
+        P = self.page_size
+        n = min(len(full) - 1, len(other))
+        if limit is not None:
+            n = min(n, limit)
+        pages = n // P
+        i = 0
+        while i < pages and full[i * P : (i + 1) * P] == other[i * P : (i + 1) * P]:
+            i += 1
+        return i * P
+
+    def _publish_memory_state(self) -> None:
+        """Refresh the cross-thread memory mirrors + gauges from engine-
+        thread truth (host pool bytes/entries, refcount-shared pages).
+        Cheap; runs after every dispatch cycle and at each swap/share."""
+        if self._host_pool is not None:
+            self._host_kv_used = self._host_pool.used_bytes
+            self._host_kv_entries = len(self._host_pool)
+            REGISTRY.gauge_set(
+                "acp_engine_host_kv_bytes", float(self._host_kv_used),
+                help="bytes of swapped-out KV resident in the host-RAM "
+                "offload tier (bounded by --tpu-host-kv-bytes)",
+            )
+        else:
+            self._host_kv_used = 0
+            self._host_kv_entries = 0
+        if self.kv_layout == "paged":
+            self._prefix_shared_pages = self._allocator.shared_count
+            REGISTRY.gauge_set(
+                "acp_engine_prefix_shared_pages",
+                float(self._prefix_shared_pages),
+                help="HBM KV pages currently refcount-shared by more than "
+                "one owner (cross-request shared-prefix dedup + prefix "
+                "cache)",
+            )

@@ -26,7 +26,16 @@ armed (``ACP_INVARIANTS=1`` or ``Engine(check_invariants=True)``):
   fault-held pages (a page owned by two slots MUST be refcounted-shared;
   a refcount with no owner is a leak — the PR 5 class); parked slots hold
   exactly their prompt-covering pages (the PR 7 garbage-lane class, in its
-  host-observable form); block-table rows mirror the page lists.
+  host-observable form); block-table rows mirror the page lists. The
+  shared-page counters (cross-request prefix dedup) must equal the truth
+  recomputed from the refcount dict — a dedup'd page freed while a second
+  slot still owns it shows up as unshared multi-ownership.
+- **host KV pool conservation** (host-RAM offload tier) — the pool's
+  used-bytes equal the sum of its live entries' bytes (a swapped-out
+  entry leaking from accounting can never be restored or reclaimed),
+  stay within the configured budget, and match the engine's
+  cross-thread mirrors; mid-restore and dedup-follower slots carry their
+  transition state only while PREFILLING.
 
 ``verify_engine`` returns the violations as strings (tests corrupt state
 and assert on them); ``check_engine_invariants`` raises
@@ -106,6 +115,24 @@ def verify_engine(engine) -> list[str]:
                     f"prefilling slot {slot}: seq_len {seq} != prefill_pos "
                     f"{sl.prefill_pos}"
                 )
+            if sl.share_of is not None and sl.prefill_pos != sl.share_of[2]:
+                problems.append(
+                    f"prefilling slot {slot}: dedup follower advanced to "
+                    f"{sl.prefill_pos} while still latched on its leader at "
+                    f"cut {sl.share_of[2]} — its suffix would attend over "
+                    "rows the leader hasn't written"
+                )
+            if sl.swap_entry is not None and sl.prefill_pos >= engine._swap_in_cut(sl):
+                problems.append(
+                    f"prefilling slot {slot}: mid-restore prefill_pos "
+                    f"{sl.prefill_pos} reached/passed its host entry's cut "
+                    "— the swap-in should have completed and detached"
+                )
+        elif sl.share_of is not None or sl.swap_entry is not None:
+            problems.append(
+                f"slot {slot}: dedup/swap state on a non-prefilling slot "
+                "(share_of/swap_entry must clear before decode)"
+            )
         else:  # ACTIVE (decoding)
             want = sl.prompt_len + len(sl.generated) - 1
             if seq != want:
@@ -140,8 +167,51 @@ def verify_engine(engine) -> list[str]:
             f"{prefilling_truth} prefilling slots recomputed from the slot dict"
         )
 
+    problems.extend(_verify_host_pool(engine))
     if engine.kv_layout == "paged":
         problems.extend(_verify_pages(engine, slots))
+    return problems
+
+
+def _verify_host_pool(engine) -> list[str]:
+    """Host-RAM KV tier conservation: the pool's used-bytes counter must
+    equal the sum of its live entries' bytes (a swapped-out entry whose
+    bytes vanished from accounting is a host-resident page leak — KV held
+    in RAM that can never be restored or reclaimed), stay within budget,
+    and match the engine's cross-thread mirrors."""
+    problems: list[str] = []
+    pool = engine._host_pool
+    if pool is None:
+        if engine._host_kv_used or engine._host_kv_entries:
+            problems.append(
+                "mirror drift: host pool disabled but _host_kv_used="
+                f"{engine._host_kv_used} / _host_kv_entries="
+                f"{engine._host_kv_entries} are non-zero"
+            )
+        return problems
+    used, entries = pool.audit()
+    total = sum(entries.values())
+    if used != total:
+        problems.append(
+            f"host KV pool leak: used_bytes {used} != {total} summed over "
+            f"{len(entries)} live entries — swapped-out KV vanished from "
+            "accounting (or accounting outlived its entry)"
+        )
+    if used > pool.max_bytes:
+        problems.append(
+            f"host KV pool over budget: {used} bytes used > max "
+            f"{pool.max_bytes} — the LRU bound is not being enforced"
+        )
+    if engine._host_kv_used != used:
+        problems.append(
+            f"mirror drift: _host_kv_used {engine._host_kv_used} != host "
+            f"pool used_bytes {used}"
+        )
+    if engine._host_kv_entries != len(entries):
+        problems.append(
+            f"mirror drift: _host_kv_entries {engine._host_kv_entries} != "
+            f"{len(entries)} live host pool entries"
+        )
     return problems
 
 
@@ -170,6 +240,21 @@ def _verify_pages(engine, slots: dict) -> list[str]:
     negative = {pg: r for pg, r in refs.items() if r <= 0}
     if negative:
         problems.append(f"non-positive refcounts: {negative}")
+
+    # shared-page accounting (cross-request prefix dedup): the allocator's
+    # incremental shared counter and the engine's stats mirror must both
+    # equal the truth recomputed from the refcount dict
+    shared_truth = sum(1 for r in refs.values() if r > 1)
+    if alloc.shared_count != shared_truth:
+        problems.append(
+            f"allocator shared_count {alloc.shared_count} != {shared_truth} "
+            "pages with refcount > 1 — incremental share accounting drifted"
+        )
+    if engine._prefix_shared_pages != shared_truth:
+        problems.append(
+            f"mirror drift: _prefix_shared_pages {engine._prefix_shared_pages} "
+            f"!= {shared_truth} refcount-shared pages"
+        )
 
     # ownership audit: every reference is held by exactly refcount owners
     owners: Counter = Counter()

@@ -35,6 +35,18 @@ Engine sites (see ``engine/engine.py``):
   flight events (including the ``invariant_violation`` event itself) +
   ``Engine.stats()`` + the paged allocator audit to a JSON dump before the
   loud crash (observability/flight.py, docs/debugging-guide.md).
+- ``engine.host_swap_slow`` — stretch the next ``times=N`` host-tier KV
+  swap operations (swap-out at preemption/park-expiry, or the first
+  restore chunk of a swap-in) by ``seconds=S`` each: a saturated host
+  memory bus / NUMA-remote pool. The stall is visible as the flight
+  recorder's ``host_stall`` phase; outputs stay byte-identical (swapping
+  only moves WHERE resume KV comes from, never what is sampled).
+- ``engine.host_swap_error`` — fail the next ``times=N`` host-tier swap
+  operations: a swap-out aborts before its entry lands (resume falls back
+  to recomputing the prefill), a swap-in abandons its restore and the
+  slot recomputes from its restored position. Deterministic and graceful
+  — the host tier is an optimization, so every failure degrades to
+  today's discard-and-recompute path, byte-identically.
 - ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:
   for the next ``times=N`` verify dispatches every draft token is treated
   as mismatched (full rejection), so each dispatch commits exactly one

@@ -60,13 +60,16 @@ PER_REQUEST_CAP = 512  # events indexed per request (timeline bound)
 FINISHED_TIMELINES = 64  # finished request timelines kept for /timeline
 
 # the phase vocabulary exported as acp_engine_phase_seconds{phase=...}
-PHASES = ("queue_wait", "prefill", "decode", "preempt_stall", "tool_overlap_hidden")
+PHASES = (
+    "queue_wait", "prefill", "decode", "preempt_stall",
+    "tool_overlap_hidden", "host_stall",
+)
 
 # event kinds that carry a rid and mark lifecycle edges (documented in
 # docs/observability.md "Flight recorder & timelines"):
 #   submit shed admit prefill_chunk prefill_done decode_block spec_verify
 #   preempt park adopt park_release tool_call expire cancel finish
-#   invariant_violation crash restart
+#   swap_out swap_in prefix_share invariant_violation crash restart
 
 
 def _trace_ids(trace) -> Optional[tuple[str, str]]:
@@ -99,12 +102,18 @@ def attribute_phases(
     - ``tool_overlap_hidden``  per early-emitted tool call, emit -> finish
       (the execution window overlap hid inside decode; informational — it
       overlaps ``decode`` rather than extending the total)
+    - ``host_stall``  per KV swap event, the engine-thread seconds spent
+      blocked inside host<->HBM copies for this request (``stall_s`` on
+      ``swap_out``/``swap_in`` events); informational — it overlaps the
+      phase the swap ran inside (prefill or preempt_stall) rather than
+      extending the total
 
     Tolerant of partial histories: a request that was shed/expired/crashed
     before some edge simply lacks the later phases."""
     t_submit = t_admit = t_first = t_end = None
     stalls: list[tuple[float, float]] = []
     tool_marks: list[float] = []
+    host_stalls: list[tuple[float, float]] = []
     pending_preempt: Optional[float] = None
     for ev in events:
         kind, t = ev["kind"], ev["t"]
@@ -123,6 +132,10 @@ def attribute_phases(
                 pending_preempt = t
         elif kind == "tool_call":
             tool_marks.append(t)
+        elif kind in ("swap_out", "swap_in"):
+            stall = float((ev.get("detail") or {}).get("stall_s") or 0.0)
+            if stall > 0:
+                host_stalls.append((t - stall, t))
         elif kind in ("finish", "expire", "cancel", "shed"):
             t_end = t
     if not events:
@@ -155,6 +168,9 @@ def attribute_phases(
     for tm in tool_marks:
         if t_end > tm:
             windows.append(("tool_overlap_hidden", tm, t_end))
+    for a, b in host_stalls:
+        if b > a:
+            windows.append(("host_stall", a, b))
     durations: dict[str, float] = {}
     for phase, a, b in windows:
         durations[phase] = durations.get(phase, 0.0) + (b - a)
@@ -256,7 +272,7 @@ class FlightRecorder:
                 labels={"phase": phase},
                 help="per-request engine phase latency attribution derived "
                 "from the flight recorder (queue_wait | prefill | decode | "
-                "preempt_stall | tool_overlap_hidden)",
+                "preempt_stall | tool_overlap_hidden | host_stall)",
             )
         if truncated:
             log.debug("flight timeline for rid %s truncated at %d events",

@@ -14,8 +14,13 @@ reserved as the trash page: padded writes land there, nothing reads it.
 
 from __future__ import annotations
 
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Optional
+
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from .attention import NEG_INF
 
@@ -179,10 +184,21 @@ class PageAllocator:
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
         self._refs: dict[int, int] = {}
+        # pages with refcount >= 2 (cross-request shared-prefix dedup +
+        # prefix-cache references), maintained incrementally so readers get
+        # an atomic int instead of scanning the refcount dict
+        self._shared = 0
 
     @property
     def free_count(self) -> int:
         return len(self._free)
+
+    @property
+    def shared_count(self) -> int:
+        """Pages currently referenced by MORE than one owner — the dedup
+        payoff: each is one HBM page serving multiple sequences. Atomic
+        int read (cross-thread safe, same contract as free_count)."""
+        return self._shared
 
     def audit(self) -> tuple[list[int], dict[int, int]]:
         """Snapshot ``(free pages, {page: refcount})`` for the runtime
@@ -205,7 +221,10 @@ class PageAllocator:
         """Take an additional reference on already-allocated pages."""
         for p in pages:
             if p != TRASH_PAGE:
-                self._refs[p] += 1
+                n = self._refs[p] + 1
+                self._refs[p] = n
+                if n == 2:
+                    self._shared += 1
 
     def free(self, pages: list[int]) -> None:
         """Drop one reference per page; pool it when the last ref drops.
@@ -215,8 +234,113 @@ class PageAllocator:
             if p == TRASH_PAGE:
                 continue
             left = self._refs[p] - 1
+            if left == 1:
+                self._shared -= 1
             if left <= 0:
                 del self._refs[p]
                 self._free.append(p)
             else:
                 self._refs[p] = left
+
+
+@dataclass
+class HostKVEntry:
+    """Swapped-out KV resident in host RAM: token-major rows (layout-
+    independent — the engine's extract/restore paths convert to and from
+    the slot rows or page blocks of whichever KV layout is serving).
+
+    ``tokens`` is the exact token sequence whose KV the rows hold (rows
+    ``[0, cut)`` of a request's prefill row), so an entry can be matched
+    either by the rid it was swapped under (preempt -> resume) or by token
+    -prefix equality (park expiry / mid-prefill deadline -> a later request
+    re-sending the same conversation or persona prompt)."""
+
+    rid: str
+    tokens: tuple
+    k: np.ndarray  # [L, cut, H_kv, d]
+    v: np.ndarray
+
+    @property
+    def cut(self) -> int:
+        return len(self.tokens)
+
+    @property
+    def nbytes(self) -> int:
+        return int(self.k.nbytes) + int(self.v.nbytes)
+
+
+class HostKVPool:
+    """Bounded host-RAM KV tier (the offload side of the engine's memory
+    hierarchy). Engine-thread owned, like :class:`PageAllocator` — no
+    locking. Entries are LRU-evicted when a put would exceed ``max_bytes``;
+    an entry that alone exceeds the budget is refused (the caller falls
+    back to recompute-on-resume, today's behavior).
+
+    ``audit()`` mirrors the allocator's: conservation here means the used-
+    bytes counter equals the sum of live entries' bytes and never exceeds
+    the budget — a swapped-out entry whose bytes vanished from accounting
+    is a host-resident page leak (the invariant checker's new class)."""
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = int(max_bytes)
+        self.used_bytes = 0
+        self._entries: "OrderedDict[str, HostKVEntry]" = OrderedDict()
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def put(self, entry: HostKVEntry) -> bool:
+        """Admit ``entry`` (keyed by rid; a re-put replaces), LRU-evicting
+        until it fits. False when the entry alone exceeds the budget."""
+        if entry.nbytes > self.max_bytes:
+            return False
+        old = self._entries.pop(entry.rid, None)
+        if old is not None:
+            self.used_bytes -= old.nbytes
+        while self.used_bytes + entry.nbytes > self.max_bytes and self._entries:
+            _, evicted = self._entries.popitem(last=False)
+            self.used_bytes -= evicted.nbytes
+        self._entries[entry.rid] = entry
+        self.used_bytes += entry.nbytes
+        return True
+
+    def get(self, rid: str) -> Optional[HostKVEntry]:
+        """Look up by rid without removing (reservation may still fail, so
+        consumption is a separate :meth:`pop`). A hit refreshes recency —
+        an attempted use is a use, or the LRU bound would really be FIFO
+        and evict exactly the entries traffic keeps reaching for."""
+        e = self._entries.get(rid)
+        if e is not None:
+            self._entries.move_to_end(rid)
+        return e
+
+    def match_prefix(self, row: list[int]) -> Optional[HostKVEntry]:
+        """Longest entry whose tokens are a STRICT prefix of ``row`` (at
+        least one suffix token must remain to produce logits) — the host
+        tier acting as a second-level prefix cache for park-expired and
+        deadline-dropped KV. A match refreshes the entry's recency (see
+        :meth:`get`)."""
+        best: Optional[HostKVEntry] = None
+        for e in self._entries.values():
+            if e.cut < len(row) and (best is None or e.cut > best.cut):
+                if tuple(row[: e.cut]) == e.tokens:
+                    best = e
+        if best is not None:
+            self._entries.move_to_end(best.rid)
+        return best
+
+    def pop(self, rid: str) -> Optional[HostKVEntry]:
+        """Consume an entry (swap-in took it; its bytes return to budget)."""
+        e = self._entries.pop(rid, None)
+        if e is not None:
+            self.used_bytes -= e.nbytes
+        return e
+
+    def clear(self) -> None:
+        self._entries.clear()
+        self.used_bytes = 0
+
+    def audit(self) -> tuple[int, dict[str, int]]:
+        """Snapshot ``(used_bytes, {rid: entry bytes})`` for the invariant
+        checker. Copies, so auditors never alias pool internals."""
+        return self.used_bytes, {r: e.nbytes for r, e in self._entries.items()}

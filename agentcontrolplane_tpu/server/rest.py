@@ -1256,6 +1256,24 @@ class RestServer:
                     help="tokens dispatched last scheduler cycle / "
                     "per-cycle token budget (chunked prefill mode)",
                 )
+                # KV memory tiers: host-pool occupancy + dedup'd pages,
+                # refreshed at scrape time so an idle engine (no dispatch
+                # cycles) still reports current tier state
+                mem = s.get("memory", {})
+                REGISTRY.gauge_set(
+                    "acp_engine_host_kv_bytes",
+                    float(mem.get("host_kv", {}).get("used_bytes", 0)),
+                    help="bytes of swapped-out KV resident in the "
+                    "host-RAM offload tier (bounded by "
+                    "--tpu-host-kv-bytes)",
+                )
+                REGISTRY.gauge_set(
+                    "acp_engine_prefix_shared_pages",
+                    float(mem.get("prefix_dedup", {}).get("shared_pages", 0)),
+                    help="HBM KV pages currently refcount-shared by more "
+                    "than one owner (cross-request shared-prefix dedup + "
+                    "prefix cache)",
+                )
             except Exception:
                 pass  # a crashed engine must not take /metrics down
 

@@ -55,7 +55,12 @@ ACP_BENCH_TTFT_TIMEOUT_S, ACP_BENCH_AB=0 / ACP_BENCH_AB_BUDGET_S,
 ACP_BENCH_TOTAL_BUDGET_S, ACP_BENCH_RETRIES,
 ACP_BENCH_FLIGHT=1 / ACP_BENCH_FLIGHT_LEGS (flight-recorder on/off
 overhead guard on the headline burst — the <2% contract, emitted as the
-doc's additive ``flight`` block).
+doc's additive ``flight`` block),
+ACP_BENCH_MEM=1 / ACP_BENCH_MEM_PROMPT / ACP_BENCH_MEM_TASKS /
+ACP_BENCH_MEM_PERSONA / ACP_BENCH_MEM_HOST_BYTES (KV memory-tier
+fixture: preempt->resume swap-in vs recompute-prefill latency, and
+effective concurrent slots with shared-prefix dedup on/off at a fixed
+page budget — emitted as the doc's additive ``mem`` block).
 
 ``ACP_INVARIANTS=1`` additionally arms the engine's runtime invariant
 checker (engine/invariants.py) for every bench engine — per-dispatch state
@@ -502,6 +507,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["tool_turn"] = val
             elif key == "hol" and "hol" not in doc:
                 doc["hol"] = val
+            elif key == "mem" and "mem" not in doc:
+                doc["mem"] = val
             elif key == "flight" and "flight" not in doc:
                 doc["flight"] = val
             else:
@@ -518,6 +525,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT tool_turn", 600))
     if os.environ.get("ACP_BENCH_HOL", "0") == "1":
         main_schedule.append(("RESULT hol", 900))
+    if os.environ.get("ACP_BENCH_MEM", "0") == "1":
+        main_schedule.append(("RESULT mem", 900))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
     if ttft_on:
@@ -923,6 +932,15 @@ def _child(args: argparse.Namespace) -> None:
 
     if (
         not args.only_ttft
+        and os.environ.get("ACP_BENCH_MEM", "0") == "1"
+    ):
+        try:
+            _result("mem", _bench_mem())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("mem", {"error": str(e)})
+
+    if (
+        not args.only_ttft
         and os.environ.get("ACP_BENCH_FLIGHT", "0") == "1"
     ):
         try:
@@ -1207,6 +1225,172 @@ def _bench_hol() -> dict:
         }
     finally:
         engine.stop()
+
+
+def _bench_mem() -> dict:
+    """KV memory-tier fixture (ACP_BENCH_MEM=1) — the two capacity
+    multipliers from docs/serving-engine.md "KV memory tiers":
+
+    (a) **swap vs recompute**: one request with a long prompt is forcibly
+    preempted mid-decode; its resume either swaps the KV back from the
+    host tier (host_kv_bytes on) or re-runs the whole prefill (off). The
+    flight recorder's preempt -> resume-prefill_done window is the
+    resume latency each way; the ratio is the recompute tax the host tier
+    kills. Byte-identical across both legs and the unpreempted run.
+
+    (b) **effective slots under shared-prefix dedup**: N tasks sharing
+    one long persona prompt burst into a page pool deliberately too small
+    for N private prefix copies. Dedup off (today) admits what fits and
+    serializes the rest; dedup on shares one copy of the persona pages.
+    Reported: peak concurrently-admitted slots each way. Byte-identical.
+
+    Both parts build their own tiny-config engines so the long prefills
+    are CPU-tractable. Knobs: ACP_BENCH_MEM_PROMPT (default 4096),
+    ACP_BENCH_MEM_TASKS (8), ACP_BENCH_MEM_PERSONA (512),
+    ACP_BENCH_MEM_HOST_BYTES (256 MiB)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.testing import FAULTS
+
+    plen = int(os.environ.get("ACP_BENCH_MEM_PROMPT", "4096"))
+    n_tasks = int(os.environ.get("ACP_BENCH_MEM_TASKS", "8"))
+    persona_len = int(os.environ.get("ACP_BENCH_MEM_PERSONA", "512"))
+    host_bytes = int(os.environ.get("ACP_BENCH_MEM_HOST_BYTES", str(256 << 20)))
+    page = 16
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+
+    def build(max_ctx, kv_pages, **kw):
+        cfg = dataclasses.replace(
+            PRESETS["tiny"], max_seq_len=max_ctx, vocab_size=512
+        )
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            max_ctx=max_ctx,
+            prefill_buckets=(64, 256),
+            decode_block_size=4,
+            kv_layout="paged",
+            page_size=page,
+            kv_pages=kv_pages,
+            # the prefix cache would let later legs skip the prefills the
+            # earlier legs measured — this fixture isolates the NEW tiers
+            prefix_cache_entries=0,
+            check_invariants=armed,
+            **kw,
+        )
+        eng.start()
+        return eng
+
+    # -- (a) preempt -> resume: swap-in vs recompute-prefill ----------------
+    max_ctx = plen + 256
+    eng = build(max_ctx, kv_pages=plen // page + 64, max_slots=2,
+                host_kv_bytes=host_bytes)
+    try:
+        prompt = [1 + (i % 250) for i in range(plen)]
+        sp = SamplingParams(temperature=0.0, max_tokens=24)
+        base = eng.generate(list(prompt), sp)  # also warms every shape
+
+        def preempt_leg(swap_on: bool) -> tuple[list, float]:
+            eng.set_host_kv_bytes(host_bytes if swap_on else 0)
+            FAULTS.arm("engine.force_preempt", after_steps=2)
+            fut = eng.submit(list(prompt), sp)
+            r = fut.result(timeout=1800)
+            FAULTS.reset()
+            assert r.preempt_count >= 1, "fixture failed to preempt"
+            tl = eng.flight.timeline(fut.rid) or []
+            t_pre = next(e["t"] for e in tl if e["kind"] == "preempt")
+            t_res = next(
+                e["t"] for e in tl if e["kind"] == "prefill_done" and e["t"] > t_pre
+            )
+            return r.tokens, (t_res - t_pre) * 1e3
+
+        # warm both resume paths (restore-scatter jits compile here, and
+        # the recompute leg's spill shapes are warm from `base`)
+        preempt_leg(True)
+        preempt_leg(False)
+        toks_on, resume_on_ms = preempt_leg(True)
+        toks_off, resume_off_ms = preempt_leg(False)
+        swap_identical = toks_on == toks_off == base.tokens
+        speedup = round(resume_off_ms / resume_on_ms, 2) if resume_on_ms > 0 else 0.0
+        swap_part = {
+            "prompt_tokens": plen,
+            "resume_swap_ms": round(resume_on_ms, 1),
+            "resume_recompute_ms": round(resume_off_ms, 1),
+            "swap_speedup_x": speedup,
+            "swap_ins": eng.kv_swap_ins,
+            "byte_identical": swap_identical,
+        }
+    finally:
+        eng.stop()
+
+    # -- (b) effective slots: shared-persona burst, dedup on/off ------------
+    persona = [3 + (i % 200) for i in range(persona_len)]
+    tails = [[7 + i, 9 + i, 11 + i, 13 + i] for i in range(n_tasks)]
+    # pool sized so ONE persona copy + per-task suffixes fit, N private
+    # copies do not: persona pages + per-task (suffix + decode + slack)
+    kv_pages = persona_len // page + n_tasks * 6 + 1
+    eng = build(max_ctx=1024, kv_pages=kv_pages, max_slots=n_tasks,
+                park_max_s=0.0)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        solo = {}
+        for i, t in enumerate(tails):
+            solo[i] = eng.generate(persona + t, sp).tokens
+
+        def burst_leg(dedup: bool) -> tuple[dict, int, int]:
+            eng.prefix_dedup = dedup
+            peak = [0]
+            shared_peak = [0]
+
+            def on_tokens(_toks):
+                s = eng.stats()
+                peak[0] = max(peak[0], s["active_slots"] + s["prefilling_slots"])
+                shared_peak[0] = max(
+                    shared_peak[0], s["memory"]["prefix_dedup"]["shared_pages"]
+                )
+
+            with eng.hold_admission():
+                futs = [
+                    eng.submit(persona + t, sp, on_tokens=on_tokens)
+                    for t in tails
+                ]
+            toks = {i: f.result(timeout=1800).tokens for i, f in enumerate(futs)}
+            return toks, peak[0], shared_peak[0]
+
+        toks_off, slots_off, _ = burst_leg(False)
+        toks_on, slots_on, shared_pages_peak = burst_leg(True)
+        dedup_identical = toks_on == toks_off == solo
+        ratio = round(slots_on / slots_off, 2) if slots_off else 0.0
+        dedup_part = {
+            "tasks": n_tasks,
+            "persona_tokens": persona_len,
+            "kv_pages": kv_pages - 1,
+            "effective_slots_dedup_off": slots_off,
+            "effective_slots_dedup_on": slots_on,
+            "slot_capacity_x": ratio,
+            "shared_pages_peak": shared_pages_peak,
+            "byte_identical": dedup_identical,
+        }
+    finally:
+        eng.stop()
+
+    return {
+        "swap": swap_part,
+        "dedup": dedup_part,
+        "note": (
+            f"preempt->resume on a {plen}-token prompt: swap-in "
+            f"{swap_part['resume_swap_ms']:.0f}ms vs recompute "
+            f"{swap_part['resume_recompute_ms']:.0f}ms "
+            f"({swap_part['swap_speedup_x']}x); {n_tasks} tasks sharing a "
+            f"{persona_len}-token persona at {kv_pages - 1} pages: "
+            f"{slots_off} -> {slots_on} concurrent slots "
+            f"({ratio}x); byte-identical="
+            f"{swap_identical and dedup_identical}"
+        ),
+    }
 
 
 def _bench_ttft(engine) -> dict:

@@ -347,6 +347,40 @@ def test_attribute_phases_tool_overlap_and_partial_histories():
     assert durations["decode"] == pytest.approx(1.0)
 
 
+def test_attribute_phases_host_stall_windows_overlap_not_extend():
+    """KV memory tiers: swap events carrying stall_s yield host_stall
+    windows ending at the event time — informational overlaps (like
+    tool_overlap_hidden), never subtracted from prefill/decode."""
+    evs = [
+        {"seq": 1, "t": 0.0, "kind": "submit"},
+        {"seq": 2, "t": 1.0, "kind": "admit"},
+        {"seq": 3, "t": 2.0, "kind": "prefill_done"},
+        {"seq": 4, "t": 3.0, "kind": "preempt"},
+        {"seq": 5, "t": 3.2, "kind": "swap_out", "detail": {"stall_s": 0.2}},
+        {"seq": 6, "t": 4.5, "kind": "swap_in", "detail": {"stall_s": 0.5}},
+        {"seq": 7, "t": 5.0, "kind": "prefill_done"},
+        {"seq": 8, "t": 9.0, "kind": "finish"},
+    ]
+    durations, windows = attribute_phases(evs)
+    assert durations["host_stall"] == pytest.approx(0.7)
+    assert ("host_stall", 3.0, 3.2) in [
+        (p, pytest.approx(a), pytest.approx(b)) for p, a, b in windows
+    ] or any(p == "host_stall" and a == pytest.approx(3.0) for p, a, _ in windows)
+    # the non-overlapping phases still sum to ~end-to-end
+    total = sum(
+        durations.get(k, 0.0)
+        for k in ("queue_wait", "prefill", "decode", "preempt_stall")
+    )
+    assert total == pytest.approx(9.0)
+    # swap events without stall detail contribute nothing
+    durations, _ = attribute_phases(
+        [{"seq": 1, "t": 0.0, "kind": "submit"},
+         {"seq": 2, "t": 1.0, "kind": "swap_out"},
+         {"seq": 3, "t": 2.0, "kind": "finish"}]
+    )
+    assert "host_stall" not in durations
+
+
 def test_dump_crash_without_dir_returns_none(monkeypatch):
     monkeypatch.delenv("ACP_FLIGHT_DUMP_DIR", raising=False)
     rec = FlightRecorder()

@@ -185,6 +185,88 @@ def test_check_raises_and_counts(eng):
     assert counter("acp_engine_invariant_violations_total") > before
 
 
+def test_host_resident_page_leak_is_detected():
+    """PR 11 corruption class 1: KV swapped out to the host tier whose
+    bytes drift from the pool's entry accounting — RAM that can never be
+    restored or reclaimed. Seeded both ways: counter drift and an entry
+    vanishing behind the counter's back."""
+    e = make_engine(kv_pages=10, host_kv_bytes=1 << 22)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        with e.hold_admission():  # oversubscribe -> preempt -> swap out
+            futs = [e.submit(ch * 20, sp) for ch in "abcdef"]
+        for f in futs:
+            f.result(timeout=180)
+        assert e.kv_swap_outs >= 1
+        _settle(e)
+        # a park-expiry swap may land an entry; make one deterministically
+        if not len(e._host_pool):
+            from agentcontrolplane_tpu.ops.paged import HostKVEntry
+            import numpy as np
+
+            e._host_pool.put(HostKVEntry(
+                rid="seed", tokens=tuple(range(16)),
+                k=np.zeros((2, 16, 2, 8), dtype=np.float32),
+                v=np.zeros((2, 16, 2, 8), dtype=np.float32),
+            ))
+            e._publish_memory_state()
+        assert verify_engine(e) == []
+
+        e._host_pool.used_bytes += 123  # bytes with no entry: the leak
+        try:
+            problems = verify_engine(e)
+        finally:
+            e._host_pool.used_bytes -= 123
+        assert any("host KV pool leak" in p for p in problems)
+        # the engine mirror must also be flagged (stats() serves it)
+        assert any("_host_kv_used" in p for p in problems)
+
+        rid, entry = next(iter(e._host_pool._entries.items()))
+        del e._host_pool._entries[rid]  # entry gone, bytes still counted
+        try:
+            problems = verify_engine(e)
+        finally:
+            e._host_pool._entries[rid] = entry
+        assert any("host KV pool leak" in p for p in problems)
+        assert verify_engine(e) == []
+    finally:
+        e.stop()
+
+
+def test_shared_page_refcount_drift_is_detected(eng):
+    """PR 11 corruption class 2: a dedup'd/shared page freed while a
+    second owner still holds it — the next free would pool a live page and
+    hand it to two sequences. The fixture's parked slot + its prefix-cache
+    entry share pages (refcount 2), so dropping one ref leaves unshared
+    multi-ownership plus shared-counter drift."""
+    _settle(eng)
+    _, refs = eng._allocator.audit()
+    shared_pg = next(pg for pg, r in refs.items() if r > 1)
+    eng._allocator.free([shared_pg])  # one owner's ref silently dropped
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._allocator.share([shared_pg])  # restore the dropped reference
+    assert any("owners but refcount" in p for p in problems)
+    assert verify_engine(eng) == []
+
+    # incremental shared-counter drift is caught independently
+    eng._allocator._shared += 1
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._allocator._shared -= 1
+    assert any("shared_count" in p for p in problems)
+    # and the stats() mirror drift class
+    eng._prefix_shared_pages += 1
+    try:
+        problems = verify_engine(eng)
+    finally:
+        eng._prefix_shared_pages -= 1
+    assert any("_prefix_shared_pages" in p for p in problems)
+    assert verify_engine(eng) == []
+
+
 def test_invariant_break_fault_trips_end_to_end():
     """The deterministic fault site corrupts a mirror inside the engine
     loop; the armed checker must crash the engine, fail the in-flight

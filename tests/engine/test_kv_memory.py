@@ -1,0 +1,515 @@
+"""KV memory tiers: host-RAM offload + cross-request shared-prefix dedup.
+
+The load-bearing guarantees (acceptance criteria for the memory hierarchy):
+
+- **Swap round-trip byte identity** — a preempted/park-expired request
+  whose KV swapped to host RAM and back produces bit-identical greedy
+  output vs the knobs-off (discard-and-recompute) path, in both KV
+  layouts, with speculation on, across preempt-resume and park-adopt.
+- **Dedup byte identity** — refcount-shared prompt pages (a burst of
+  same-persona requests) never change what is sampled; they only change
+  how many physical copies of the prefix exist.
+- **Graceful degradation** — every swap failure (pool off, pool full,
+  injected ``engine.host_swap_slow`` / ``engine.host_swap_error``) falls
+  back to recompute, still byte-identically, with the armed invariant
+  checker auditing every dispatch cycle throughout.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.invariants import verify_engine
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.metrics import REGISTRY
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2)
+
+
+def make_engine(kv_layout="paged", **kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    # armed posture for the whole suite: every dispatch cycle audits the
+    # three pools (HBM pages, host entries, shared refcounts)
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG,
+        tokenizer=TOK,
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        page_size=8,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+def counter(name: str) -> float:
+    m = REGISTRY._metrics.get(name)
+    return 0.0 if m is None else m.values.get((), 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def _settle(eng: Engine) -> None:
+    """Wait for the engine loop to drain to idle so test-thread audits
+    don't race a dispatch cycle (memory mirrors publish per cycle)."""
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (eng._has_work() or len(eng._waiting)):
+        time.sleep(0.01)
+    time.sleep(0.1)
+
+
+# -- host-RAM offload tier: swap round-trip byte identity --------------------
+
+
+def test_swap_roundtrip_identical_paged_under_pool_pressure():
+    """Oversubscribed paged pool: preemptions swap KV to host and resume
+    swaps it back — outputs equal the uncontended runs exactly, and at
+    least one full swap round-trip is observed."""
+    eng = make_engine(kv_pages=10, host_kv_bytes=1 << 22)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcdef"]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        before_out = counter("acp_engine_kv_swap_out_total")
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp) for p in prompts]
+        results = dict(zip(prompts, (f.result(timeout=180) for f in futs)))
+        for p, r in results.items():
+            assert r.tokens == solo[p], f"swap round-trip diverged for {p!r}"
+            assert r.finish_reason in ("stop", "length")
+        assert eng.kv_swap_outs >= 1 and eng.kv_swap_ins >= 1
+        assert counter("acp_engine_kv_swap_out_total") > before_out
+        mem = eng.stats()["memory"]["host_kv"]
+        assert mem["enabled"] and mem["swap_ins"] == eng.kv_swap_ins
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+@pytest.mark.parametrize("kv_layout", ["slot", "paged"])
+def test_forced_preempt_swap_resume_identical_spec_on(kv_layout):
+    """Both layouts, speculation on: a forced preemption swaps out, the
+    resume swaps in, and greedy output matches the unpreempted run."""
+    eng = make_engine(kv_layout=kv_layout, host_kv_bytes=1 << 22, spec_len=4)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        base = eng.generate("hello world " * 4, sp).tokens
+        FAULTS.arm("engine.force_preempt", after_steps=2)
+        r = eng.generate("hello world " * 4, sp)
+        assert r.tokens == base
+        assert r.preempt_count >= 1
+        assert eng.kv_swap_outs >= 1 and eng.kv_swap_ins >= 1
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_swap_in_metered_through_chunked_budget_loop():
+    """With chunked prefill on, a swap-in restores through the token-budget
+    scheduler (budget-costed chunks) — byte-identical, and the restore's
+    chunks are flight-recorded as swap chunks."""
+    eng = make_engine(
+        kv_pages=10, host_kv_bytes=1 << 22, prefill_chunk=16,
+        prefix_cache_entries=0,
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcdef"]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=180).tokens == solo[p]
+        assert eng.kv_swap_ins >= 1
+        swap_chunks = eng.flight.events(
+            last=0, kind="prefill_chunk"
+        )
+        assert any(e.get("detail", {}).get("swap") for e in swap_chunks)
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_park_expiry_swaps_and_prefix_match_restores():
+    """A parked slot expiring swaps its prompt KV to host; the
+    conversation's next turn (different rid) restores it by token-prefix
+    match instead of re-prefilling — byte-identical to a cold run."""
+    eng = make_engine(
+        kv_pages=60, host_kv_bytes=1 << 22, park_max_s=0.2,
+        prefix_cache_entries=0,
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+        turn1 = "persona " * 5
+        cold = eng.generate(turn1 + "more text here", sp).tokens
+        eng.submit(turn1, sp, park=True).result(60)
+        deadline = time.monotonic() + 10
+        while eng.kv_swap_outs < 1 and time.monotonic() < deadline:
+            # keep the loop spinning so the park-expiry sweep runs
+            eng.submit("x", SamplingParams(temperature=0.0, max_tokens=1)).result(30)
+            time.sleep(0.02)
+        assert eng.kv_swap_outs >= 1, "park expiry never swapped out"
+        r = eng.generate(turn1 + "more text here", sp)
+        assert r.tokens == cold
+        assert eng.kv_swap_ins >= 1
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_host_tier_off_is_todays_behavior():
+    """host_kv_bytes=0 (the default): no pool, no swap events, no host
+    bytes — the preempt path is exactly the discard-and-recompute engine."""
+    eng = make_engine(kv_pages=10)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcd"]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=180).tokens == solo[p]
+        assert eng.preemptions >= 1
+        assert eng.kv_swap_outs == 0 and eng.kv_swap_ins == 0
+        assert eng.stats()["memory"]["host_kv"]["enabled"] is False
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_host_pool_budget_bounds_and_lru_evicts():
+    """A pool too small for every victim stays within budget (LRU) and
+    oversized entries are refused — resumes still byte-identical."""
+    # budget fits roughly one tiny entry: 2 layers * 2 heads * 64 dim *
+    # 2B * 2 (k+v) = 1KiB/row -> 16 rows/page = ~16KiB per page
+    eng = make_engine(kv_pages=10, host_kv_bytes=40 * 1024)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcdef"]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=180).tokens == solo[p]
+        assert eng._host_pool.used_bytes <= eng.host_kv_bytes
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+# -- cross-request shared-prefix dedup ---------------------------------------
+
+
+PERSONA = "p" * 40
+TAILS = [f"-{chr(97 + i) * 4}" for i in range(4)]
+
+
+@pytest.mark.parametrize("prefill_chunk", [0, 16])
+def test_dedup_burst_identical_and_shares_pages(prefill_chunk):
+    """A burst of same-persona requests admitted in one group shares the
+    persona's pages (1 copy, not N) and produces byte-identical outputs —
+    with and without chunked prefill (the mid-prefill-leader wait path)."""
+    eng = make_engine(
+        kv_pages=40, prefix_cache_entries=0, prefill_chunk=prefill_chunk,
+        spec_len=4,
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=8)
+        solo = {t: eng.generate(PERSONA + t, sp).tokens for t in TAILS}
+        with eng.hold_admission():
+            futs = [eng.submit(PERSONA + t, sp) for t in TAILS]
+        for t, f in zip(TAILS, futs):
+            assert f.result(timeout=180).tokens == solo[t], f"dedup diverged {t!r}"
+        assert eng.prefix_shares >= len(TAILS) - 1
+        share_events = eng.flight.events(last=0, kind="prefix_share")
+        assert len(share_events) >= len(TAILS) - 1
+        assert all(e["detail"]["pages"] >= 1 for e in share_events)
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_dedup_multiplies_concurrent_slots_at_fixed_page_budget():
+    """The capacity claim: at a pool too small for N private persona
+    copies, dedup admits the whole burst concurrently (shared prefix
+    pages), where dedup-off serializes it. Sizing: persona 48 tokens = 6
+    pages; each private row needs ~8 pages incl. decode growth, so 4
+    private copies (32) exceed the 23 usable pages while the shared form
+    (6 + 4x2) fits."""
+    persona = "q" * 48
+
+    def peak_concurrency(dedup: bool) -> int:
+        eng = make_engine(
+            kv_pages=24, prefix_cache_entries=0, prefix_dedup=dedup,
+            park_max_s=0.0,
+        )
+        try:
+            sp = SamplingParams(temperature=0.0, max_tokens=8)
+            streaming: set = set()
+            peak = [0]
+
+            def on_tokens(i):
+                def cb(_toks):
+                    streaming.add(i)
+                    live = eng.stats()
+                    peak[0] = max(
+                        peak[0], live["active_slots"] + live["prefilling_slots"]
+                    )
+                return cb
+
+            with eng.hold_admission():
+                futs = [
+                    eng.submit(persona + t, sp, on_tokens=on_tokens(i))
+                    for i, t in enumerate(TAILS)
+                ]
+            for f in futs:
+                f.result(timeout=180)
+            return peak[0]
+        finally:
+            eng.stop()
+
+    with_dedup = peak_concurrency(True)
+    without = peak_concurrency(False)
+    # persona = 5 pages/request private vs 1 shared copy: the 39-page pool
+    # (one trash page) fits all 4 shared but not 4 private + lookahead
+    assert with_dedup >= len(TAILS), (with_dedup, without)
+    assert with_dedup > without, (with_dedup, without)
+
+
+def test_dedup_off_never_shares():
+    eng = make_engine(kv_pages=40, prefix_cache_entries=0, prefix_dedup=False)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        with eng.hold_admission():
+            futs = [eng.submit(PERSONA + t, sp) for t in TAILS]
+        for f in futs:
+            f.result(timeout=180)
+        assert eng.prefix_shares == 0
+        assert eng.stats()["memory"]["prefix_dedup"]["enabled"] is False
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_parked_dedup_leader_released_for_capacity_admits_undeduped():
+    """When the ONLY parked capacity IS the chosen dedup leader, the
+    engine must release it for its slot id and admit the request
+    undeduped — not crash the dispatch thread resolving the vanished
+    leader's pages. (The leader's prompt shares a persona prefix with the
+    request but is not a strict prefix of it, so adoption can't apply.)"""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=1, max_ctx=128,
+        prefill_buckets=(32, 64, 128), decode_block_size=4,
+        kv_layout="paged", page_size=8, prefix_cache_entries=0,
+        check_invariants=True, park_max_s=30.0,
+    )
+    eng.start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        persona = "persona " * 4  # 32 shared tokens
+        other = persona + "-- a different task entirely"
+        solo = eng.generate(other, sp).tokens
+        eng.submit(persona + "conversation one", sp, park=True).result(60)
+        assert eng._parked_count == 1
+        r = eng.submit(other, sp).result(timeout=120)  # pre-fix: engine crash
+        assert r.tokens == solo
+        assert eng.park_releases >= 1
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_dedup_leader_preempted_mid_prefill_followers_recover():
+    """A dedup leader preempted mid-prefill rewinds its waiting followers
+    to the rows it actually wrote; everyone still finishes byte-identical
+    (the follower recomputes the gap into the shared pages)."""
+    eng = make_engine(kv_pages=40, prefix_cache_entries=0, prefill_chunk=8)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=8)
+        solo = {t: eng.generate(PERSONA + t, sp).tokens for t in TAILS}
+        FAULTS.arm("engine.preempt_mid_prefill", after_steps=1)
+        with eng.hold_admission():
+            futs = [eng.submit(PERSONA + t, sp) for t in TAILS]
+        for t, f in zip(TAILS, futs):
+            assert f.result(timeout=180).tokens == solo[t], (
+                f"follower diverged after leader preemption: {t!r}"
+            )
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_dedup_follower_of_adopted_leader_shares_full_page_list():
+    """A follower whose dedup leader is a just-ADOPTED parked slot in the
+    same admission group must share the leader's FULL page list (kept +
+    fresh), not the parked slot's stale kept-only list — a truncated
+    share maps rows between the park cut and the share cut to
+    never-written follower pages and decodes over garbage KV."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=128,
+        prefill_buckets=(32, 64, 128), decode_block_size=4,
+        kv_layout="paged", page_size=8, prefix_cache_entries=0,
+        check_invariants=True, park_max_s=30.0,
+    )
+    eng.start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        turn1 = "persona " * 6  # 48 tokens -> park_cut 48
+        next_turn = turn1 + "assistant said; next question"  # row 77, cut 72
+        solo = eng.generate(next_turn, sp).tokens
+        eng.submit(turn1, sp, park=True).result(60)
+        with eng.hold_admission():  # A adopts; B dedups on A past the cut
+            fa = eng.submit(next_turn, sp)
+            fb = eng.submit(next_turn, sp)
+        ra, rb = fa.result(timeout=120), fb.result(timeout=120)
+        assert eng.park_adoptions >= 1 and eng.prefix_shares >= 1
+        assert ra.tokens == solo
+        assert rb.tokens == solo, (
+            "follower of an adopted leader decoded over unwritten rows"
+        )
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_mid_restore_preempt_reputs_whole_entry():
+    """A slot preempted WHILE its swap-in is restoring must re-put the
+    whole consumed host entry (zero copy) — not just the rows that landed
+    — so the next resume still swaps in instead of recomputing."""
+    eng = make_engine(
+        kv_pages=12, host_kv_bytes=1 << 22, prefill_chunk=8,
+        prefix_cache_entries=0,
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        base = eng.generate("w" * 30, sp).tokens
+        chunks0 = eng.prefill_chunks
+        # preempt once at the first decode block (global decode_steps is
+        # already past 2) -> swap-out #1; then land a mid-prefill
+        # preemption DURING the resume's restore: the 30-token row takes 4
+        # initial chunks, so the restore's rounds run from chunks0+5 on
+        FAULTS.arm("engine.force_preempt", after_steps=2)
+        FAULTS.arm("engine.preempt_mid_prefill", after_steps=chunks0 + 5)
+        fut = eng.submit("w" * 30, sp)
+        r = fut.result(timeout=180)
+        assert r.tokens == base
+        assert r.preempt_count == 2
+        assert eng.kv_swap_outs == 2  # decode preempt + mid-restore re-put
+        tl = eng.flight.timeline(fut.rid)
+        outs = [e for e in tl if e["kind"] == "swap_out"]
+        ins = [e for e in tl if e["kind"] == "swap_in" and not e["detail"].get("error")]
+        assert len(outs) == 2 and ins
+        # the re-put preserved the WHOLE entry: the second offload and the
+        # final restore cover the first offload's rows, not just the few
+        # that landed before the mid-restore preemption
+        assert outs[1]["detail"]["tokens"] == outs[0]["detail"]["tokens"]
+        assert ins[-1]["detail"]["tokens"] == outs[0]["detail"]["tokens"]
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+# -- fault sites + combined stress -------------------------------------------
+
+
+def test_host_swap_error_falls_back_to_recompute_identically():
+    eng = make_engine(kv_pages=10, host_kv_bytes=1 << 22)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        base = eng.generate("hello world " * 4, sp).tokens
+        FAULTS.arm("engine.host_swap_error", times=1)
+        FAULTS.arm("engine.force_preempt", after_steps=2)
+        r = eng.generate("hello world " * 4, sp)
+        assert r.tokens == base
+        assert r.preempt_count >= 1
+        assert eng.kv_swap_outs == 0  # the swap-out failed; resume recomputed
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_host_swap_slow_stall_is_flight_recorded():
+    eng = make_engine(kv_pages=10, host_kv_bytes=1 << 22)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        base = eng.generate("hello world " * 4, sp).tokens
+        FAULTS.arm("engine.host_swap_slow", times=1, seconds=0.2)
+        FAULTS.arm("engine.force_preempt", after_steps=2)
+        fut = eng.submit("hello world " * 4, sp)
+        r = fut.result(timeout=180)
+        assert r.tokens == base
+        tl = eng.flight.timeline(fut.rid)
+        swaps = [e for e in tl if e["kind"] in ("swap_out", "swap_in")]
+        assert swaps, "no swap events on the preempted request's timeline"
+        assert any(e["detail"].get("stall_s", 0) > 0.1 for e in swaps)
+    finally:
+        eng.stop()
+
+
+def test_stress_pressure_swap_faults_preempt_invariants_armed():
+    """Satellite stress: oversubscribed paged pool + page_pressure + both
+    swap faults + force_preempt, invariants armed (make_engine default),
+    dedup-eligible prompts. Every output must equal its solo run."""
+    # cache off: the drain check below expects every page back in the
+    # pool, and live cache entries legitimately pin pages at idle
+    eng = make_engine(
+        kv_pages=16, host_kv_bytes=1 << 20, prefill_chunk=16,
+        prefix_cache_entries=0,
+    )
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=10)
+        prompts = [PERSONA + t for t in TAILS] + ["z" * 24]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        FAULTS.arm("engine.page_pressure", pages=4)
+        FAULTS.arm("engine.host_swap_slow", times=2, seconds=0.05)
+        FAULTS.arm("engine.host_swap_error", times=1)
+        FAULTS.arm("engine.force_preempt", after_steps=3)
+        streams = {p: [] for p in prompts}
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp, on_tokens=streams[p].extend) for p in prompts]
+        results = dict(zip(prompts, (f.result(timeout=300) for f in futs)))
+        for p, r in results.items():
+            assert r.tokens == solo[p], f"stress diverged for {p!r}"
+            assert streams[p] == list(r.tokens), "stream replayed across swap resume"
+        FAULTS.reset()
+        # pages all recycled once the burst drains (held pages released)
+        deadline = time.monotonic() + 5
+        while eng._allocator.free_count != eng.num_pages - 1:
+            assert time.monotonic() < deadline, "leaked KV pages"
+            time.sleep(0.05)
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()

@@ -223,8 +223,11 @@ def test_fault_force_preempt_resumes_identically_slot_mode():
 
 def test_fault_page_pressure_shrinks_pool_midserve():
     """Injected pool pressure (pages held out of the allocator) must force
-    preemption under concurrency while every response stays exact."""
-    eng = make_engine(kv_pages=17)  # 16 usable
+    preemption under concurrency while every response stays exact.
+    Dedup off: the three identical prompts would otherwise SHARE their
+    prompt pages (the PR 11 capacity multiplier) and fit the shrunken
+    pool without the preemption this test exists to exercise."""
+    eng = make_engine(kv_pages=17, prefix_dedup=False)  # 16 usable
     try:
         sp = SamplingParams(temperature=0.0, max_tokens=12)
         solo = eng.generate("m" * 20, sp).tokens

@@ -628,10 +628,22 @@ async def test_engine_status_exposes_decode_efficiency_and_spec_block():
             assert spec["enabled"] is True and spec["spec_len"] == 4
             for key in ("proposed", "accepted", "acceptance_rate", "verify_dispatches"):
                 assert key in spec
-            # the scrape-time gauge rides /metrics too
+            # KV memory tiers (ISSUE 11): the `memory` block must ride
+            # /v1/engine — host-tier occupancy + dedup payoff for ops
+            mem = doc["memory"]
+            assert mem["host_kv"]["enabled"] is False  # knob off here
+            assert mem["host_kv"]["used_bytes"] == 0
+            for key in ("swap_outs", "swap_ins", "max_bytes", "entries"):
+                assert key in mem["host_kv"]
+            assert mem["prefix_dedup"]["enabled"] is False  # slot layout
+            for key in ("shares", "shared_pages"):
+                assert key in mem["prefix_dedup"]
+            # the scrape-time gauges ride /metrics too
             h.operator.options.engine = eng
             text = await (await h.http.get(f"{h.base}/metrics")).text()
             assert "acp_engine_tokens_per_decode_step" in text
+            assert "acp_engine_host_kv_bytes" in text
+            assert "acp_engine_prefix_shared_pages" in text
     finally:
         eng.stop()
 
