@@ -60,5 +60,6 @@ lint:
 lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness contracts
 	$(PY) -m agentcontrolplane_tpu.analysis --metrics-docs docs/observability.md \
 		agentcontrolplane_tpu tests bench.py
+	-$(PY) -m agentcontrolplane_tpu.analysis --bench-trend .  # advisory: perf-trajectory sentinel
 
 ci: lint lint-acp test dryrun

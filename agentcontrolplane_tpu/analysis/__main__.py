@@ -43,7 +43,23 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         help="also check the acp_* metric inventory in this doc against "
         "every Registry call in the package (both drift directions fail)",
     )
+    ap.add_argument(
+        "--bench-trend",
+        nargs="?",
+        const=str(_PACKAGE_ROOT.parent),
+        default=None,
+        metavar="DIR",
+        help="bench-trajectory sentinel: normalize every BENCH_PR*.json "
+        "under DIR (default: the repo root) into one trend table and exit "
+        "nonzero on a regression past a per-metric tolerance (advisory in "
+        "CI; see analysis/bench_trend.py)",
+    )
     args = ap.parse_args(argv)
+    if args.bench_trend is not None:
+        # trend mode is exclusive: the lint gates run in their own step
+        from .bench_trend import main as trend_main
+
+        return trend_main(args.bench_trend)
     paths = args.paths or [str(_PACKAGE_ROOT)]
     violations = analyze(paths, rules=args.rule)
     if args.metrics_docs and not args.rule:

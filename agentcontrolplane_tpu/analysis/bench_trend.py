@@ -1,0 +1,205 @@
+"""bench-trend: the per-PR perf-fixture trajectory must not silently rot.
+
+Every PR records a bench doc via ``ACP_BENCH_PR_DOC`` (BENCH_PR6.json,
+BENCH_PR7.json, ...). Each doc pins that PR's fixture numbers — headline
+decode throughput, recorder/profiler overhead guards, KV-tier speedups —
+but nothing ever read them BACK: a PR that quietly regressed a prior PR's
+fixture would ship with a green CI. This sentinel normalizes the headline
+and fixture numbers of every ``BENCH_PR*.json`` into one trend table and
+exits nonzero when the newest sample of a metric regresses past its
+per-metric tolerance against the best prior same-platform sample.
+
+Advisory by design (``make lint-acp`` runs it with make's ``-`` prefix and
+CI marks the step ``continue-on-error``): most of the trajectory is
+CPU-fixture data whose absolute numbers are noisy, so the tolerances are
+wide and a trip is a prompt to look, not a hard gate. Comparisons only
+ever pair docs from the same backend (a CPU doc can never "regress" a TPU
+doc), and metrics missing from a doc are skipped — fixtures are additive
+per PR, not retroactive.
+
+Stdlib-only, like the rest of ``analysis/`` — runs from a bare checkout
+via ``python -m agentcontrolplane_tpu.analysis --bench-trend [DIR]``.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Optional
+
+_DOC_RE = re.compile(r"^BENCH_PR(\d+)\.json$")
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """One tracked trend series.
+
+    ``path``: key path into the bench doc. ``direction``: ``higher`` /
+    ``lower`` (better). ``rel_tol``: allowed relative worsening vs the best
+    prior same-platform sample. ``max_abs``: additionally, an absolute
+    ceiling (``lower`` metrics only — e.g. overhead contracts).
+    ``hardware_only``: judge regressions only on accelerator-backend docs —
+    absolute-throughput numbers from CPU fallback runs vary with machine
+    load and fixture knobs (the existing docs' headline notes show 100x
+    spread on the same backend), so a CPU sample is tabulated but never
+    tripped on; self-relative metrics (overheads, speedup ratios) stay
+    judged everywhere."""
+
+    name: str
+    path: tuple[str, ...]
+    direction: str = "higher"
+    rel_tol: float = 0.35
+    max_abs: Optional[float] = None
+    hardware_only: bool = False
+
+
+# wide tolerances: the trajectory is mostly CPU-fixture data. The overhead
+# guards (flight/prof) get absolute ceilings because their docs state a
+# hard contract (<2%, measured with noise margin).
+METRICS: tuple[MetricSpec, ...] = (
+    MetricSpec("decode_tok_s_per_chip", ("value",), "higher", 0.35,
+               hardware_only=True),
+    MetricSpec("mfu", ("mfu",), "higher", 0.35, hardware_only=True),
+    MetricSpec(
+        "flight_overhead_pct", ("flight", "overhead_pct"), "lower",
+        rel_tol=2.0, max_abs=3.0,
+    ),
+    MetricSpec(
+        "prof_overhead_pct", ("prof", "overhead_pct"), "lower",
+        rel_tol=2.0, max_abs=3.0,
+    ),
+    MetricSpec("swap_speedup_x", ("mem", "swap", "swap_speedup_x"), "higher", 0.5),
+    MetricSpec("dedup_capacity_x", ("mem", "dedup", "slot_capacity_x"), "higher", 0.5),
+    MetricSpec("tool_overlap_saved_pct", ("tool_turn", "saved_pct"), "higher", 0.5),
+    MetricSpec("goodput_ratio", ("prof", "goodput_ratio"), "higher", 0.25),
+)
+
+
+def _get(doc: dict, path: tuple[str, ...]) -> Optional[float]:
+    node: Any = doc
+    for key in path:
+        if not isinstance(node, dict) or key not in node:
+            return None
+        node = node[key]
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _platform(doc: dict) -> str:
+    plat = doc.get("platform") or {}
+    return str(plat.get("backend", "unknown"))
+
+
+def load_docs(root: str | Path) -> list[tuple[int, str, dict]]:
+    """``(pr_number, filename, doc)`` for every parseable BENCH_PR*.json
+    under ``root``, ordered by PR number. Unparseable docs are skipped with
+    a note in the doc slot (they can't anchor a comparison)."""
+    out: list[tuple[int, str, dict]] = []
+    root = Path(root)
+    if not root.is_dir():
+        return out
+    for p in sorted(root.iterdir()):
+        m = _DOC_RE.match(p.name)
+        if not m:
+            continue
+        try:
+            doc = json.loads(p.read_text(encoding="utf-8"))
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(doc, dict):
+            out.append((int(m.group(1)), p.name, doc))
+    out.sort(key=lambda t: t[0])
+    return out
+
+
+@dataclass
+class Regression:
+    metric: str
+    latest_doc: str
+    latest: float
+    baseline_doc: str
+    baseline: float
+    detail: str
+
+
+def check_trend(root: str | Path) -> tuple[str, list[Regression]]:
+    """(rendered trend table, regressions). Empty-regressions = healthy.
+
+    For each metric: collect (pr, doc, platform, value) samples; the
+    NEWEST sample is judged against the best PRIOR sample from the same
+    platform (best = max for ``higher`` metrics, min for ``lower``). A
+    metric with fewer than two same-platform samples can only trip its
+    ``max_abs`` ceiling."""
+    docs = load_docs(root)
+    lines: list[str] = []
+    regressions: list[Regression] = []
+    if not docs:
+        return "bench-trend: no BENCH_PR*.json docs found\n", []
+    header = f"{'metric':<26}" + "".join(
+        f"{f'PR{pr}':>12}" for pr, _, _ in docs
+    )
+    lines.append(header)
+    for spec in METRICS:
+        samples = [
+            (pr, name, _platform(doc), _get(doc, spec.path))
+            for pr, name, doc in docs
+        ]
+        row = f"{spec.name:<26}" + "".join(
+            f"{v:>12.3f}" if v is not None else f"{'-':>12}"
+            for _, _, _, v in samples
+        )
+        lines.append(row)
+        present = [s for s in samples if s[3] is not None]
+        if spec.hardware_only:
+            present = [s for s in present if s[2] not in ("cpu", "unknown")]
+        if not present:
+            continue
+        latest_pr, latest_name, latest_plat, latest = present[-1]
+        if spec.max_abs is not None and latest > spec.max_abs:
+            regressions.append(Regression(
+                spec.name, latest_name, latest, "(contract)", spec.max_abs,
+                f"{latest:.3f} exceeds the absolute ceiling {spec.max_abs}",
+            ))
+        prior = [s for s in present[:-1] if s[2] == latest_plat]
+        if not prior:
+            continue
+        if spec.direction == "higher":
+            b_pr, b_name, _, best = max(prior, key=lambda s: s[3])
+            floor = best * (1.0 - spec.rel_tol)
+            if latest < floor:
+                regressions.append(Regression(
+                    spec.name, latest_name, latest, b_name, best,
+                    f"{latest:.3f} < {floor:.3f} "
+                    f"(best prior {best:.3f} in {b_name}, "
+                    f"tol -{spec.rel_tol:.0%}, platform {latest_plat})",
+                ))
+        else:
+            b_pr, b_name, _, best = min(prior, key=lambda s: s[3])
+            # guard the sign: an overhead can be negative (noise); the
+            # relative ceiling only binds once the baseline is positive
+            ceiling = best * (1.0 + spec.rel_tol) if best > 0 else None
+            if ceiling is not None and latest > ceiling:
+                regressions.append(Regression(
+                    spec.name, latest_name, latest, b_name, best,
+                    f"{latest:.3f} > {ceiling:.3f} "
+                    f"(best prior {best:.3f} in {b_name}, "
+                    f"tol +{spec.rel_tol:.0%}, platform {latest_plat})",
+                ))
+    return "\n".join(lines) + "\n", regressions
+
+
+def main(root: str | Path) -> int:
+    """CLI body for ``--bench-trend``: print the table, report
+    regressions, exit 1 when any tripped."""
+    table, regressions = check_trend(root)
+    print(table, end="")
+    if regressions:
+        print(f"bench-trend: {len(regressions)} regression(s):")
+        for r in regressions:
+            print(f"  {r.metric}: {r.detail}")
+        return 1
+    print("bench-trend: trajectory healthy")
+    return 0

@@ -14,6 +14,7 @@ operation replaces that with one binary:
   acp-tpu contacts [respond <call-id> <text>]
   acp-tpu task create <agent> <message> [--follow]
   acp-tpu timeline [request-id]   (engine flight recorder)
+  acp-tpu perf                    (compute efficiency observatory)
 """
 
 from __future__ import annotations
@@ -690,6 +691,56 @@ def cmd_engine(args) -> int:
         return 0
 
 
+def cmd_perf(args) -> int:
+    """Compute efficiency observatory: per-program dispatch telemetry
+    (where device time goes, how much of each dispatch is padding), the
+    cold-compile observatory (compiles real traffic paid for after
+    prewarm), and the goodput/waste ledger (tokens computed vs emitted,
+    waste attributed by cause)."""
+    with _client(args) as http:
+        resp = http.get("/v1/engine/perf")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        doc = resp.json()
+        if args.json:
+            print(json.dumps(doc, indent=2))
+            return 0
+        g = doc.get("goodput", {})
+        computed = g.get("computed", 0)
+        print(
+            f"goodput: {g.get('goodput', 0)}/{computed} token positions "
+            f"({g.get('ratio', 1.0):.1%}); profiler "
+            f"{'enabled' if doc.get('enabled') else 'DISABLED'}, "
+            f"prewarmed={doc.get('prewarmed')}"
+        )
+        waste = {k: v for k, v in g.get("waste", {}).items() if v}
+        if waste:
+            print("waste by cause:")
+            for cause, n in sorted(waste.items(), key=lambda kv: -kv[1]):
+                pct = 100.0 * n / computed if computed else 0.0
+                print(f"  {cause:<18}{n:>12}  ({pct:.1f}%)")
+        cold = doc.get("cold_compiles", {})
+        if cold.get("serving"):
+            print(f"SERVING-TIME COLD COMPILES: {cold['serving']} "
+                  "(each was a latency stall — widen prewarm coverage)")
+            for ev in cold.get("events", []):
+                print(f"  {ev['program']:<34}{ev['wall_s'] * 1e3:>10.1f}ms")
+        programs = doc.get("programs", {})
+        if programs:
+            print(f"{'PROGRAM':<34}{'N':>7}{'HOST ms':>10}{'DEV ms':>10}"
+                  f"{'PAD%':>7}  TOKENS")
+            for key, p in list(programs.items())[: args.top]:
+                dev = p.get("device_ms_mean")
+                print(
+                    f"{key:<34}{p['dispatches']:>7}"
+                    f"{p['host_ms_mean']:>10.3f}"
+                    f"{dev if dev is not None else float('nan'):>10.3f}"
+                    f"{p['padding_pct']:>7.1f}  {p['real_tokens']}"
+                )
+        return 0
+
+
 def cmd_timeline(args) -> int:
     """Flight-recorder introspection: with a request id, replay that
     request's full decision sequence (admit, chunks, preempts, park/adopt,
@@ -859,6 +910,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     eng = sub.add_parser("engine", help="TPU engine status")
     eng.set_defaults(fn=cmd_engine)
+
+    pf = sub.add_parser(
+        "perf",
+        help="compute efficiency observatory: per-program dispatch "
+        "telemetry, cold compiles, goodput/waste accounting",
+    )
+    pf.add_argument("--json", action="store_true", help="raw JSON payload")
+    pf.add_argument(
+        "--top", type=int, default=20,
+        help="program rows to show (sorted by total host time)",
+    )
+    pf.set_defaults(fn=cmd_perf)
 
     tl = sub.add_parser(
         "timeline",

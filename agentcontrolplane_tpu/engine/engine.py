@@ -689,6 +689,15 @@ class Engine:
         from ..observability.flight import FlightRecorder
 
         self.flight = FlightRecorder()
+        # compute efficiency observatory (observability/profiler.py): per-
+        # dispatch program telemetry, cold-compile tracking, goodput/waste
+        # ledger. Public attribute like the flight recorder: REST/CLI read
+        # it via its declared cross-thread methods. ACP_PROF=0 reduces every
+        # hook to one bool branch (bench A/B), and the hooks never touch
+        # dispatch inputs/outputs — profiler on/off is byte-identical.
+        from ..observability.profiler import DispatchProfiler
+
+        self.profiler = DispatchProfiler(flight=self.flight)
         self.check_invariants = (
             bool(check_invariants)
             if check_invariants is not None
@@ -1153,7 +1162,28 @@ class Engine:
             self.prefill_chunk = ch
         if ch:
             self._prewarm_chunked(constrained)
+        # from here on, a first-dispatch-of-shape is a compile REAL traffic
+        # pays for: the profiler turns it into a cold_compile flight event
+        # + acp_engine_cold_compiles_total (serving-time latency bug)
+        self.profiler.mark_prewarmed()
         log.info("engine prewarm complete (constrained=%s)", constrained)
+
+    def _prewarm_gap(self, phase: str, **detail) -> None:
+        """A planned prewarm (bucket, batch) program shape never formed —
+        its compile WILL happen at serving time. Promoted from a bare log
+        line to data: a flight event plus a prewarm-coverage counter, so
+        the gap is alertable instead of buried in startup logs."""
+        log.warning(
+            "prewarm: %s batch never formed (%s)",
+            phase, " ".join(f"{k}={v}" for k, v in detail.items()),
+        )
+        self.flight.record("prewarm_gap", phase=phase, **detail)
+        REGISTRY.counter_add(
+            "acp_engine_prewarm_gaps_total", 1.0, labels={"phase": phase},
+            help="prewarm coverage gaps: a planned (bucket, batch) program "
+            "shape never formed during prewarm, so its compile will happen "
+            "at serving time (pair with acp_engine_cold_compiles_total)",
+        )
 
     def _prewarm_chunked(self, constrained: bool) -> None:
         """Warm the chunk loop's own shapes: multi-chunk prompts at every
@@ -1178,7 +1208,7 @@ class Engine:
                 if b in self._chunk_batch_sizes:
                     break
             else:
-                log.warning("prewarm: chunked batch B=%d never formed", b)
+                self._prewarm_gap("chunked", B=b)
             b *= 2
 
     def _prewarm_phases(self, constrained: bool = False) -> None:
@@ -1268,9 +1298,7 @@ class Engine:
                         if (b, Bsz) in self._full_batch_shapes:
                             break
                     else:
-                        log.warning(
-                            "prewarm: plain batch (bucket=%d, B=%d) never formed", b, Bsz
-                        )
+                        self._prewarm_gap("plain", bucket=b, B=Bsz)
                 Bsz *= 2
             # phase d: the prefix-cache CONTINUATION program: a seed request,
             # then hitting bursts at every power-of-two batch size up to
@@ -1311,7 +1339,7 @@ class Engine:
                             if b in self._cont_batch_sizes:
                                 break
                         else:
-                            log.warning("prewarm: continuation batch B=%d never formed", b)
+                            self._prewarm_gap("continuation", B=b)
                         b *= 2
                     with self._prefix_lock:
                         for key in [
@@ -1345,7 +1373,7 @@ class Engine:
                         if b in self._spill_batch_sizes:
                             break
                     else:
-                        log.warning("prewarm: spill batch B=%d never formed", b)
+                        self._prewarm_gap("spill", B=b)
                     b *= 2
 
     def cancel(self, future: Future) -> None:
@@ -1460,6 +1488,10 @@ class Engine:
             # flight recorder occupancy (the recorder's own methods take
             # its lock; self.flight is a public attribute, never mutated)
             "flight": self.flight.stats(),
+            # compute efficiency observatory: per-program dispatch stats,
+            # cold-compile tracking, goodput/waste ledger (the profiler's
+            # stats() is its declared cross-thread read surface)
+            "perf": self.profiler.stats(),
         }
         if self.kv_layout == "paged":
             out["kv_pages"] = {
@@ -1528,6 +1560,9 @@ class Engine:
                 # memory-tier mirrors/gauges refresh BEFORE the armed audit
                 # below, so mirror-vs-truth checks see post-cycle state
                 self._publish_memory_state()
+                # goodput/waste ledger counters + ratio gauge (delta-based;
+                # the scrape path refreshes them too via stats())
+                self.profiler.publish()
                 if self.check_invariants:
                     if self._faults.enabled and self._faults.pop(
                         "engine.invariant_break"
@@ -1931,6 +1966,7 @@ class Engine:
                     self._dummy_min_close,
                     self._put(np.ones(B, dtype=np.int32)),
                 )
+                prof_t0 = self.profiler.start()
                 if self.kv_layout == "paged":
                     P = self.page_size
                     page_ids = np.zeros((B, CH // P), dtype=np.int32)
@@ -1960,6 +1996,14 @@ class Engine:
                         self._put(slots),
                         *tail,
                     )
+                if self.profiler.enabled:
+                    # spill rounds run full CH-token rows: no bucket padding
+                    self.profiler.record(
+                        f"spill[{self.kv_layout},{CH}x{B}]", prof_t0,
+                        out=_tok, real_tokens=B * CH, real_slots=B,
+                    )
+                    pre = sum(CH for e in batch if e[0][0].prewarm)
+                    self.profiler.account(goodput=B * CH - pre, prewarm=pre)
                 for e in batch:
                     e[1] += CH
 
@@ -2126,7 +2170,9 @@ class Engine:
             else:
                 # offload the partial prompt KV before it is dropped — a
                 # control-plane retry of the same task prefix-matches it
-                self._swap_out(slot, sl, reason="expire")
+                if not self._swap_out(slot, sl, reason="expire") and not req.prewarm:
+                    # dropped outright: the chunks already spent are waste
+                    self.profiler.reclassify("preempt_discard", sl.prefill_pos)
                 self._drop_prefilling_slot(slot)
 
     def _drop_prefilling_slot(self, slot: int) -> _Slot:
@@ -2241,6 +2287,11 @@ class Engine:
                             "swap_in", rid=sl.request.rid, slot=slot,
                             error=True,
                         )
+                        # the preserved rows now get recomputed by model
+                        # chunks after all — host-swap-error recompute waste
+                        self.profiler.reclassify(
+                            "swap_recompute", self._swap_in_cut(sl)
+                        )
                         sl.swap_entry = None
                         aborted_slots.add(slot)
                         spent -= n  # nothing dispatched; refund the budget
@@ -2334,6 +2385,7 @@ class Engine:
             self._dummy_min_close,
             self._put(np.ones(B, dtype=np.int32)),
         )
+        prof_t0 = self.profiler.start()
         if self.kv_layout == "paged":
             P = self.page_size
             page_ids = np.full((B, bucket // P), TRASH_PAGE, dtype=np.int32)
@@ -2365,6 +2417,17 @@ class Engine:
                 self._put(starts),
                 self._put(slots),
                 *tail,
+            )
+        if self.profiler.enabled:
+            real = int(lengths.sum())
+            self.profiler.record(
+                f"chunk[{self.kv_layout},{bucket}x{B}]", prof_t0, out=_tok,
+                real_tokens=real, padded_tokens=B * bucket - real,
+                real_slots=B,
+            )
+            pre = sum(n for _, sl, _, n in batch if sl.request.prewarm)
+            self.profiler.account(
+                goodput=real - pre, prewarm=pre, pad_bucket=B * bucket - real
             )
 
     # -- prefix KV cache (slot layout) -----------------------------------
@@ -2414,7 +2477,12 @@ class Engine:
 
             fn = jax.jit(copy, donate_argnums=(0,))
             self._jit_copy_prefix[cut] = fn
+        prof_t0 = self.profiler.start()
         self.cache = fn(self.cache, jnp.int32(slot), entry["k"], entry["v"])
+        self.profiler.record(
+            f"prefix_copy[{cut}]", prof_t0, out=self.cache["k"],
+            real_tokens=cut, real_slots=1,
+        )
 
     def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:
         """After a prefill: snapshot the slot's leading KV as a reusable
@@ -2469,7 +2537,12 @@ class Engine:
 
                 fn = jax.jit(extract)  # read-only: cache NOT donated
                 self._jit_extract_prefix[cut] = fn
+            prof_t0 = self.profiler.start()
             ek, ev = fn(self.cache, jnp.int32(slot))
+            self.profiler.record(
+                f"prefix_extract[{cut}]", prof_t0, out=ek,
+                real_tokens=cut, real_slots=1,
+            )
             entry = {"cut": cut, "k": ek, "v": ev}
         with self._prefix_lock:
             self._prefix_cache[key] = entry
@@ -2787,6 +2860,7 @@ class Engine:
             min_close,
             self._put(budgets),
         )
+        prof_t0 = self.profiler.start()
         if self.kv_layout == "paged":
             P = self.page_size
             # suffix pages only (the model writes just the suffix; shared
@@ -2822,6 +2896,24 @@ class Engine:
                 self.params, self.cache, *common, self._put(slots), *tail
             )
         self.cache = cache
+        if self.profiler.enabled:
+            # program key mirrors the jit cache keying: kind x bucket x
+            # batch x layout, +tbl once the real grammar table shape traces
+            kind = "prefill_cont" if starts_np is not None else "prefill"
+            tbl = "+tbl" if table is not self._dummy_table else ""
+            real = int(lengths.sum())
+            self.profiler.record(
+                f"{kind}[{self.kv_layout},{bucket}x{B}{tbl}]", prof_t0,
+                out=firsts, real_tokens=real,
+                padded_tokens=B * bucket - real, real_slots=B,
+            )
+            pre = sum(
+                int(lengths[i]) for i, (r, _, _, _) in enumerate(chunk)
+                if r.prewarm
+            )
+            self.profiler.account(
+                goodput=real - pre, prewarm=pre, pad_bucket=B * bucket - real
+            )
         # snapshot prefixes for future hits (engine thread; the state can't
         # change before decode extends past the cut). Hit slots save too:
         # their rows/tables now hold the FULL prompt KV, so the next turn can
@@ -3113,7 +3205,11 @@ class Engine:
             req.resume_tokens = list(sl.generated[sl.prefix_len:])
         # host KV tier: offload the written rows before the pages go —
         # re-admission then swaps them back instead of re-running prefill
-        self._swap_out(slot, sl, reason="preempt")
+        rows_written = sl.prefill_pos if sl.prefilling else int(self._seq_lens[slot])
+        if not self._swap_out(slot, sl, reason="preempt") and not req.prewarm:
+            # no host copy landed: the written KV is discarded and the
+            # resume recomputes it — goodput retroactively becomes waste
+            self.profiler.reclassify("preempt_discard", rows_written)
         req.preempt_count += 1
         self.preemptions += 1
         self._state_dirty = True
@@ -3275,6 +3371,8 @@ class Engine:
             d["temps"], d["top_ks"], d["top_ps"], d["table"],
             d["con_states"], d["constrained"], d["min_close"], d["budgets"],
         )
+        n_act = self._n_active()
+        prof_t0 = self.profiler.start()
         if self.kv_layout == "paged":
             # block tables ride the same dirty discipline: re-uploaded only
             # when a page was appended (or the state itself was re-uploaded),
@@ -3292,6 +3390,21 @@ class Engine:
             )
         d["tokens"], d["seq_lens"], con_states_dev, d["budgets"], d["active"], d["rng"] = carry
         d["con_states"] = con_states_dev
+        KB = self.decode_block_size
+        prog_key = (
+            f"decode[{self.kv_layout},{W}x{KB}"
+            f"{'+tbl' if d['table'] is not self._dummy_table else ''}]"
+        )
+        if self.profiler.enabled:
+            # real/padded here are the DISPATCH-time view (lanes active as
+            # uploaded); mid-block deactivations land precisely in the
+            # account() call after the commit loop below
+            self.profiler.record(
+                prog_key, prof_t0,
+                out=tok_block, real_tokens=n_act * KB,
+                padded_tokens=(W - n_act) * KB,
+                real_slots=n_act, padded_slots=W - n_act,
+            )
         # ONE host round trip for both results — through a high-RTT link
         # sequential np.asarray fetches double the per-block latency floor.
         # con_states must stay mirrored so the next dirty upload (admission
@@ -3304,11 +3417,31 @@ class Engine:
         self.decode_steps += K
         # one event per decode dispatch (batch-level, not per slot/token):
         # a timeline reader sees the cadence, not a flood
-        self.flight.record("decode_block", width=W, steps=K, active=self._n_active())
+        self.flight.record(
+            "decode_block", width=W, steps=K, active=self._n_active(),
+            program=prog_key,
+        )
+        emitted = pre_emitted = 0
         for slot, sl in list(self._slots.items()):
             if sl.parked or sl.prefilling:
                 continue  # parked/mid-prefill lanes were not in this dispatch
+            n0 = len(sl.generated)
             self._consume_tokens(slot, sl, (int(tok_block[k, slot]) for k in range(K)))
+            # sl stays valid after a _finish pops the slot — the delta is
+            # this dispatch's committed tokens (stop tokens included: the
+            # termination signal is useful compute)
+            if sl.request.prewarm:
+                pre_emitted += len(sl.generated) - n0
+            else:
+                emitted += len(sl.generated) - n0
+        if self.profiler.enabled:
+            # every one of the W*K computed positions lands in exactly one
+            # cause: committed tokens are goodput (or prewarm), the rest —
+            # inactive lanes and post-finish steps — is width padding
+            self.profiler.account(
+                goodput=emitted, prewarm=pre_emitted,
+                pad_width=W * K - emitted - pre_emitted,
+            )
         self._publish_decode_gauges()
 
     def _consume_tokens(self, slot: int, sl: _Slot, toks) -> None:
@@ -3551,6 +3684,7 @@ class Engine:
             proposed[slot] = len(d)
         use_real = self._token_table is not None
         self._rng, step_rng = jax.random.split(self._rng)
+        prof_t0 = self.profiler.start()
         args = [
             self.params,
             self.cache,
@@ -3573,17 +3707,31 @@ class Engine:
             args.append(self._put(self._block_tables[:W]))
         cache, out_toks, n_emit, new_states = self._jit_verify(*args)
         self.cache = cache
+        spec_prog_key = (
+            f"spec_verify[{self.kv_layout},{W}x{T}{'+tbl' if use_real else ''}]"
+        )
+        if self.profiler.enabled:
+            n_part = int(active.sum())
+            real_in = int(n_input.sum())
+            self.profiler.record(
+                spec_prog_key, prof_t0,
+                out=out_toks, real_tokens=real_in,
+                padded_tokens=W * T - real_in,
+                real_slots=n_part, padded_slots=W - n_part,
+            )
         # one combined host round trip, same discipline as the block path
         out_toks, n_emit, new_states = jax.device_get((out_toks, n_emit, new_states))
         self._con_states[:W] = new_states
         self.decode_steps += 1  # one model forward, however many tokens land
         self.spec_dispatches += 1
         self._state_dirty = True  # host mirrors advanced; next block re-uploads
+        sp_emitted = sp_pre = sp_rejected = 0
         for slot, sl in list(self._slots.items()):
             if sl.parked or sl.prefilling:
                 continue
             n = int(n_emit[slot])
             prop = int(proposed[slot])
+            n_gen0 = len(sl.generated)
             if prop:
                 # emitted = accepted prefix + one corrected token — except
                 # when emission ended ON a matching draft token (stop token
@@ -3616,6 +3764,21 @@ class Engine:
                 )
             if n > 0:
                 self._consume_tokens(slot, sl, (int(t) for t in out_toks[slot, :n]))
+            d_tok = len(sl.generated) - n_gen0
+            if sl.request.prewarm:
+                sp_pre += d_tok
+            else:
+                sp_emitted += d_tok
+            if prop:
+                # positions the verify pass computed past the emitted
+                # prefix: rejected draft tail (the speculation gamble lost)
+                sp_rejected += max(0, 1 + prop - n)
+        if self.profiler.enabled:
+            self.profiler.account(
+                goodput=sp_emitted, prewarm=sp_pre,
+                spec_rejected=sp_rejected,
+                pad_width=W * T - sp_emitted - sp_pre - sp_rejected,
+            )
         if self.flight.enabled:
             # one aggregate event per verify dispatch: the propose/verify/
             # accept decision, with how much the drafts actually paid
@@ -3625,6 +3788,7 @@ class Engine:
                 proposed=int(sum(len(d) for d in drafts.values())),
                 emitted=int(sum(int(n_emit[s]) for s in drafts)),
                 forced_reject=force_reject,
+                program=spec_prog_key,
             )
         self._publish_decode_gauges()
         return True
@@ -4083,7 +4247,12 @@ class Engine:
                 fn = jax.jit(lambda c, ids: (c["k"][:, ids], c["v"][:, ids]))
                 self._jit_swap_gather[n] = fn
             ids = np.asarray(pages[i : i + n], dtype=np.int32)
-            chunks.append(fn(self.cache, self._put(ids)))
+            prof_t0 = self.profiler.start()
+            out = fn(self.cache, self._put(ids))
+            self.profiler.record(
+                f"swap_gather[{n}]", prof_t0, out=out[0], real_tokens=n * P
+            )
+            chunks.append(out)
             i += n
         for k, v in chunks:
             for a in (k, v):
@@ -4119,7 +4288,12 @@ class Engine:
 
                 fn = jax.jit(extract)  # read-only: cache NOT donated
                 self._jit_swap_extract[n] = fn
-            chunks.append(fn(self.cache, jnp.int32(slot), jnp.int32(start)))
+            prof_t0 = self.profiler.start()
+            out = fn(self.cache, jnp.int32(slot), jnp.int32(start))
+            self.profiler.record(
+                f"swap_extract[{n}]", prof_t0, out=out[0], real_tokens=n
+            )
+            chunks.append(out)
             start += n
         for k, v in chunks:
             for a in (k, v):
@@ -4159,8 +4333,13 @@ class Engine:
                 vb = entry.v[:, lo : lo + m * P].reshape(
                     entry.v.shape[0], m, P, *entry.v.shape[2:]
                 )
+                prof_t0 = self.profiler.start()
                 self.cache = fn(
                     self.cache, self._put(ids), self._put(kb), self._put(vb)
+                )
+                self.profiler.record(
+                    f"swap_scatter[{m}]", prof_t0, out=self.cache["k"],
+                    real_tokens=m * P,
                 )
                 i += m
         else:
@@ -4184,10 +4363,15 @@ class Engine:
 
                     fn = jax.jit(restore, donate_argnums=(0,))
                     self._jit_swap_restore[m] = fn
+                prof_t0 = self.profiler.start()
                 self.cache = fn(
                     self.cache, jnp.int32(slot), jnp.int32(pos),
                     self._put(entry.k[:, pos : pos + m]),
                     self._put(entry.v[:, pos : pos + m]),
+                )
+                self.profiler.record(
+                    f"swap_restore[{m}]", prof_t0, out=self.cache["k"],
+                    real_tokens=m,
                 )
                 pos += m
         return time.monotonic() - t0
@@ -4237,6 +4421,11 @@ class Engine:
                 and sl.share_of[1] == rid
             ):
                 if sl.prefill_pos > pos:
+                    # the follower re-runs rows its dead leader had covered:
+                    # the leader's compute of them is now waste
+                    self.profiler.reclassify(
+                        "dedup_rewind", sl.prefill_pos - pos
+                    )
                     sl.prefill_pos = pos
                     self._seq_lens[s] = pos
                 sl.share_of = None

@@ -36,6 +36,12 @@ armed (``ACP_INVARIANTS=1`` or ``Engine(check_invariants=True)``):
   stay within the configured budget, and match the engine's
   cross-thread mirrors; mid-restore and dedup-follower slots carry their
   transition state only while PREFILLING.
+- **goodput/waste token conservation** (compute efficiency observatory) —
+  the profiler's ledger must balance: computed token positions ==
+  goodput + Σ attributed waste causes, with every counter non-negative.
+  A dispatch site that adds compute without classifying it (or a
+  reclassification that isn't zero-sum) breaks the goodput ratio the
+  scheduler autopilot will steer by.
 
 ``verify_engine`` returns the violations as strings (tests corrupt state
 and assert on them); ``check_engine_invariants`` raises
@@ -168,8 +174,35 @@ def verify_engine(engine) -> list[str]:
         )
 
     problems.extend(_verify_host_pool(engine))
+    problems.extend(_verify_profiler(engine))
     if engine.kv_layout == "paged":
         problems.extend(_verify_pages(engine, slots))
+    return problems
+
+
+def _verify_profiler(engine) -> list[str]:
+    """Goodput/waste ledger conservation (observability/profiler.py):
+    every computed token position is classified exactly once, so
+    ``computed == goodput + sum(waste)`` must hold and no counter may go
+    negative. ``account()`` makes this true by construction; the audit
+    exists to catch a future dispatch site that bypasses it (or a
+    reclassification that isn't a zero-sum move)."""
+    problems: list[str] = []
+    led = engine.profiler.ledger()
+    computed, goodput, waste = led["computed"], led["goodput"], led["waste"]
+    total_waste = sum(waste.values())
+    if computed != goodput + total_waste:
+        problems.append(
+            f"goodput ledger conservation broken: {computed} computed token "
+            f"positions != {goodput} goodput + {total_waste} attributed "
+            "waste — a dispatch site is adding compute without classifying "
+            "it (or a reclassify was not zero-sum)"
+        )
+    if goodput < 0:
+        problems.append(f"goodput ledger negative: goodput {goodput} < 0")
+    negative = {c: n for c, n in waste.items() if n < 0}
+    if negative:
+        problems.append(f"negative waste-cause counters: {negative}")
     return problems
 
 

@@ -1,10 +1,11 @@
 from .flight import FlightRecorder, attribute_phases, phase_summaries
 from .metrics import REGISTRY, Registry
 from .otel_metrics import MetricsExporter
+from .profiler import DispatchProfiler, WASTE_CAUSES
 from .tracing import NOOP_TRACER, Span, Tracer, new_span_id, new_trace_id
 
 __all__ = [
     "REGISTRY", "Registry", "MetricsExporter", "NOOP_TRACER", "Span", "Tracer",
     "new_span_id", "new_trace_id", "FlightRecorder", "attribute_phases",
-    "phase_summaries",
+    "phase_summaries", "DispatchProfiler", "WASTE_CAUSES",
 ]

@@ -70,6 +70,9 @@ PHASES = (
 #   submit shed admit prefill_chunk prefill_done decode_block spec_verify
 #   preempt park adopt park_release tool_call expire cancel finish
 #   swap_out swap_in prefix_share invariant_violation crash restart
+#   cold_compile prewarm_gap (compute efficiency observatory: a compiled-
+#   program first-dispatch after prewarm, and a prewarm shape that never
+#   formed — see observability/profiler.py)
 
 
 def _trace_ids(trace) -> Optional[tuple[str, str]]:

@@ -1,0 +1,391 @@
+"""Compute efficiency observatory: per-dispatch program telemetry.
+
+The flight recorder (observability/flight.py) made the *scheduler's*
+decisions inspectable; this module is its compute-side twin. The engine
+dispatches a zoo of compiled programs — prefill buckets, chunk
+continuations, decode widths, spec verify, swap restores, prefix copies —
+and until this module nobody could answer "where does device time go, how
+much of each dispatch is padding, and how many computed tokens were thrown
+away?". Three layers, all hanging off one :class:`DispatchProfiler` owned
+by the engine (``engine.profiler``):
+
+- **per-program dispatch telemetry** — every device-dispatch site wraps its
+  jit call in ``t0 = profiler.start()`` / ``profiler.record(key, t0, ...)``
+  where ``key`` names the compiled program the way the jit cache keys it
+  (kind × bucket/width × batch × layout, plus a ``+tbl`` marker for
+  programs whose trace shape changes once the grammar token table exists).
+  ``record`` accumulates host dispatch wall time, real-vs-padded token and
+  slot counts, and — SAMPLED, every ``sample_every``-th dispatch per
+  program, to bound overhead — a ``jax.block_until_ready`` device-inclusive
+  time. Each dispatch also lands one ``acp_engine_dispatch_seconds
+  {program=}`` observation (dispatch granularity, never per token: the same
+  always-on-cheap posture as the flight recorder; ``ACP_PROF=0`` reduces
+  every hook to one bool branch for bench A/B).
+
+- **cold-compile observatory** — the FIRST dispatch of a program key is
+  where jit traces and compiles, so its wall time is recorded as that
+  program's compile cost (the first dispatch always blocks, so the number
+  is the real stall, not the async enqueue). Once the engine declares
+  prewarm complete (:meth:`mark_prewarmed`), any further first-dispatch is
+  a compile REAL TRAFFIC paid for — a serving-time latency bug. It records
+  a ``cold_compile`` flight event and increments
+  ``acp_engine_cold_compiles_total``, turning the silent "prewarm: batch
+  never formed" log line into an alertable signal.
+
+- **goodput/waste accounting** — dispatch sites classify every computed
+  token position into exactly one cause via :meth:`account`: ``goodput``
+  (prompt rows prefilled into live KV + sampled tokens committed), or a
+  waste cause (``pad_bucket`` prefill bucket padding, ``pad_width`` decode/
+  verify lane+step padding, ``spec_rejected`` rejected draft positions,
+  ``preempt_discard`` discarded-and-recomputed KV, ``swap_recompute``
+  host-swap-error recompute, ``dedup_rewind`` follower rewinds,
+  ``prewarm`` synthetic warm-up traffic). :meth:`reclassify` moves already-
+  counted goodput into a waste cause when the engine later discards it
+  (zero-sum, clamped), so conservation — ``computed == goodput + Σ waste``
+  — holds by construction and is audited by the armed invariant checker
+  (engine/invariants.py ``_verify_profiler``). Exported as
+  ``acp_engine_tokens_computed_total{cause=}`` plus the
+  ``acp_engine_goodput_ratio`` gauge.
+
+Cross-thread contract: the write side (``record``/``account``/
+``reclassify``) runs on the engine thread; the read side (``stats`` /
+``ledger`` / ``publish``) runs on REST scrape threads and takes the same
+lock — enforced by the acplint thread-ownership pass (read methods are
+declared ``# acp: cross-thread``; server code must go through them, never
+the profiler's privates).
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import time
+from typing import Any, Optional
+
+from .metrics import REGISTRY
+
+# every computed token position lands in goodput or exactly one of these
+WASTE_CAUSES = (
+    "pad_bucket",       # prefill rows padded to the compiled bucket
+    "pad_width",        # decode/verify lanes+steps beyond committed tokens
+    "spec_rejected",    # draft positions the verify pass rejected
+    "preempt_discard",  # KV discarded at preempt/expiry and recomputed
+    "swap_recompute",   # host-tier restore failed; preserved KV recomputed
+    "dedup_rewind",     # follower rewound past rows its dead leader wrote
+    "prewarm",          # synthetic warm-up traffic (compute, no serving)
+)
+
+COLD_EVENTS_KEPT = 32  # recent serving-time cold compiles kept for /perf
+
+
+class _Program:
+    """Mutable per-program aggregate (guarded by the profiler lock)."""
+
+    __slots__ = (
+        "dispatches", "host_s", "blocked_s", "blocked_samples",
+        "real_tokens", "padded_tokens", "real_slots", "padded_slots",
+        "first_wall_s", "cold",
+    )
+
+    def __init__(self) -> None:
+        self.dispatches = 0
+        self.host_s = 0.0
+        self.blocked_s = 0.0      # sampled dispatch-to-ready wall time
+        self.blocked_samples = 0
+        self.real_tokens = 0
+        self.padded_tokens = 0
+        self.real_slots = 0
+        self.padded_slots = 0
+        self.first_wall_s = 0.0   # first dispatch = trace + compile wall
+        self.cold = False         # first dispatch landed AFTER prewarm
+
+
+class DispatchProfiler:
+    """Per-dispatch program telemetry + cold-compile tracking + goodput
+    ledger. One per :class:`~agentcontrolplane_tpu.engine.engine.Engine`
+    (``engine.profiler``); ``flight`` (optional) receives ``cold_compile``
+    events so serving-time compiles appear inline with the scheduler
+    decisions that caused them."""
+
+    # A/B caveat: `enabled` is a plain mutable attribute (benches toggle it
+    # on a live engine). A program whose FIRST dispatch lands inside a
+    # disabled window is never registered, so it would read as a cold
+    # compile when re-enabled after mark_prewarmed() — toggle only on
+    # warmed engines whose program zoo is already registered (the shipped
+    # bench fixture runs its profiler-on warm-up leg first for exactly
+    # this reason), or re-baseline with a fresh profiler.
+
+    def __init__(
+        self,
+        flight=None,
+        enabled: Optional[bool] = None,
+        sample_every: Optional[int] = None,
+    ):
+        if enabled is None:
+            enabled = os.environ.get("ACP_PROF", "1") not in ("", "0")
+        if sample_every is None:
+            sample_every = int(os.environ.get("ACP_PROF_SAMPLE", "32"))
+        self.enabled = bool(enabled)
+        self.sample_every = max(1, int(sample_every))
+        self._flight = flight
+        self._lock = threading.Lock()
+        self._programs: dict[str, _Program] = {}
+        self._warm = False
+        self._cold_serving = 0
+        self._cold_events: "collections.deque[dict]" = collections.deque(
+            maxlen=COLD_EVENTS_KEPT
+        )
+        # the goodput/waste ledger: computed == goodput + sum(waste) holds
+        # by construction (account() adds both sides; reclassify() is a
+        # clamped zero-sum move) — the armed invariant checker audits it
+        self._computed = 0
+        self._goodput = 0
+        self._waste: dict[str, int] = {c: 0 for c in WASTE_CAUSES}
+        # registry values pushed so far, so publish() emits deltas and two
+        # concurrent publishers can't double-count
+        self._pub_tokens: dict[str, int] = {}
+        self._pub_prog: dict[tuple[str, str], int] = {}
+
+    # -- write side (engine thread) ---------------------------------------
+
+    def start(self) -> float:
+        """Stamp a dispatch about to be issued (0.0 when disabled — the
+        matching ``record`` is then skipped by its own guard)."""
+        return time.monotonic() if self.enabled else 0.0
+
+    def record(
+        self,
+        key: str,
+        t0: float,
+        out: Any = None,
+        real_tokens: int = 0,
+        padded_tokens: int = 0,
+        real_slots: int = 0,
+        padded_slots: int = 0,
+    ) -> None:
+        """One dispatch of compiled program ``key``: host wall time since
+        ``t0`` plus real/padded token+slot counts. ``out`` (any jax value
+        the dispatch produced) lets the sampled legs — and always the FIRST
+        dispatch of a key, whose wall time is the compile cost — block
+        until device-ready for a device-inclusive time. Sampling bounds the
+        overhead; blocking changes timing only, never values, so profiler
+        on/off stays byte-identical."""
+        if not self.enabled or not t0:
+            # t0 == 0.0 means start() ran while the profiler was disabled
+            # and `enabled` flipped mid-dispatch (bench A/B legs toggle it
+            # from another thread) — a time-since-boot "duration" from the
+            # zero stamp would corrupt the program's stats
+            return
+        host_s = time.monotonic() - t0
+        with self._lock:
+            p = self._programs.get(key)
+            first = p is None
+            if first:
+                p = self._programs[key] = _Program()
+            sample = first or (p.dispatches % self.sample_every == 0)
+        blocked_s = None
+        if sample and out is not None:
+            import jax
+
+            jax.block_until_ready(out)
+            blocked_s = time.monotonic() - t0
+        cold = False
+        wall = blocked_s if blocked_s is not None else host_s
+        with self._lock:
+            p.dispatches += 1
+            p.host_s += host_s
+            p.real_tokens += int(real_tokens)
+            p.padded_tokens += int(padded_tokens)
+            p.real_slots += int(real_slots)
+            p.padded_slots += int(padded_slots)
+            if blocked_s is not None:
+                p.blocked_s += blocked_s
+                p.blocked_samples += 1
+            if first:
+                p.first_wall_s = wall
+                if self._warm:
+                    p.cold = True
+                    self._cold_serving += 1
+                    self._cold_events.append(
+                        {"program": key, "wall_s": round(wall, 6),
+                         "t": round(t0, 6)}
+                    )
+                    cold = True
+        REGISTRY.observe(
+            "acp_engine_dispatch_seconds", host_s, labels={"program": key},
+            help="host wall time per device dispatch, by compiled program "
+            "(kind x bucket/width x batch x layout); sampled legs include "
+            "block_until_ready device time in the per-program stats",
+        )
+        if cold:
+            REGISTRY.counter_add(
+                "acp_engine_cold_compiles_total", 1.0,
+                help="first-dispatch-of-shape events AFTER prewarm declared "
+                "completion — compiles real traffic paid for at serving "
+                "time (each is a latency bug: widen prewarm coverage)",
+            )
+            if self._flight is not None:
+                self._flight.record(
+                    "cold_compile", program=key, wall_s=round(wall, 6)
+                )
+
+    def account(self, goodput: int = 0, **waste: int) -> None:
+        """Classify one dispatch's computed token positions: ``goodput``
+        plus any :data:`WASTE_CAUSES` keywords. The computed total is the
+        sum of what the caller passes, so ledger conservation holds by
+        construction; an unknown cause raises (programming error)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            total = int(goodput)
+            self._goodput += int(goodput)
+            for cause, n in waste.items():
+                if cause not in self._waste:
+                    raise KeyError(f"unknown waste cause {cause!r}")
+                if n:
+                    self._waste[cause] += int(n)
+                    total += int(n)
+            self._computed += total
+
+    def reclassify(self, cause: str, n: int) -> None:
+        """Move ``n`` already-goodput token positions into ``cause`` — the
+        engine discarded compute it had counted useful (preemption without
+        a host swap, a failed restore, a dedup follower rewind). Zero-sum
+        and clamped at the available goodput, so conservation survives
+        over-estimates (e.g. prefix-cache rows that were never computed in
+        this admission)."""
+        if not self.enabled or n <= 0:
+            return
+        if cause not in self._waste:
+            raise KeyError(f"unknown waste cause {cause!r}")
+        with self._lock:
+            n = min(int(n), self._goodput)
+            if n <= 0:
+                return
+            self._goodput -= n
+            self._waste[cause] += n
+
+    def mark_prewarmed(self) -> None:
+        """Prewarm coverage is complete: every LATER first-dispatch of a
+        program key is a serving-time cold compile (flight event +
+        ``acp_engine_cold_compiles_total``)."""
+        with self._lock:
+            self._warm = True
+
+    # -- read side (engine loop per cycle + REST scrape threads) ----------
+
+    def publish(self) -> None:  # acp: cross-thread
+        """Push ledger counters (as deltas) and the goodput-ratio gauge to
+        the registry. Called per scheduler cycle by the engine loop and at
+        scrape time; safe from any thread (delta bookkeeping happens under
+        the profiler lock, so concurrent publishers never double-count)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            token_deltas: list[tuple[str, int]] = []
+            for cause, n in [("goodput", self._goodput), *self._waste.items()]:
+                d = n - self._pub_tokens.get(cause, 0)
+                if d:
+                    token_deltas.append((cause, d))
+                    self._pub_tokens[cause] = n
+            prog_deltas: list[tuple[str, str, int]] = []
+            for key, p in self._programs.items():
+                for kind, n in (("real", p.real_tokens), ("padded", p.padded_tokens)):
+                    d = n - self._pub_prog.get((key, kind), 0)
+                    if d:
+                        prog_deltas.append((key, kind, d))
+                        self._pub_prog[(key, kind)] = n
+            computed, goodput = self._computed, self._goodput
+        for cause, d in token_deltas:
+            REGISTRY.counter_add(
+                "acp_engine_tokens_computed_total", float(d),
+                labels={"cause": cause},
+                help="computed token positions by outcome: goodput (live KV "
+                "+ committed tokens) vs the waste causes (bucket/width "
+                "padding, rejected drafts, preempt-discarded KV, host-swap "
+                "recompute, dedup rewinds, prewarm)",
+            )
+        for key, kind, d in prog_deltas:
+            REGISTRY.counter_add(
+                "acp_engine_dispatch_tokens_total", float(d),
+                labels={"program": key, "kind": kind},
+                help="token positions dispatched per compiled program, "
+                "split real vs padding (the per-program padding-waste "
+                "series behind the goodput accounting)",
+            )
+        REGISTRY.gauge_set(
+            "acp_engine_goodput_ratio",
+            (goodput / computed) if computed else 1.0,
+            help="goodput token positions / all computed token positions "
+            "(1.0 = no padding or discarded compute); see "
+            "acp_engine_tokens_computed_total for the waste attribution",
+        )
+
+    def ledger(self) -> dict[str, Any]:  # acp: cross-thread
+        """Snapshot of the goodput/waste ledger (the invariant checker's
+        conservation input): ``computed == goodput + sum(waste.values())``."""
+        with self._lock:
+            return {
+                "computed": self._computed,
+                "goodput": self._goodput,
+                "waste": dict(self._waste),
+            }
+
+    def stats(self) -> dict[str, Any]:  # acp: cross-thread
+        """The /v1/engine/perf payload: per-program dispatch stats, the
+        cold-compile observatory, and the goodput/waste ledger."""
+        self.publish()
+        with self._lock:
+            programs: dict[str, dict[str, Any]] = {}
+            for key, p in sorted(
+                self._programs.items(), key=lambda kv: -kv[1].host_s
+            ):
+                if not p.dispatches:
+                    # record() creates the entry, drops the lock for the
+                    # sampled block_until_ready, then increments — a scrape
+                    # landing in that window skips the half-born program
+                    continue
+                padded_pct = (
+                    round(100.0 * p.padded_tokens / (p.real_tokens + p.padded_tokens), 2)
+                    if (p.real_tokens + p.padded_tokens) else 0.0
+                )
+                programs[key] = {
+                    "dispatches": p.dispatches,
+                    "host_ms_total": round(p.host_s * 1e3, 3),
+                    "host_ms_mean": round(p.host_s / p.dispatches * 1e3, 4),
+                    "device_ms_mean": (
+                        round(p.blocked_s / p.blocked_samples * 1e3, 4)
+                        if p.blocked_samples else None
+                    ),
+                    "device_samples": p.blocked_samples,
+                    "real_tokens": p.real_tokens,
+                    "padded_tokens": p.padded_tokens,
+                    "padding_pct": padded_pct,
+                    "real_slots": p.real_slots,
+                    "padded_slots": p.padded_slots,
+                    "first_wall_ms": round(p.first_wall_s * 1e3, 3),
+                    "cold": p.cold,
+                }
+            waste = dict(self._waste)
+            computed, goodput = self._computed, self._goodput
+            doc = {
+                "enabled": self.enabled,
+                "sample_every": self.sample_every,
+                "prewarmed": self._warm,
+                "programs": programs,
+                "cold_compiles": {
+                    "serving": self._cold_serving,
+                    "events": list(self._cold_events),
+                },
+                "goodput": {
+                    "computed": computed,
+                    "goodput": goodput,
+                    "ratio": round(goodput / computed, 4) if computed else 1.0,
+                    "waste": waste,
+                },
+            }
+        return doc
+
+
+__all__ = ["DispatchProfiler", "WASTE_CAUSES"]

@@ -289,6 +289,7 @@ class RestServer:
         r.add_post("/v1/chat/completions", self.chat_completions)
         r.add_get("/v1/models", self.list_models)
         r.add_get("/v1/engine", self.engine_status)
+        r.add_get("/v1/engine/perf", self.engine_perf)
         r.add_get("/v1/engine/flight", self.engine_flight)
         r.add_get("/v1/requests/{rid}/timeline", self.request_timeline)
         r.add_get("/metrics", self.metrics)
@@ -1146,6 +1147,17 @@ class RestServer:
             return web.json_response({"configured": False})
         return web.json_response({"configured": True, **engine.stats()})
 
+    async def engine_perf(self, request: web.Request) -> web.Response:
+        """Compute efficiency observatory: per-program dispatch telemetry
+        (host/device time, real-vs-padded tokens), the cold-compile
+        observatory, and the goodput/waste ledger. The profiler's stats()
+        is its declared cross-thread read surface (same contract as the
+        flight recorder's read methods)."""
+        engine = self.operator.engine
+        if engine is None:
+            return _json_error(503, "no TPU engine configured")
+        return web.json_response({"configured": True, **engine.profiler.stats()})
+
     async def engine_flight(self, request: web.Request) -> web.Response:
         """Flight-recorder window (token-authed like every non-health
         route): the engine's recent scheduler decisions, last-N filterable
@@ -1274,6 +1286,11 @@ class RestServer:
                     "than one owner (cross-request shared-prefix dedup + "
                     "prefix cache)",
                 )
+                # compute efficiency observatory: no re-set needed here —
+                # the stats() call above ran profiler.stats(), whose
+                # publish() already refreshed acp_engine_goodput_ratio and
+                # the ledger counters from the same snapshot this scrape
+                # serves
             except Exception:
                 pass  # a crashed engine must not take /metrics down
 

@@ -56,6 +56,10 @@ ACP_BENCH_TOTAL_BUDGET_S, ACP_BENCH_RETRIES,
 ACP_BENCH_FLIGHT=1 / ACP_BENCH_FLIGHT_LEGS (flight-recorder on/off
 overhead guard on the headline burst — the <2% contract, emitted as the
 doc's additive ``flight`` block),
+ACP_BENCH_PROF=1 / ACP_BENCH_PROF_LEGS (dispatch-profiler on/off overhead
+guard on the headline burst — the compute efficiency observatory's <2%
+contract, emitted as the doc's additive ``prof`` block with the burst's
+goodput ratio),
 ACP_BENCH_MEM=1 / ACP_BENCH_MEM_PROMPT / ACP_BENCH_MEM_TASKS /
 ACP_BENCH_MEM_PERSONA / ACP_BENCH_MEM_HOST_BYTES (KV memory-tier
 fixture: preempt->resume swap-in vs recompute-prefill latency, and
@@ -511,6 +515,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["mem"] = val
             elif key == "flight" and "flight" not in doc:
                 doc["flight"] = val
+            elif key == "prof" and "prof" not in doc:
+                doc["prof"] = val
             else:
                 return
             _flush_doc(doc)
@@ -529,6 +535,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT mem", 900))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
+    if os.environ.get("ACP_BENCH_PROF", "0") == "1":
+        main_schedule.append(("RESULT prof", 900))
     if ttft_on:
         main_schedule.append(("RESULT ttft", ttft_timeout))
 
@@ -896,7 +904,9 @@ def _child(args: argparse.Namespace) -> None:
 
     if not args.only_ttft:
         tok_s, total, elapsed, done = measure(
-            drain=ttft_on or os.environ.get("ACP_BENCH_FLIGHT", "0") == "1"
+            drain=ttft_on
+            or os.environ.get("ACP_BENCH_FLIGHT", "0") == "1"
+            or os.environ.get("ACP_BENCH_PROF", "0") == "1"
         )
         _result("headline", {
             "tok_s_per_chip": round(tok_s, 1),
@@ -947,6 +957,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("flight", _bench_flight(engine, measure))
         except Exception as e:  # the fixture must not lose the headline
             _result("flight", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_PROF", "0") == "1"
+    ):
+        try:
+            _result("prof", _bench_prof(engine, measure))
+        except Exception as e:  # the fixture must not lose the headline
+            _result("prof", {"error": str(e)})
 
     if ttft_on or args.only_ttft:
         try:
@@ -1032,6 +1051,31 @@ def _bench_tool_turn(engine) -> dict:
     }
 
 
+def _ab_overhead_legs(set_enabled, measure, legs: int) -> tuple[float, float, float]:
+    """The interleaved on/off overhead protocol shared by the flight and
+    profiler guards: one discarded warm-up pair (interpreter/allocator
+    settling drifts the first CPU legs by 10-30%, swamping a 2% signal),
+    then ``legs`` pairs with alternating mode order so residual monotone
+    drift taxes both modes symmetrically, medians per mode (CPU legs are
+    noisy), percent overhead. The caller owns saving/restoring the real
+    enabled state around this."""
+    on_s: list[float] = []
+    off_s: list[float] = []
+    set_enabled(True)
+    measure(drain=True)
+    set_enabled(False)
+    measure(drain=True)
+    for i in range(legs):
+        order = (True, False) if i % 2 == 0 else (False, True)
+        for enabled in order:
+            set_enabled(enabled)
+            (on_s if enabled else off_s).append(measure(drain=True)[0])
+    on = sorted(on_s)[len(on_s) // 2]
+    off = sorted(off_s)[len(off_s) // 2]
+    overhead_pct = round(100.0 * (1.0 - on / off), 2) if off > 0 else 0.0
+    return on, off, overhead_pct
+
+
 def _bench_flight(engine, measure) -> dict:
     """Flight-recorder overhead guard (ACP_BENCH_FLIGHT=1): re-run the
     HEADLINE burst twice on the same warmed engine — recorder on (the
@@ -1043,30 +1087,16 @@ def _bench_flight(engine, measure) -> dict:
     Legs interleave on/off to cancel slow drift; each leg drains before
     the next so the engine is idle at every start."""
     legs = max(1, int(os.environ.get("ACP_BENCH_FLIGHT_LEGS", "2")))
-    on_s: list[float] = []
-    off_s: list[float] = []
     was_enabled = engine.flight.enabled
     ev0 = engine.flight.stats()["recorded_total"]
     try:
-        # one discarded pair first: interpreter/allocator warm-up drifts
-        # the first legs by 10-30% on CPU, which would swamp the 2% signal
-        engine.flight.enabled = True
-        measure(drain=True)
-        engine.flight.enabled = False
-        measure(drain=True)
-        for i in range(legs):
-            # alternate which mode runs first per pair: any residual
-            # monotone drift (cache/allocator settling) then hits both
-            # modes symmetrically instead of always taxing the same one
-            order = (True, False) if i % 2 == 0 else (False, True)
-            for enabled in order:
-                engine.flight.enabled = enabled
-                (on_s if enabled else off_s).append(measure(drain=True)[0])
+
+        def set_enabled(v: bool) -> None:
+            engine.flight.enabled = v
+
+        on, off, overhead_pct = _ab_overhead_legs(set_enabled, measure, legs)
     finally:
         engine.flight.enabled = was_enabled
-    on = sorted(on_s)[len(on_s) // 2]  # medians: CPU legs are noisy
-    off = sorted(off_s)[len(off_s) // 2]
-    overhead_pct = round(100.0 * (1.0 - on / off), 2) if off > 0 else 0.0
     events = engine.flight.stats()["recorded_total"] - ev0
     # the direct measurement the A/B legs bound from above: per-event
     # record() cost x events-per-burst is the recorder's whole bill
@@ -1091,6 +1121,67 @@ def _bench_flight(engine, measure) -> dict:
             f"warm-up pair discarded): {overhead_pct:+.2f}% overhead "
             f"(contract: < 2%); direct record() cost "
             f"{per_event_us:.2f}us/event at dispatch granularity"
+        ),
+    }
+
+
+def _bench_prof(engine, measure) -> dict:
+    """Dispatch-profiler overhead guard (ACP_BENCH_PROF=1): re-run the
+    HEADLINE burst with the compute efficiency observatory on (the
+    always-on default) vs ``profiler.enabled=False`` (the ``ACP_PROF=0``
+    posture) and report the throughput delta — the same interleaved-legs
+    protocol as the flight guard (_bench_flight), same <2%-on-this-fixture
+    contract: the profiler records at dispatch granularity (one short lock
+    + one registry observation per jitted dispatch, block_until_ready only
+    on sampled legs), so its cost must vanish against the dispatches it
+    measures. Also emits the measured burst's goodput ratio and top waste
+    causes — the numbers the observatory exists to produce."""
+    legs = max(1, int(os.environ.get("ACP_BENCH_PROF_LEGS", "2")))
+    was_enabled = engine.profiler.enabled
+    try:
+
+        def set_enabled(v: bool) -> None:
+            engine.profiler.enabled = v
+
+        on, off, overhead_pct = _ab_overhead_legs(set_enabled, measure, legs)
+        # the goodput numbers must describe the MEASURED burst, not the
+        # engine's whole life (prewarm + other fixtures would pollute the
+        # ratio, and off legs don't account at all — the trend sentinel
+        # gates on this number): one more profiled burst bracketed by
+        # ledger snapshots gives the clean window delta
+        engine.profiler.enabled = True
+        led0 = engine.profiler.ledger()
+        measure(drain=True)
+        led1 = engine.profiler.ledger()
+        perf = engine.profiler.stats()
+    finally:
+        engine.profiler.enabled = was_enabled
+    computed = led1["computed"] - led0["computed"]
+    goodput = led1["goodput"] - led0["goodput"]
+    ratio = round(goodput / computed, 4) if computed else 1.0
+    waste = {
+        k: led1["waste"][k] - led0["waste"].get(k, 0)
+        for k in led1["waste"]
+        if led1["waste"][k] - led0["waste"].get(k, 0)
+    }
+    top_waste = dict(sorted(waste.items(), key=lambda kv: -kv[1])[:3])
+    return {
+        "legs": legs,
+        "profiler_on_tok_s_per_chip": round(on, 1),
+        "profiler_off_tok_s_per_chip": round(off, 1),
+        "overhead_pct": overhead_pct,
+        "within_2pct": overhead_pct < 2.0,
+        "goodput_ratio": ratio,
+        "tokens_computed": computed,
+        "top_waste": top_waste,
+        "programs_profiled": len(perf["programs"]),
+        "note": (
+            f"headline burst, profiler on {on:.1f} vs off {off:.1f} "
+            f"tok/s/chip (median of {legs} interleaved leg pair(s), one "
+            f"warm-up pair discarded): {overhead_pct:+.2f}% overhead "
+            f"(contract: < 2%); goodput ratio {ratio:.3f} over "
+            f"{computed} computed token positions in one profiled burst, "
+            f"top waste {top_waste}"
         ),
     }
 

@@ -190,6 +190,31 @@ def test_thread_ownership_fires_on_chained_server_scope_reach(tmp_path):
     assert "_events" in violations[0].message
 
 
+def test_thread_ownership_fires_on_profiler_scope_server_reach(tmp_path):
+    """The compute-observatory extension of the chained-reach rule:
+    ``engine.profiler`` is a public handle like ``engine.flight``, but its
+    privates (the program table, the goodput ledger) are engine-written
+    state — server code must go through the profiler's declared
+    cross-thread read methods (``stats()`` / ``ledger()``), never
+    ``engine.profiler._programs``."""
+    root = _write(
+        tmp_path,
+        "server/handlers.py",
+        """
+        def perf(engine):
+            raw = engine.profiler._programs    # chained private reach
+            led = engine.profiler._goodput     # ledger privates too
+            ok = engine.profiler.stats()       # declared read method: fine
+            ok2 = engine.profiler.ledger()     # declared read method: fine
+            return len(raw), led, ok, ok2
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"] * 2
+    messages = " | ".join(v.message for v in violations)
+    assert "_programs" in messages and "_goodput" in messages
+
+
 def test_flight_recorder_cross_thread_reads_lint_clean(tmp_path):
     """The recorder's own posture — reads under its lock from methods
     declared cross-thread — must pass the pass that polices it."""

@@ -267,6 +267,43 @@ def test_shared_page_refcount_drift_is_detected(eng):
     assert verify_engine(eng) == []
 
 
+def test_goodput_ledger_conservation_break_is_detected(eng):
+    """ISSUE 12 corruption class: a dispatch site adding compute without
+    classifying it (or a non-zero-sum reclassify) breaks the goodput
+    ledger the scheduler autopilot will steer by. Seeded three ways:
+    unclassified compute, a negative waste counter, and negative
+    goodput."""
+    _settle(eng)
+    assert verify_engine(eng) == []
+    prof = eng.profiler
+
+    prof._computed += 7  # compute nothing classified
+    try:
+        problems = verify_engine(eng)
+    finally:
+        prof._computed -= 7
+    assert any("goodput ledger conservation broken" in p for p in problems)
+
+    pad0, comp0 = prof._waste["pad_bucket"], prof._computed
+    prof._waste["pad_bucket"] = -2
+    prof._computed = comp0 - pad0 - 2  # keep the sum balanced: only negativity trips
+    try:
+        problems = verify_engine(eng)
+    finally:
+        prof._waste["pad_bucket"], prof._computed = pad0, comp0
+    assert any("negative waste-cause counters" in p for p in problems)
+
+    good0, comp0 = prof._goodput, prof._computed
+    prof._goodput = -1
+    prof._computed = -1 + sum(prof._waste.values())  # balanced but negative
+    try:
+        problems = verify_engine(eng)
+    finally:
+        prof._goodput, prof._computed = good0, comp0
+    assert any("goodput ledger negative" in p for p in problems)
+    assert verify_engine(eng) == []
+
+
 def test_invariant_break_fault_trips_end_to_end():
     """The deterministic fault site corrupts a mirror inside the engine
     loop; the armed checker must crash the engine, fail the in-flight

@@ -1,0 +1,158 @@
+"""DispatchProfiler unit behavior (observability/profiler.py): program
+aggregation, sampled device timing, the cold-compile observatory, and the
+goodput/waste ledger's conservation-by-construction."""
+
+import numpy as np
+import pytest
+
+from agentcontrolplane_tpu.observability.metrics import REGISTRY
+from agentcontrolplane_tpu.observability.profiler import (
+    DispatchProfiler,
+    WASTE_CAUSES,
+)
+
+
+def counter(name: str, **labels) -> float:
+    m = REGISTRY._metrics.get(name)
+    if m is None:
+        return 0.0
+    return m.values.get(tuple(sorted(labels.items())), 0.0)
+
+
+def _conserved(prof: DispatchProfiler) -> bool:
+    led = prof.ledger()
+    return led["computed"] == led["goodput"] + sum(led["waste"].values())
+
+
+def test_record_aggregates_per_program_and_observes_histogram():
+    prof = DispatchProfiler(enabled=True, sample_every=2)
+    for _ in range(5):
+        t0 = prof.start()
+        prof.record("decode[slot,4x4]", t0, out=np.zeros(4),
+                    real_tokens=12, padded_tokens=4, real_slots=3,
+                    padded_slots=1)
+    doc = prof.stats()
+    p = doc["programs"]["decode[slot,4x4]"]
+    assert p["dispatches"] == 5
+    assert p["real_tokens"] == 60 and p["padded_tokens"] == 20
+    assert p["padding_pct"] == 25.0
+    assert p["real_slots"] == 15 and p["padded_slots"] == 5
+    # sampling: first always blocks, then every 2nd (dispatches 0, 2, 4)
+    assert p["device_samples"] == 3
+    assert p["device_ms_mean"] is not None
+    assert p["host_ms_mean"] >= 0.0 and p["first_wall_ms"] >= 0.0
+    count, window = REGISTRY.series_window(
+        "acp_engine_dispatch_seconds", {"program": "decode[slot,4x4]"}
+    )
+    assert count >= 5
+
+
+def test_cold_compiles_only_after_mark_prewarmed():
+    prof = DispatchProfiler(enabled=True)
+    before = counter("acp_engine_cold_compiles_total")
+    prof.record("prefill[slot,64x1]", prof.start())
+    assert prof.stats()["cold_compiles"]["serving"] == 0
+    assert counter("acp_engine_cold_compiles_total") == before
+    prof.mark_prewarmed()
+    # an already-known program stays warm
+    prof.record("prefill[slot,64x1]", prof.start())
+    assert prof.stats()["cold_compiles"]["serving"] == 0
+    # a NEW program key after prewarm is a serving-time cold compile
+    prof.record("prefill[slot,128x1]", prof.start())
+    doc = prof.stats()
+    assert doc["cold_compiles"]["serving"] == 1
+    assert doc["cold_compiles"]["events"][0]["program"] == "prefill[slot,128x1]"
+    assert doc["programs"]["prefill[slot,128x1]"]["cold"] is True
+    assert doc["programs"]["prefill[slot,64x1]"]["cold"] is False
+    assert counter("acp_engine_cold_compiles_total") == before + 1
+
+
+def test_cold_compile_records_flight_event():
+    from agentcontrolplane_tpu.observability.flight import FlightRecorder
+
+    flight = FlightRecorder(enabled=True)
+    prof = DispatchProfiler(flight=flight, enabled=True)
+    prof.mark_prewarmed()
+    prof.record("spill[paged,2048x4]", prof.start())
+    evs = flight.events(kind="cold_compile")
+    assert len(evs) == 1
+    assert evs[0]["detail"]["program"] == "spill[paged,2048x4]"
+    assert "wall_s" in evs[0]["detail"]
+
+
+def test_ledger_conservation_by_construction_and_reclassify_zero_sum():
+    prof = DispatchProfiler(enabled=True)
+    prof.account(goodput=100, pad_bucket=28, prewarm=10)
+    prof.account(goodput=50, pad_width=6, spec_rejected=4)
+    assert _conserved(prof)
+    led = prof.ledger()
+    assert led["computed"] == 198 and led["goodput"] == 150
+    prof.reclassify("preempt_discard", 40)
+    assert _conserved(prof)
+    led = prof.ledger()
+    assert led["goodput"] == 110 and led["waste"]["preempt_discard"] == 40
+    # clamp: reclassifying more than the available goodput stays zero-sum
+    prof.reclassify("dedup_rewind", 10_000)
+    assert _conserved(prof)
+    led = prof.ledger()
+    assert led["goodput"] == 0 and led["waste"]["dedup_rewind"] == 110
+    # zero/negative reclassify is a no-op
+    prof.reclassify("swap_recompute", 0)
+    prof.reclassify("swap_recompute", -5)
+    assert _conserved(prof)
+
+
+def test_unknown_waste_cause_raises():
+    prof = DispatchProfiler(enabled=True)
+    with pytest.raises(KeyError):
+        prof.account(goodput=1, bogus_cause=2)
+    prof.account(goodput=1)
+    with pytest.raises(KeyError):
+        prof.reclassify("bogus_cause", 1)
+    assert set(prof.ledger()["waste"]) == set(WASTE_CAUSES)
+
+
+def test_publish_pushes_delta_counters_and_ratio_gauge():
+    prof = DispatchProfiler(enabled=True)
+    base_good = counter("acp_engine_tokens_computed_total", cause="goodput")
+    base_pad = counter("acp_engine_tokens_computed_total", cause="pad_bucket")
+    prof.account(goodput=30, pad_bucket=10)
+    prof.publish()
+    assert counter("acp_engine_tokens_computed_total", cause="goodput") == base_good + 30
+    assert counter("acp_engine_tokens_computed_total", cause="pad_bucket") == base_pad + 10
+    # delta-based: a second publish with no new activity adds nothing
+    prof.publish()
+    assert counter("acp_engine_tokens_computed_total", cause="goodput") == base_good + 30
+    assert counter("acp_engine_goodput_ratio") == pytest.approx(0.75)
+    # per-program token split publishes too
+    prof.record("chunk[slot,32x2]", prof.start(), real_tokens=40, padded_tokens=24)
+    base_real = counter(
+        "acp_engine_dispatch_tokens_total", program="chunk[slot,32x2]", kind="real"
+    )
+    prof.publish()
+    assert counter(
+        "acp_engine_dispatch_tokens_total", program="chunk[slot,32x2]", kind="real"
+    ) == base_real + 40
+
+
+def test_disabled_profiler_is_inert():
+    prof = DispatchProfiler(enabled=False)
+    assert prof.start() == 0.0
+    prof.record("decode[slot,1x4]", 0.0, real_tokens=4)
+    prof.account(goodput=10, pad_width=2)
+    prof.reclassify("preempt_discard", 5)
+    prof.publish()
+    doc = prof.stats()
+    assert doc["enabled"] is False
+    assert doc["programs"] == {}
+    assert doc["goodput"]["computed"] == 0
+    assert doc["goodput"]["ratio"] == 1.0
+
+
+def test_env_knobs(monkeypatch):
+    monkeypatch.setenv("ACP_PROF", "0")
+    assert DispatchProfiler().enabled is False
+    monkeypatch.setenv("ACP_PROF", "1")
+    monkeypatch.setenv("ACP_PROF_SAMPLE", "7")
+    prof = DispatchProfiler()
+    assert prof.enabled is True and prof.sample_every == 7

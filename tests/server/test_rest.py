@@ -854,3 +854,122 @@ async def test_cli_timeline_against_live_server(capsys):
             assert rc == 1
     finally:
         eng.stop()
+
+
+async def test_perf_endpoint_and_cli(capsys):
+    """ISSUE 12: /v1/engine/perf serves the compute efficiency observatory
+    (per-program dispatch telemetry + cold compiles + goodput ledger) and
+    `acp-tpu perf` renders it."""
+    import dataclasses
+
+    import jax
+
+    from agentcontrolplane_tpu.cli import main as cli_main
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=2, max_ctx=256, prefill_buckets=(128, 256),
+    )
+    eng.start()
+    try:
+        eng.generate("perf drive", SamplingParams(temperature=0.0, max_tokens=6))
+        h = RestHarness()
+        h.operator.engine = eng
+        async with h:
+            resp = await h.http.get(f"{h.base}/v1/engine/perf")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["configured"] is True and doc["enabled"] is True
+            assert any(k.startswith("prefill[") for k in doc["programs"])
+            g = doc["goodput"]
+            assert g["computed"] == g["goodput"] + sum(g["waste"].values())
+            assert "cold_compiles" in doc
+            rc = await asyncio.to_thread(cli_main, ["--server", h.base, "perf"])
+            assert rc == 0
+            out = capsys.readouterr().out
+            assert "goodput:" in out and "PROGRAM" in out
+            rc = await asyncio.to_thread(
+                cli_main, ["--server", h.base, "perf", "--json"]
+            )
+            assert rc == 0
+            assert "programs" in capsys.readouterr().out
+    finally:
+        eng.stop()
+
+
+async def test_perf_endpoint_503_without_engine():
+    async with RestHarness() as h:
+        assert (await h.http.get(f"{h.base}/v1/engine/perf")).status == 503
+
+
+async def test_scrape_refresh_gauges_agree_with_engine_stats():
+    """Satellite (ISSUE 12): every engine-side gauge the scrape path
+    refreshes — the memory block (PR 11), the scheduler block (PR 7), and
+    the new perf block — must agree with Engine.stats() after activity.
+    Catches publisher/scrape drift: a gauge whose scrape-time refresh
+    reads a different field than stats() serves would silently fork the
+    dashboard from the API."""
+    import dataclasses
+    import re as _re
+
+    import jax
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    cfg = dataclasses.replace(PRESETS["tiny"], vocab_size=512, n_kv_heads=2)
+    eng = Engine(
+        config=cfg, tokenizer=ByteTokenizer(),
+        mesh=make_mesh({"tp": 2}, devices=jax.devices()[:2]),
+        max_slots=4, max_ctx=64, prefill_buckets=(32, 64),
+        decode_block_size=4, kv_layout="paged", page_size=8,
+        prefill_chunk=16, host_kv_bytes=1 << 22, spec_len=4,
+    )
+    eng.start()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=8)
+        futs = [eng.submit(f"scrape drift {i} " * 2, sp) for i in range(4)]
+        for f in futs:
+            f.result(timeout=120)
+        # idle engine: stats() is stable across the scrape and the compare
+        h = RestHarness()
+        h.operator.engine = eng
+        h.operator.options.engine = eng  # the scrape path reads options
+        async with h:
+            text = await (await h.http.get(f"{h.base}/metrics")).text()
+            s = eng.stats()
+
+            def gauge(name: str) -> float:
+                m = _re.search(rf"^{name} (\S+)$", text, _re.M)
+                assert m is not None, f"{name} missing from the scrape"
+                return float(m.group(1))
+
+            # scheduler block (PR 7)
+            assert gauge("acp_engine_active_slots") == s["active_slots"]
+            assert gauge("acp_engine_waiting_requests") == s["waiting"]
+            assert gauge("acp_engine_prefilling_slots") == s["prefilling_slots"]
+            assert gauge("acp_engine_tokens_per_decode_step") == pytest.approx(
+                s["tokens_per_decode_step"]
+            )
+            assert gauge("acp_engine_token_budget_utilization") == pytest.approx(
+                s["scheduler"]["budget_utilization_last"]
+            )
+            # memory block (PR 11)
+            assert gauge("acp_engine_host_kv_bytes") == s["memory"]["host_kv"]["used_bytes"]
+            assert gauge("acp_engine_prefix_shared_pages") == s["memory"][
+                "prefix_dedup"
+            ]["shared_pages"]
+            # perf block (this PR)
+            assert gauge("acp_engine_goodput_ratio") == pytest.approx(
+                s["perf"]["goodput"]["ratio"], abs=1e-3
+            )
+    finally:
+        eng.stop()
