@@ -74,6 +74,18 @@ METRICS: tuple[MetricSpec, ...] = (
     MetricSpec("dedup_capacity_x", ("mem", "dedup", "slot_capacity_x"), "higher", 0.5),
     MetricSpec("tool_overlap_saved_pct", ("tool_turn", "saved_pct"), "higher", 0.5),
     MetricSpec("goodput_ratio", ("prof", "goodput_ratio"), "higher", 0.25),
+    # fused megastep (PR 13): split-vs-fused dispatches-per-cycle ratio is
+    # self-relative (judged everywhere); the fused leg's absolute
+    # dispatches-per-cycle should hold near 1.0 on steady busy traffic
+    MetricSpec(
+        "megastep_dispatch_reduction_x",
+        ("megastep", "dispatch_reduction_x"), "higher", 0.5,
+    ),
+    MetricSpec(
+        "megastep_dispatches_per_cycle",
+        ("megastep", "megastep_on", "dispatches_per_chunk_cycle"),
+        "lower", rel_tol=0.5,
+    ),
 )
 
 

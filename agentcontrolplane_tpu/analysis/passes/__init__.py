@@ -7,10 +7,12 @@
 | jit-purity       | no host clock/RNG/global in traced/forward code  | PR 4   |
 | coord-wallclock  | wall-clock decisions are leader-local            | PR 4/7 |
 | budget-sharing   | token budgets computed only in the declared seam | PR 5   |
+| dispatch-seam    | compiled-program calls only at declared seams    | PR 13  |
 """
 
 from .budget_seam import BudgetSeamPass
 from .coord_wallclock import CoordWallclockPass
+from .dispatch_seam import DispatchSeamPass
 from .jit_purity import JitPurityPass
 from .lane_defaults import LaneDefaultsPass
 from .thread_ownership import ThreadOwnershipPass
@@ -21,6 +23,7 @@ ALL_PASSES = [
     JitPurityPass(),
     CoordWallclockPass(),
     BudgetSeamPass(),
+    DispatchSeamPass(),
 ]
 
 RULES = tuple(p.name for p in ALL_PASSES)
@@ -30,6 +33,7 @@ __all__ = [
     "RULES",
     "BudgetSeamPass",
     "CoordWallclockPass",
+    "DispatchSeamPass",
     "JitPurityPass",
     "LaneDefaultsPass",
     "ThreadOwnershipPass",

@@ -1,0 +1,74 @@
+"""dispatch-seam: device dispatches in the engine live at declared seams.
+
+Bug class (PR 13): the fused megastep exists so a busy engine cycle pays
+ONE device dispatch instead of 1 + #chunk-batches + #verify programs. That
+property regresses silently — any later feature that calls a compiled
+program (``self._jit_*``) from a new spot in the cycle loop quietly turns
+one-dispatch cycles back into multi-dispatch cycles, and nothing fails: the
+engine still serves, just slower. The dispatch count is a structural
+contract, so it gets a structural check.
+
+The rule: in any class that declares at least one ``# acp: megastep-seam``
+method, every LOAD of a ``self._jit_*`` attribute (calling it, aliasing it
+into a local, or probing it) must occur inside a method carrying the
+marker. The marked set IS the audited seam surface — the megastep dispatch
+itself, the split programs it falls back to, the admission-edge prefill,
+swap/prefix KV copies, and the upload guard. Writing a new dispatch site
+means either routing it through the megastep (the right answer for
+per-cycle work) or consciously declaring a new seam in review.
+
+Stores (``self._jit_x = jax.jit(...)`` in the builder) are exempt —
+assignment is construction, not dispatch. Reads of ``_jit_*`` via chained
+attributes (``engine._jit_decode`` from server code) are the
+thread-ownership pass's territory; this pass audits the engine class
+itself.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation
+
+_MARKER = "megastep-seam"
+_PREFIX = "_jit_"
+
+
+class DispatchSeamPass(LintPass):
+    name = "dispatch-seam"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+            methods = [
+                n
+                for n in cls.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            ]
+            seams = {
+                m.name for m in methods if sf.func_marker(m, _MARKER) is not None
+            }
+            if not seams:
+                continue
+            for fn in methods:
+                if fn.name in seams:
+                    continue
+                for node in ast.walk(fn):
+                    if (
+                        isinstance(node, ast.Attribute)
+                        and node.attr.startswith(_PREFIX)
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                    ):
+                        yield self.violation(
+                            sf,
+                            node,
+                            f"compiled-program access self.{node.attr} in "
+                            f"{fn.name}, outside the declared dispatch seams "
+                            f"({', '.join(sorted(seams))}) — a new dispatch "
+                            "site silently regresses one-dispatch cycles "
+                            "back to multi-dispatch; route per-cycle work "
+                            "through the megastep or declare the seam with "
+                            "'# acp: megastep-seam'",
+                        )

@@ -115,6 +115,29 @@ def _add_tpu_flags(p) -> None:
         "0 disables (byte-identical either way)",
     )
     p.add_argument(
+        "--tpu-megastep", type=int, default=1,
+        help="fused megastep dispatch: a busy chunked cycle's prefill "
+        "chunks + final-chunk continuations + decode block (or spec "
+        "verify) compile into ONE program, so the steady-state cycle "
+        "issues a single device dispatch (greedy outputs byte-identical "
+        "on/off; see docs/megastep.md); 0 = the split per-phase dispatches",
+    )
+    p.add_argument(
+        "--tpu-rate-planner", type=int, default=1,
+        help="admission-time chunk-rate planner: deadline requests get a "
+        "per-cycle chunk quota (tokens remaining / cycles until deadline, "
+        "reprojected on preempt-resume and park-adopt) instead of the "
+        "flat one-chunk cadence — deadlines met by arithmetic, not EDF "
+        "luck (see docs/megastep.md); 0 = flat cadence",
+    )
+    p.add_argument(
+        "--tpu-autopilot", type=int, default=0,
+        help="scheduler autopilot: steer --tpu-prefill-chunk / "
+        "--tpu-token-budget / --tpu-spec-len one bounded step at a time "
+        "from observed phase attribution, budget utilization and "
+        "speculative acceptance (see docs/megastep.md); 0 = off",
+    )
+    p.add_argument(
         "--tpu-park-max-s", type=float, default=30.0,
         help="overlapped tool execution: seconds a slot parked at "
         "generation end (prompt KV resident) waits for the conversation's "
@@ -143,6 +166,9 @@ def _build_engine(args, coordination=None):
         token_budget=args.tpu_token_budget,
         host_kv_bytes=args.tpu_host_kv_bytes,
         prefix_dedup=bool(args.tpu_prefix_dedup),
+        megastep=bool(args.tpu_megastep),
+        rate_planner=bool(args.tpu_rate_planner),
+        autopilot=bool(args.tpu_autopilot),
         coordination=coordination,
     )
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:
@@ -775,6 +801,20 @@ def cmd_timeline(args) -> int:
             print("phases (sum ~ end-to-end; tool_overlap_hidden overlaps decode):")
             for phase, dur in doc["phases"].items():
                 print(f"  {phase:<22}{dur * 1e3:>10.1f}ms")
+        if doc.get("rate_plan"):
+            rp = doc["rate_plan"]
+            print(
+                f"rate plan: quota {rp['quota']} chunk(s)/cycle, "
+                f"{rp['reprojections']} reprojection(s); actual "
+                f"{rp['chunks_dispatched']} chunks / {rp['chunk_tokens']} "
+                f"tokens over {rp['prefill_span_s'] * 1e3:.1f}ms"
+            )
+            for pr in rp["projections"]:
+                print(
+                    f"  {pr['reason']:<8} quota={pr['quota']} "
+                    f"tokens_left={pr['tokens_left']} "
+                    f"seconds_left={pr['seconds_left']}"
+                )
         return 0
 
 

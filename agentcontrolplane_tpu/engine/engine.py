@@ -213,6 +213,12 @@ class _Slot:
     prefilling: bool = False
     prefill_pos: int = 0
     prefill_row: Optional[list] = None
+    # admission-time chunk-rate plan (engine/planner.py): chunks of
+    # progress this slot should make per scheduler cycle so its deadline
+    # is met by arithmetic, not EDF luck. Projected at admission and
+    # reprojected on preempt→resume and park→adopt re-admissions; 1 for
+    # deadline-free requests (exactly the PR 7 one-chunk cadence).
+    chunk_quota: int = 1
     # host-tier swap-in: the HostKVEntry whose rows are being restored into
     # this slot's KV through the token-budget loop (one restore chunk per
     # scheduler cycle, budget-costed like a prefill chunk). Cleared when
@@ -324,6 +330,40 @@ class Engine:
         # how many positions commit). 0 disables (the default).
         spec_len: int = 0,
         spec_ngram: int = 3,  # longest n-gram the drafter matches on
+        # fused megastep dispatch: a busy chunked cycle's work — pending
+        # mid-prefill chunks, final-chunk continuation prefills, and the
+        # decode block (or the speculative verify pass) — compiles into ONE
+        # program, so the steady-state cycle issues a single device
+        # dispatch instead of 1 + #chunk-batches + #final-batches. Greedy
+        # outputs are byte-identical megastep on or off (the phases are the
+        # same model programs, cache-threaded in the same order; only the
+        # dispatch boundary moves). False = the PR 7 split dispatches, kept
+        # for A/B. Inert while nothing is mid-prefill (the plain decode /
+        # verify iteration is already one dispatch).
+        megastep: bool = True,
+        # bound on distinct fused program shapes: a NEW (chunk bucket x
+        # batch x decode width x phase-set) combination past this many
+        # falls back to the split dispatches for that cycle (which reuse
+        # already-compiled programs) instead of compiling yet another
+        # megastep variant — fusion must not turn the jit cache into a
+        # combinatorial zoo.
+        megastep_max_programs: int = 32,
+        # admission-time chunk-rate planner (engine/planner.py): deadline
+        # requests get a per-cycle chunk quota (tokens remaining / cycles
+        # until deadline) instead of the flat one-chunk-per-cycle cadence.
+        # Reprojected on preempt-resume and park-adopt. Inert without
+        # deadlines and under multi-host coordination (leader-local wall
+        # clock, same rule as EDF ordering).
+        rate_planner: bool = True,
+        planner_max_quota: int = 8,  # per-slot per-cycle chunk cap
+        # scheduler autopilot (engine/planner.py): every
+        # autopilot_interval busy cycles, steer prefill_chunk /
+        # token_budget / spec_len one bounded step from the flight
+        # recorder's phase attribution + budget utilization + spec
+        # acceptance. Off by default; constructor-disabled under
+        # coordination (host-local wall-clock inputs would fork lockstep).
+        autopilot: bool = False,
+        autopilot_interval: int = 128,
         # parked-slot lifetime: a slot parked at generation end (see
         # _Request.park) that no follow-up turn adopts within this window
         # is released. 0 disables parking entirely. Parking is also
@@ -643,6 +683,39 @@ class Engine:
         self.spec_proposed = 0  # draft tokens sent to verification
         self.spec_accepted = 0  # draft tokens the model agreed with
         self.spec_dispatches = 0  # verify dispatches issued
+        # fused megastep dispatch (see _megastep_dispatch). _fuse_pending
+        # carries one cycle's planned-but-undispatched chunk work from
+        # _prefill_chunks to the decode/verify dispatch site; it never
+        # survives a cycle (every _decode_once entry consumes it).
+        self.megastep = bool(megastep)
+        self.megastep_max_programs = max(0, int(megastep_max_programs))  # 0 = never fuse
+        self._fuse_pending: Optional[dict] = None
+        self._megastep_shapes: set[tuple] = set()  # fused shapes dispatched
+        self.megastep_dispatches = 0  # fused program dispatches issued
+        self.megastep_fallbacks = 0  # cycles split-dispatched (shape bound)
+        # admission-time chunk-rate planner + autopilot (engine/planner.py)
+        from .planner import Autopilot, AutopilotLimits, CycleClock
+
+        self.rate_planner = bool(rate_planner)
+        self.planner_max_quota = max(1, int(planner_max_quota))
+        self._cycle_clock = CycleClock()
+        self.quota_projections = 0  # rate plans issued (admit + reproject)
+        self.quota_reprojections = 0  # reprojections (resume/adopt)
+        self.autopilot_enabled = bool(autopilot) and coordination is None
+        self._autopilot = (  # acp: mirror (immutable; stats reads plain ints off it)
+            Autopilot(
+                AutopilotLimits(
+                    chunk_min=self.page_size if kv_layout == "paged" else 8,
+                    chunk_max=self.prefill_buckets[-1],
+                    budget_max=4 * self.max_slots * self.decode_block_size
+                    + 4 * self.prefill_buckets[-1],
+                    spec_len_max=16,
+                ),
+                interval=autopilot_interval,
+            )
+            if self.autopilot_enabled
+            else None
+        )
         # overlapped tool execution (see _stream / _park). _parked_count is
         # a plain int mirror of "slots in _slots with parked=True" so
         # cross-thread readers (stats()) never iterate the engine-mutated
@@ -706,7 +779,7 @@ class Engine:
 
         self._build_jitted()
 
-    def _put(self, x) -> jax.Array:
+    def _put(self, x) -> jax.Array:  # acp: megastep-seam — upload guard, not a model program
         if jax.process_count() > 1:
             # multihost: device_put cannot target non-addressable devices;
             # every process supplies its local shards of the same replicated
@@ -807,7 +880,10 @@ class Engine:
                 )
                 return cache, toks, (tokens, seq_lens, con_states, budgets, active, rng)
 
-            return jax.jit(decode_block, donate_argnums=(1, 2, 3, 4, 5, 10, 13))
+            # raw (unjitted): the split path jits it standalone; the fused
+            # megastep composes the same body so both paths trace the same
+            # graph per phase
+            return decode_block
 
         def make_verify(verify_fn):
             """Speculative verify + on-device accept in one dispatch: the
@@ -840,7 +916,52 @@ class Engine:
                 )
                 return cache, out_toks, n_emit, new_states
 
-            return jax.jit(verify_block, donate_argnums=(1,))
+            return verify_block  # raw; jitted standalone AND fused below
+
+        def make_megastep(mid_fn, final_fn, decode_block, verify_block):
+            """The fused per-cycle program (see _megastep_dispatch): one
+            compiled dispatch runs [mid-chunk KV writes] -> [final-chunk
+            continuation prefill + first-token sample] -> [decode block |
+            speculative verify], with the cache threaded phase to phase so
+            the write/read ordering is exactly the split path's dispatch
+            order. Each phase is the SAME raw body the split programs jit
+            standalone, so per-phase math is identical and greedy outputs
+            stay byte-identical. Absent phases pass None (an empty pytree:
+            presence is part of the trace, so every phase combination is
+            its own compiled shape — bounded by megastep_max_programs).
+            Donation: the cache and the decode carry arrays, matching the
+            split decode block's in-place reuse; dec_aux (temps/top_ks/
+            table/...) is host-retained across blocks and must NOT donate."""
+
+            def megastep(params, cache, mids, finals, dec_carry, dec_aux, ver):
+                f_out = d_out = v_out = None
+                if mids is not None:
+                    cache = mid_fn(params, cache, *mids)
+                if finals is not None:
+                    lanes, (f_rng, f_temps, f_top_ks, f_top_ps, f_table,
+                            f_con0, f_cst0, f_minc, f_budg) = finals
+                    cache, logits = final_fn(params, cache, *lanes)
+                    f_out = sample_first(
+                        logits, f_rng, f_temps, f_top_ks, f_top_ps, f_table,
+                        f_con0, f_cst0, f_minc, f_budg,
+                    )
+                if dec_carry is not None:
+                    tokens, seq_lens, con_states, budgets, active, rng = dec_carry
+                    temps, top_ks, top_ps, table, constrained, min_close, extra = dec_aux
+                    cache, toks, carry = decode_block(
+                        params, cache, tokens, seq_lens, active, rng, temps,
+                        top_ks, top_ps, table, con_states, constrained,
+                        min_close, budgets, *extra,
+                    )
+                    d_out = (toks, carry)
+                if ver is not None:
+                    cache, out_toks, n_emit, new_states = verify_block(
+                        params, cache, *ver
+                    )
+                    v_out = (out_toks, n_emit, new_states)
+                return cache, f_out, d_out, v_out
+
+            return jax.jit(megastep, donate_argnums=(1, 4))
 
         if self.kv_layout == "paged":
             from ..models.llama import (
@@ -869,18 +990,38 @@ class Engine:
                 paged_continue_and_sample, donate_argnums=(1,)
             )
             mesh = self.mesh
-            self._jit_decode_paged = make_decode_block(
+            decode_block = make_decode_block(
                 lambda params, pages, tokens, seq_lens, active, block_tables: decode_step_paged(
                     params, pages, tokens, seq_lens, block_tables, active, config,
                     use_pallas=use_pallas, mesh=mesh,
                 )
             )
+            self._jit_decode_paged = jax.jit(
+                decode_block, donate_argnums=(1, 2, 3, 4, 5, 10, 13)
+            )
             from ..models.llama import verify_paged_continue
 
-            self._jit_verify = make_verify(
+            verify_block = make_verify(
                 lambda params, pages, inputs, n_input, starts, block_tables: verify_paged_continue(
                     params, pages, inputs, n_input, starts, block_tables, config
                 )
+            )
+            self._jit_verify = jax.jit(verify_block, donate_argnums=(1,))
+            from ..models.llama import prefill_paged_continue_kv
+
+            self._jit_megastep = make_megastep(
+                lambda params, pages, toks, lens, starts, page_ids, tables: (
+                    prefill_paged_continue_kv(
+                        params, pages, toks, lens, starts, page_ids, tables, config
+                    )
+                ),
+                lambda params, pages, toks, lens, starts, page_ids, tables: (
+                    prefill_paged_continue(
+                        params, pages, toks, lens, starts, page_ids, tables, config
+                    )
+                ),
+                decode_block,
+                verify_block,
             )
         else:
 
@@ -901,17 +1042,37 @@ class Engine:
                 return cache, toks, states
 
             self._jit_prefill_continue = jax.jit(continue_and_sample, donate_argnums=(1,))
-            self._jit_decode = make_decode_block(
+            decode_block = make_decode_block(
                 lambda params, cache, tokens, seq_lens, active: decode_step(
-                    params, cache, tokens, seq_lens, config
+                    params, cache, tokens, seq_lens, config, active=active
                 )
+            )
+            self._jit_decode = jax.jit(
+                decode_block, donate_argnums=(1, 2, 3, 4, 5, 10, 13)
             )
             from ..models.llama import verify_continue
 
-            self._jit_verify = make_verify(
+            verify_block = make_verify(
                 lambda params, cache, inputs, n_input, starts: verify_continue(
                     params, cache, inputs, n_input, starts, config
                 )
+            )
+            self._jit_verify = jax.jit(verify_block, donate_argnums=(1,))
+            from ..models.llama import prefill_continue_kv
+
+            self._jit_megastep = make_megastep(
+                lambda params, cache, toks, lens, starts, slots_: (
+                    prefill_continue_kv(
+                        params, cache, toks, lens, starts, slots_, config
+                    )
+                ),
+                lambda params, cache, toks, lens, starts, slots_: (
+                    prefill_continue(
+                        params, cache, toks, lens, starts, slots_, config
+                    )
+                ),
+                decode_block,
+                verify_block,
             )
 
     # -- public API ------------------------------------------------------
@@ -1162,6 +1323,8 @@ class Engine:
             self.prefill_chunk = ch
         if ch:
             self._prewarm_chunked(constrained)
+            if self.megastep:
+                self._prewarm_megastep(constrained)
         # from here on, a first-dispatch-of-shape is a compile REAL traffic
         # pays for: the profiler turns it into a cold_compile flight event
         # + acp_engine_cold_compiles_total (serving-time latency bug)
@@ -1186,18 +1349,84 @@ class Engine:
         )
 
     def _prewarm_chunked(self, constrained: bool) -> None:
-        """Warm the chunk loop's own shapes: multi-chunk prompts at every
-        power-of-two batch size compile the KV-only chunk dispatch at the
-        chunk bucket plus the final-chunk continuation buckets."""
+        """Warm the SPLIT chunk loop's shapes: multi-chunk prompts at
+        every power-of-two batch size compile the KV-only chunk dispatch
+        at the chunk bucket plus the final-chunk continuation buckets.
+        Runs with the megastep temporarily OFF: these split programs are
+        the fused path's shape-bound fallback, so they must stay warm even
+        on a megastep engine (the fused shapes get their own phase,
+        _prewarm_megastep)."""
         K = self.decode_block_size
         CHK = self._chunk_tokens()
         long_len = min(self.max_ctx - K - 2, CHK * 2 + max(3, CHK // 2))
         if long_len <= CHK:
             return  # every admissible prompt fits one chunk: legacy shapes cover it
         one = SamplingParams(temperature=0.0, max_tokens=1, json_only=constrained)
+        ms, self.megastep = self.megastep, False
+        try:
+            b = 1
+            while b <= min(self.prefill_batch_max, self.max_slots):
+                for _attempt in range(5):
+                    with self.hold_admission():
+                        futs = [
+                            self.submit([1] * (long_len - i), one, _prewarm=True)
+                            for i in range(b)
+                        ]
+                    for f in futs:
+                        f.result(timeout=1800)
+                    if b in self._chunk_batch_sizes:
+                        break
+                else:
+                    self._prewarm_gap("chunked", B=b)
+                b *= 2
+        finally:
+            self.megastep = ms
+
+    def _prewarm_megastep(self, constrained: bool) -> None:
+        """Warm the fused megastep's core (bucket, batch, width) shapes:
+        one long-running decoder keeps a decode phase live while b long
+        prompts chunk through it, forming megastep[m{bucket}x{b}+d{W}x{K}]
+        (and the final-chunk / chunks-only variants along the way) for
+        every power-of-two b. Coverage is verified against the DISPATCHED
+        shape set, with the standard prewarm_gap flight event + counter on
+        a miss. Deliberately bounded: higher-occupancy decode widths and
+        spec-verify fusions compile on demand and surface through the
+        cold-compile observatory rather than paying a full width x batch x
+        phase-set matrix at startup."""
+        K = self.decode_block_size
+        CHK = self._chunk_tokens()
+        long_len = min(self.max_ctx - K - 2, CHK * 2 + max(3, CHK // 2))
+        if long_len <= CHK:
+            return  # nothing ever mid-prefills more than one chunk
+        mid_bucket = _next_bucket(min(CHK, long_len), self.prefill_buckets)
+        one = SamplingParams(temperature=0.0, max_tokens=1, json_only=constrained)
+
+        def mid_formed(b: int) -> bool:
+            want = f"m{mid_bucket}x{b}"
+            return any(
+                any(part.startswith(want) for part in sh[1])
+                for sh in self._megastep_shapes
+            )
+
         b = 1
-        while b <= min(self.prefill_batch_max, self.max_slots):
+        while b <= min(self.prefill_batch_max, max(1, self.max_slots - 1)):
             for _attempt in range(5):
+                # a decoder long enough to outlive the chunk cycles keeps
+                # the fused decode phase in every megastep of this burst
+                decode_for = (
+                    2 * K * (2 + b * -(-long_len // CHK))
+                )
+                anchor = self.submit(
+                    [1] * max(1, self.prefill_buckets[0] - 1),
+                    SamplingParams(temperature=0.0, max_tokens=decode_for),
+                    _prewarm=True,
+                )
+                anchor.admitted.result(timeout=1800)
+                steps0 = self.decode_steps
+                for _ in range(30000):  # bounded poll, no wall-clock compare
+                    if self.decode_steps != steps0:
+                        break
+                    time.sleep(0.002)
                 with self.hold_admission():
                     futs = [
                         self.submit([1] * (long_len - i), one, _prewarm=True)
@@ -1205,10 +1434,15 @@ class Engine:
                     ]
                 for f in futs:
                     f.result(timeout=1800)
-                if b in self._chunk_batch_sizes:
+                self.cancel(anchor)
+                with contextlib.suppress(Exception):
+                    anchor.result(timeout=1800)
+                # verified AFTER the attempt (like _prewarm_chunked): a
+                # shape forming on the final try must not record a gap
+                if mid_formed(b):
                     break
             else:
-                self._prewarm_gap("chunked", B=b)
+                self._prewarm_gap("megastep", bucket=mid_bucket, B=b)
             b *= 2
 
     def _prewarm_phases(self, constrained: bool = False) -> None:
@@ -1450,6 +1684,26 @@ class Engine:
                     round(min(1.0, self._budget_spent_total / self._budget_total), 4)
                     if self._budget_total else 0.0
                 ),
+                # fused megastep dispatch: one compiled program per busy
+                # cycle instead of 1 + #chunk-batches + #final-batches
+                "megastep": {
+                    "enabled": self.megastep,
+                    "dispatches": self.megastep_dispatches,
+                    "shapes": len(self._megastep_shapes),
+                    "max_programs": self.megastep_max_programs,
+                    "fallbacks": self.megastep_fallbacks,
+                },
+                # admission-time chunk-rate planner + autopilot
+                "planner": {
+                    "enabled": self.rate_planner,
+                    "quota_projections": self.quota_projections,
+                    "quota_reprojections": self.quota_reprojections,
+                    "autopilot": self.autopilot_enabled,
+                    "autopilot_adjustments": (
+                        self._autopilot.adjustments
+                        if self._autopilot is not None else 0
+                    ),
+                },
             },
             "spec": {
                 "enabled": self.spec_len > 0,
@@ -1563,6 +1817,8 @@ class Engine:
                 # goodput/waste ledger counters + ratio gauge (delta-based;
                 # the scrape path refreshes them too via stats())
                 self.profiler.publish()
+                if self._autopilot is not None:
+                    self._autopilot_tick()
                 if self.check_invariants:
                     if self._faults.enabled and self._faults.pop(
                         "engine.invariant_break"
@@ -1904,8 +2160,16 @@ class Engine:
                 # whole prefill
                 for item, start, swap, share in enriched:
                     req, slot, _pages, _m = item
+                    # re-admission edges REPROJECT the chunk-rate plan:
+                    # preempt->resume and park->adopt both land here
+                    reason = (
+                        "resume" if req.preempt_count
+                        else "adopt" if _m is not None and _m[1].get("in_slot")
+                        else "admit"
+                    )
                     self._begin_chunked_prefill(
-                        req, slot, start, swap=swap, share_of=share
+                        req, slot, start, swap=swap, share_of=share,
+                        reason=reason,
                     )
                 continue
             # host restores and dedup followers go through the prefilling
@@ -1930,7 +2194,8 @@ class Engine:
                     )
         return admitted
 
-    def _spill_long_chunks(self, enriched: list[list]) -> None:  # acp: dispatch-lanes toks,starts,slots,page_ids
+    def _spill_long_chunks(self, enriched: list[list]) -> None:  # acp: megastep-seam
+        # acp: dispatch-lanes toks,starts,slots,page_ids
         """Chunked prefill, batched across the admission group: round-robin
         one largest-bucket chunk per long request per dispatch (KV writes
         only; the sampled token is discarded) until every remainder fits one
@@ -2055,6 +2320,7 @@ class Engine:
         start: int,
         swap: Optional[object] = None,
         share_of: Optional[tuple] = None,
+        reason: str = "admit",
     ) -> None:
         """Admit a request as a PREFILLING slot: the slot id and (paged) KV
         pages are reserved and the prefix-cache start resolved, but no model
@@ -2076,11 +2342,98 @@ class Engine:
         sl.prefill_row = self._full_row(req)
         sl.swap_entry = swap
         sl.share_of = share_of
+        self._project_quota(slot, sl, reason)
         self._slots[slot] = sl
         self._prefilling_count += 1
         self._seq_lens[slot] = start
         self._last_tokens[slot] = 0
         self._state_dirty = True  # the lane must upload as inactive
+
+    def _project_quota(self, slot: int, sl: _Slot, reason: str) -> None:  # acp: leader-local
+        """Admission-time chunk-rate plan (engine/planner.py): convert the
+        request's deadline into a per-cycle chunk quota so the prefill
+        finishes by arithmetic, not EDF luck. Projected at admission and
+        REPROJECTED at the re-admission edge of every displacement event —
+        preempt→resume and park→adopt both re-enter here, so a displaced
+        request's plan always reflects its remaining tokens and remaining
+        time. Leader-local: deadlines are host wall clock, so followers
+        (and every rank under coordination — the EDF fallback rule) keep
+        quota 1."""
+        if self._coord_follower:
+            return
+        sl.chunk_quota = 1
+        if (
+            not self.rate_planner
+            or self._coordination is not None
+            or sl.request.deadline is None
+        ):
+            return
+        from .planner import project_quota
+
+        tokens_left = max(0, len(sl.prefill_row or []) - sl.prefill_pos)
+        seconds_left = sl.request.deadline - time.monotonic()
+        sl.chunk_quota = project_quota(
+            tokens_left,
+            self._chunk_tokens(),
+            seconds_left,
+            self._cycle_clock.cycle_s or 0.05,
+            max_quota=self.planner_max_quota,
+        )
+        self.quota_projections += 1
+        if reason != "admit":
+            self.quota_reprojections += 1
+            REGISTRY.counter_add(
+                "acp_engine_quota_reprojections_total", 1.0,
+                help="chunk-rate plans recomputed at a re-admission edge "
+                "(preempt-resume / park-adopt) — each is a displaced "
+                "request whose remaining-time arithmetic changed",
+            )
+        if not sl.request.prewarm:
+            self.flight.record(
+                "quota", rid=sl.request.rid, slot=slot,
+                quota=sl.chunk_quota, tokens_left=tokens_left,
+                seconds_left=round(max(0.0, seconds_left), 4),
+                reason=reason,
+            )
+
+    def _autopilot_tick(self) -> None:
+        """Scheduler autopilot (engine/planner.py): on interval
+        boundaries, let the observed phase attribution steer the
+        scheduling knobs one bounded step. The flight recorder graduates
+        from diagnostic to controller; every adjustment is itself a
+        flight event, so the control loop stays inspectable."""
+        ap = self._autopilot
+        if ap is None or not ap.due():
+            return
+        from ..observability.flight import phase_summaries
+
+        phases = {k: v.get("p99", 0.0) for k, v in phase_summaries().items()}
+        util = (
+            self._budget_spent_total / self._budget_total
+            if self._budget_total else 0.0
+        )
+        acc = (
+            self.spec_accepted / self.spec_proposed
+            if self.spec_proposed else None
+        )
+        knobs = {
+            "prefill_chunk": self.prefill_chunk,
+            "token_budget": self.token_budget,
+            "spec_len": self.spec_len,
+        }
+        changes = ap.step(phases, util, acc, knobs)
+        if not changes:
+            return
+        for knob, value in changes.items():
+            setattr(self, knob, value)
+        self.flight.record("autopilot", **{f"set_{k}": v for k, v in changes.items()})
+        REGISTRY.counter_add(
+            "acp_engine_autopilot_adjustments_total", 1.0,
+            help="scheduler-knob adjustments applied by the autopilot "
+            "(prefill_chunk / token_budget / spec_len steered from phase "
+            "attribution, budget utilization and spec acceptance)",
+        )
+        log.info("autopilot adjusted knobs: %s", changes)
 
     def _has_work(self) -> bool:
         """Anything the dispatch loop must advance: decoding or mid-prefill
@@ -2095,6 +2448,7 @@ class Engine:
         pinned by tests: decode dispatches EVERY cycle active slots exist
         (never starved by pending chunks), and at least one chunk advances
         per cycle (a tight budget throttles prefill, never deadlocks it)."""
+        t0 = time.monotonic()
         if not self._prefilling_count:
             # chunked off, or nothing mid-prefill: the legacy decode
             # iteration. Keyed on _prefilling_count, not the knob: slots
@@ -2102,6 +2456,7 @@ class Engine:
             # if prefill_chunk was toggled off mid-flight (benches/tests
             # A/B the knob on a live engine).
             self._decode_once()
+            self._cycle_clock.observe(time.monotonic() - t0)
             return
         self._apply_cancels()
         self._expire_prefilling()
@@ -2111,7 +2466,10 @@ class Engine:
             decode_reserve + self._chunk_tokens() * max(1, self._prefilling_count)
         )
         spent = self._prefill_chunks(max(0, budget - decode_reserve))
-        if self._n_active():
+        if self._n_active() or self._fuse_pending is not None:
+            # a fused cycle enters the decode site even with nothing
+            # decoding: the pending chunk lanes flush as a chunks-only
+            # megastep there
             steps0 = self.decode_steps
             self._decode_once()
             if self.decode_steps > steps0:
@@ -2121,6 +2479,7 @@ class Engine:
                 spent += n_active * min(
                     self.decode_steps - steps0, self.decode_block_size
                 )
+        self._cycle_clock.observe(time.monotonic() - t0)
         self._budget_last = (budget, spent)
         self._budget_spent_total += spent
         self._budget_total += budget
@@ -2194,16 +2553,124 @@ class Engine:
             self._tables_dirty = True
         return sl
 
+    def _use_megastep(self) -> bool:
+        """Fused dispatch applies: the knob is on and the cycle has chunk
+        work to fuse with the decode/verify dispatch. The non-chunked
+        engine never fuses — its cycle is already one dispatch."""
+        return self.megastep
+
+    def _slot_chunk_tokens(self, sl: _Slot, CHK: int) -> int:
+        """Per-cycle chunk size for one mid-prefill slot. The rate
+        planner's quota (chunks/cycle, engine/planner.py) collapses into
+        ONE larger continuation lane of quota*CHK tokens rather than
+        quota separate lanes — consecutive chunks of a slot cannot be
+        lanes of the same fused dispatch (the later lane would gather KV
+        rows the earlier lane writes in the same program), and one bigger
+        bucket is cheaper than quota dispatches in the split path too.
+        Capped at the largest compiled prefill bucket; CHK and the
+        buckets are page multiples, so paged alignment is preserved."""
+        q = sl.chunk_quota if self.rate_planner else 1
+        return min(max(1, q) * CHK, self.prefill_buckets[-1])
+
+    def _chunk_items(self, batch: list) -> list:
+        """(slot, sl, start, n) chunk tuples -> _prefill_group items."""
+        paged = self.kv_layout == "paged"
+        return [
+            (sl.request, slot,
+             self._slot_pages.get(slot) if paged else None, None)
+            for slot, sl, _st, _n in batch
+        ]
+
+    def _run_restores(
+        self, restores: list
+    ) -> tuple[set, int]:
+        """Dispatch this round's host-tier swap-in rows (host->device
+        copies — issued immediately in every mode; a copy cannot ride the
+        fused model program). Returns ``(aborted_slots, refunded_tokens)``:
+        a restore the ``engine.host_swap_error`` fault cancelled dispatched
+        nothing, so its budget refunds and it stays out of the round's
+        flight/counter record."""
+        aborted: set[int] = set()
+        refund = 0
+        if not restores:
+            return aborted, refund
+        with self._hol_clock():
+            for slot, sl, st, n in restores:
+                if self._faults.enabled and st == 0:
+                    spec = self._faults.pop("engine.host_swap_slow")
+                    if spec is not None:
+                        slow = float(spec.get("seconds", 0.05))
+                        time.sleep(slow)
+                        sl.swap_stall_s += slow  # attributed as host_stall
+                    if self._faults.pop("engine.host_swap_error") is not None:
+                        # restore "failed" before any rows landed: fall
+                        # back to recomputing the whole prefill (the entry
+                        # was consumed; byte-identity is unaffected)
+                        self.flight.record(
+                            "swap_in", rid=sl.request.rid, slot=slot,
+                            error=True,
+                        )
+                        # the preserved rows now get recomputed by model
+                        # chunks after all — host-swap-error recompute waste
+                        self.profiler.reclassify(
+                            "swap_recompute", self._swap_in_cut(sl)
+                        )
+                        sl.swap_entry = None
+                        aborted.add(slot)
+                        refund += n
+                        continue
+                sl.swap_stall_s += self._swap_in_rows(slot, sl.swap_entry, st, n)
+                sl.prefill_pos = st + n
+                self._seq_lens[slot] = sl.prefill_pos
+                if sl.prefill_pos >= self._swap_in_cut(sl):
+                    self._finish_swap_in(slot, sl)
+        return aborted, refund
+
+    def _record_chunk_round(
+        self, landed: list, spent: int, budget: int, restore_slots: set
+    ) -> None:
+        """One round's chunk bookkeeping, shared by the split path and the
+        megastep commit: per-chunk flight events (only chunks that really
+        dispatched), the round's budget-spend event, and the counters."""
+        self.prefill_chunks += len(landed)
+        if self.flight.enabled:
+            # the EDF/quota pick + budget spend this cycle: one event per
+            # chunk that actually dispatched plus the round's accounting
+            for slot, sl, st, n in landed:
+                if not sl.request.prewarm:
+                    self.flight.record(
+                        "prefill_chunk", rid=sl.request.rid, slot=slot,
+                        start=st, n=n,
+                        final=st + n >= len(sl.prefill_row or ()),
+                        swap=slot in restore_slots,
+                    )
+            self.flight.record(
+                "prefill_round", scheduled=len(landed), spent=spent,
+                budget=budget,
+            )
+        REGISTRY.counter_add(
+            "acp_engine_prefill_chunks_total", float(len(landed)),
+            help="prefill chunk dispatches (per-slot chunks) under the "
+            "unified token-budget scheduler",
+        )
+
     def _prefill_chunks(self, chunk_budget: int) -> int:
         """One scheduler round of chunked prefill: give each mid-prefill
-        slot at most ONE chunk, in deadline-weighted order (earliest
-        deadline first, then admission order; under multi-host coordination
-        deadlines are leader-local wall clock, so ordering falls back to
-        admission order — the same lockstep rule as deadline expiry), until
-        the chunk budget is spent. The first chunk always dispatches even
-        over budget (minimum-progress guarantee). Non-final chunks write KV
-        only; a final chunk samples the slot's first token and flips it to
-        decoding via the shared _prefill_group path. Returns tokens spent."""
+        slot its planned per-cycle chunk (the rate planner's quota; one
+        base chunk without a deadline), in deadline-weighted order
+        (earliest deadline first, then admission order; under multi-host
+        coordination deadlines are leader-local wall clock, so ordering
+        falls back to admission order — the same lockstep rule as deadline
+        expiry), until the chunk budget is spent. The first chunk always
+        dispatches even over budget (minimum-progress guarantee).
+        Non-final chunks write KV only; a final chunk samples the slot's
+        first token and flips it to decoding via the shared _prefill_group
+        path. With the megastep enabled, mid chunks and continuation
+        finals are PLANNED here but dispatch fused with this cycle's
+        decode/verify program (_fuse_pending -> _megastep_dispatch);
+        plain finals (start 0) keep the plain causal program — byte-for-
+        byte the chunked-off dispatch — and still join this cycle's
+        decode lanes. Returns tokens spent."""
         pre = [(s, sl) for s, sl in self._slots.items() if sl.prefilling]
         if not pre:
             return 0
@@ -2252,108 +2719,70 @@ class Engine:
         sched: list[tuple[int, _Slot, int, int]] = []  # (slot, sl, start, n)
         spent = 0
         for slot, sl in pre:
+            cap = self._slot_chunk_tokens(sl, CHK)
             if sl.swap_entry is not None:
                 # a swapped chunk costs budget like a prefill chunk (EDF-
                 # ordered with them): the restore copy competes for the
                 # same cycle the model chunks would
-                n = min(CHK, self._swap_in_cut(sl) - sl.prefill_pos)
+                n = min(cap, self._swap_in_cut(sl) - sl.prefill_pos)
             else:
-                n = min(CHK, len(sl.prefill_row) - sl.prefill_pos)
+                n = min(cap, len(sl.prefill_row) - sl.prefill_pos)
             if sched and spent + n > chunk_budget:
                 break  # budget spent; later (EDF-ordered) slots wait a cycle
             sched.append((slot, sl, sl.prefill_pos, n))
             spent += n
         restores = [c for c in sched if c[1].swap_entry is not None]
         restore_slots = {c[0] for c in restores}
-        aborted_slots: set[int] = set()  # restores the fault site cancelled
         model = [c for c in sched if c[1].swap_entry is None]
         mids = [c for c in model if c[2] + c[3] < len(c[1].prefill_row)]
         finals = [c for c in model if c[2] + c[3] >= len(c[1].prefill_row)]
+        # finals whose whole row fits one chunk (start 0) take the plain
+        # causal program — byte-for-byte the chunked-off dispatch; only
+        # true continuations need the offset program
+        plain = [c for c in finals if c[2] == 0]
+        conts = [c for c in finals if c[2] > 0]
+        aborted_slots, refund = self._run_restores(restores)
+        spent -= refund
+        if self._use_megastep() and (mids or conts):
+            # fused cycle: plain finals dispatch now (and join this very
+            # cycle's decode lanes, as in the split path); mid chunks and
+            # continuation finals defer into the single fused program the
+            # decode/verify site dispatches (_megastep_dispatch). Their
+            # commit bookkeeping (prefill_pos, flight, counters) rides the
+            # megastep commit so nothing is recorded that didn't dispatch.
+            with self._hol_clock():
+                for batch in _pow2_chunks(plain, self.prefill_batch_max):
+                    self._prefill_group(self._chunk_items(batch))
+            landed_now = [
+                c for c in sched
+                if c[0] not in aborted_slots
+                and (c in plain or c[0] in restore_slots)
+            ]
+            self._fuse_pending = {
+                "mids": mids, "finals": conts, "landed": landed_now,
+                "spent": spent, "budget": chunk_budget,
+                "restores": restore_slots,
+            }
+            return spent
         with self._hol_clock():
-            for slot, sl, st, n in restores:
-                if self._faults.enabled and st == 0:
-                    spec = self._faults.pop("engine.host_swap_slow")
-                    if spec is not None:
-                        slow = float(spec.get("seconds", 0.05))
-                        time.sleep(slow)
-                        sl.swap_stall_s += slow  # attributed as host_stall
-                    if self._faults.pop("engine.host_swap_error") is not None:
-                        # restore "failed" before any rows landed: fall
-                        # back to recomputing the whole prefill (the entry
-                        # was consumed; byte-identity is unaffected). The
-                        # chunk never dispatched — keep it out of the
-                        # round's flight/counter record too.
-                        self.flight.record(
-                            "swap_in", rid=sl.request.rid, slot=slot,
-                            error=True,
-                        )
-                        # the preserved rows now get recomputed by model
-                        # chunks after all — host-swap-error recompute waste
-                        self.profiler.reclassify(
-                            "swap_recompute", self._swap_in_cut(sl)
-                        )
-                        sl.swap_entry = None
-                        aborted_slots.add(slot)
-                        spent -= n  # nothing dispatched; refund the budget
-                        continue
-                sl.swap_stall_s += self._swap_in_rows(slot, sl.swap_entry, st, n)
-                sl.prefill_pos = st + n
-                self._seq_lens[slot] = sl.prefill_pos
-                if sl.prefill_pos >= self._swap_in_cut(sl):
-                    self._finish_swap_in(slot, sl)
             for batch in _pow2_chunks(mids, self.prefill_batch_max):
                 self._chunk_dispatch(batch)
-            # finals whose whole row fits one chunk (start 0) take the plain
-            # causal program — byte-for-byte the chunked-off dispatch; only
-            # true continuations need the offset program
-            plain = [c for c in finals if c[2] == 0]
-            conts = [c for c in finals if c[2] > 0]
-            paged = self.kv_layout == "paged"
-
-            def items(batch):
-                return [
-                    (sl.request, slot,
-                     self._slot_pages.get(slot) if paged else None, None)
-                    for slot, sl, _st, _n in batch
-                ]
-
             for batch in _pow2_chunks(plain, self.prefill_batch_max):
-                self._prefill_group(items(batch))
+                self._prefill_group(self._chunk_items(batch))
             for batch in _pow2_chunks(conts, self.prefill_batch_max):
                 self._prefill_group(
-                    items(batch),
+                    self._chunk_items(batch),
                     starts_np=np.asarray([st for _, _, st, _ in batch], dtype=np.int32),
                 )
         for slot, sl, st, n in mids:
             sl.prefill_pos = st + n
             self._seq_lens[slot] = sl.prefill_pos
         landed = [c for c in sched if c[0] not in aborted_slots]
-        self.prefill_chunks += len(landed)
-        if self.flight.enabled:
-            # the EDF pick + budget spend this cycle: one event per chunk
-            # that actually dispatched (an aborted restore already recorded
-            # its swap_in error and advanced nothing) plus the round's
-            # budget accounting
-            for slot, sl, st, n in landed:
-                if not sl.request.prewarm:
-                    self.flight.record(
-                        "prefill_chunk", rid=sl.request.rid, slot=slot,
-                        start=st, n=n,
-                        final=st + n >= len(sl.prefill_row or ()),
-                        swap=slot in restore_slots,
-                    )
-            self.flight.record(
-                "prefill_round", scheduled=len(landed), spent=spent,
-                budget=chunk_budget,
-            )
-        REGISTRY.counter_add(
-            "acp_engine_prefill_chunks_total", float(len(landed)),
-            help="prefill chunk dispatches (per-slot chunks) under the "
-            "unified token-budget scheduler",
-        )
+        self._record_chunk_round(landed, spent, chunk_budget, restore_slots)
         return spent
 
-    def _chunk_dispatch(  # acp: dispatch-lanes toks,lengths,starts,slots,page_ids
+    def _chunk_dispatch(  # acp: megastep-seam — split chunk program (fused fallback)
+        # acp: dispatch-lanes toks,lengths,starts,slots,page_ids
         self, batch: list[tuple[int, "_Slot", int, int]]
     ) -> None:
         """One batched KV-only chunk dispatch (the per-cycle analogue of
@@ -2461,7 +2890,7 @@ class Engine:
             self._prefix_cache.move_to_end(best_key)
             return (best_key, best)
 
-    def _copy_prefix_into_slot(self, slot: int, entry: dict) -> None:
+    def _copy_prefix_into_slot(self, slot: int, entry: dict) -> None:  # acp: megastep-seam
         cut = entry["cut"]
         fn = self._jit_copy_prefix.get(cut)
         if fn is None:
@@ -2484,7 +2913,7 @@ class Engine:
             real_tokens=cut, real_slots=1,
         )
 
-    def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:
+    def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:  # acp: megastep-seam
         """After a prefill: snapshot the slot's leading KV as a reusable
         prefix entry (LRU-capped). Slot layout: a device COPY at the largest
         bucket/chunk boundary. Paged layout: zero-copy — take a reference on
@@ -2776,32 +3205,24 @@ class Engine:
                 )
         return self._token_table
 
-    def _prefill_group(
-        self,
-        chunk: list[tuple[_Request, int, Optional[list[int]]]],
-        starts_np: Optional[np.ndarray] = None,
-    ) -> None:
-        # acp: dispatch-lanes tokens,lengths,slots,temps,top_ks,top_ps,con_states0,constrained0,budgets,full_lens,page_ids
+    def _prefill_lanes(
+        self, chunk: list, starts: np.ndarray
+    ) -> dict:
+        # acp: dispatch-lanes tokens,lengths,slots,temps,top_ks,top_ps,con_states0,constrained0,budgets,full_lens
         # acp: budget-seam — the ONE admission-time budget computation (the
         # +1-for-the-first-token form); decode/verify recomputation goes
         # through _slot_budget
-        """One batched prefill dispatch for B already-reserved requests
-        (B = power of two <= prefill_batch_max). Burst admissions no longer
-        serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
-        batch-1 prefills. With ``starts_np`` (prefix-cache hits and/or
-        chunked-prefill remainders; slot KV below each start is already
-        populated), only the SUFFIX runs through the model
-        (prefill_continue)."""
+        """Build the batched prefill/continuation lane arrays for B
+        already-reserved requests — shared by the split _prefill_group
+        dispatch and the megastep's fused final phase, so both upload the
+        same numbers (the budget seam must have exactly one home)."""
         B = len(chunk)
-        starts = starts_np if starts_np is not None else np.zeros(B, dtype=np.int32)
         # bucket over what actually runs through the model (full row on a
         # miss; suffix on a hit)
         bucket = max(
             _next_bucket(len(self._full_row(r)) - int(starts[i]), self.prefill_buckets)
             for i, (r, _, _, _) in enumerate(chunk)
         )
-        if starts_np is None:
-            self._full_batch_shapes.add((bucket, B))
         tokens = np.zeros((B, bucket), dtype=np.int32)
         lengths = np.zeros(B, dtype=np.int32)
         slots = np.zeros(B, dtype=np.int32)
@@ -2844,21 +3265,48 @@ class Engine:
                 seed = tuple(s.forced_prefix) + tuple(req.resume_tokens)
                 con_states0[i] = self._seed_con_state(seed) if seed else self._table_start
                 constrained0[i] = True
+        return {
+            "bucket": bucket, "tokens": tokens, "lengths": lengths,
+            "slots": slots, "temps": temps, "top_ks": top_ks,
+            "top_ps": top_ps, "con_states0": con_states0,
+            "constrained0": constrained0, "budgets": budgets,
+            "full_lens": full_lens, "table": table, "min_close": min_close,
+        }
+
+    def _prefill_group(  # acp: megastep-seam
+        self,
+        chunk: list[tuple[_Request, int, Optional[list[int]]]],
+        starts_np: Optional[np.ndarray] = None,
+    ) -> None:
+        """One batched prefill dispatch for B already-reserved requests
+        (B = power of two <= prefill_batch_max). Burst admissions no longer
+        serialize: 64 arrivals are 8 dispatches of 8 prompts, not 64
+        batch-1 prefills. With ``starts_np`` (prefix-cache hits and/or
+        chunked-prefill remainders; slot KV below each start is already
+        populated), only the SUFFIX runs through the model
+        (prefill_continue)."""
+        B = len(chunk)
+        starts = starts_np if starts_np is not None else np.zeros(B, dtype=np.int32)
+        ln = self._prefill_lanes(chunk, starts)
+        bucket, full_lens, lengths = ln["bucket"], ln["full_lens"], ln["lengths"]
+        table, min_close = ln["table"], ln["min_close"]
+        if starts_np is None:
+            self._full_batch_shapes.add((bucket, B))
         self._rng, step_rng = jax.random.split(self._rng)
         common = (
-            self._put(tokens),
+            self._put(ln["tokens"]),
             self._put(lengths),
         )
         tail = (
             step_rng,
-            self._put(temps),
-            self._put(top_ks),
-            self._put(top_ps),
+            self._put(ln["temps"]),
+            self._put(ln["top_ks"]),
+            self._put(ln["top_ps"]),
             table,
-            self._put(con_states0),
-            self._put(constrained0),
+            self._put(ln["con_states0"]),
+            self._put(ln["constrained0"]),
             min_close,
-            self._put(budgets),
+            self._put(ln["budgets"]),
         )
         prof_t0 = self.profiler.start()
         if self.kv_layout == "paged":
@@ -2889,11 +3337,11 @@ class Engine:
             self._cont_batch_sizes.add(B)
             cache, firsts, con_states = self._jit_prefill_continue(
                 self.params, self.cache, *common,
-                self._put(starts), self._put(slots), *tail,
+                self._put(starts), self._put(ln["slots"]), *tail,
             )
         else:
             cache, firsts, con_states = self._jit_prefill(
-                self.params, self.cache, *common, self._put(slots), *tail
+                self.params, self.cache, *common, self._put(ln["slots"]), *tail
             )
         self.cache = cache
         if self.profiler.enabled:
@@ -2914,6 +3362,24 @@ class Engine:
             self.profiler.account(
                 goodput=real - pre, prewarm=pre, pad_bucket=B * bucket - real
             )
+        # one combined round trip (see _decode_once; the tunnel RTT floor
+        # applies per fetch, not per byte)
+        firsts, con_states = jax.device_get((firsts, con_states))
+        self._finish_prefill_dispatch(chunk, firsts, con_states, full_lens)
+
+    def _finish_prefill_dispatch(  # acp: megastep-seam — _save_prefix extracts KV
+        self,
+        chunk: list,
+        firsts: np.ndarray,
+        con_states: np.ndarray,
+        full_lens: np.ndarray,
+    ) -> None:
+        """Host-side commit of one prefill dispatch's results (shared by
+        the split _prefill_group and the megastep's fused final phase):
+        snapshot prefixes, flip PREFILLING slots to decoding, stream first
+        tokens + forced prefixes, and finish slots whose first token was
+        terminal. ``self.cache`` must already hold the post-dispatch
+        cache (prefix snapshots extract from it)."""
         # snapshot prefixes for future hits (engine thread; the state can't
         # change before decode extends past the cut). Hit slots save too:
         # their rows/tables now hold the FULL prompt KV, so the next turn can
@@ -2922,9 +3388,6 @@ class Engine:
             for req, slot, _, _m in chunk:
                 if not req.truncated:
                     self._save_prefix(self._full_row(req), len(req.prompt), slot)
-        # one combined round trip (see _decode_once; the tunnel RTT floor
-        # applies per fetch, not per byte)
-        firsts, con_states = jax.device_get((firsts, con_states))
         self._state_dirty = True  # new slots: decode must re-upload state
         now = time.monotonic()
         for i, (req, slot, _, _m) in enumerate(chunk):
@@ -3291,38 +3754,19 @@ class Engine:
         table.extend(new_pages)
         self._tables_dirty = True
 
-    def _decode_once(self) -> None:
-        self._apply_cancels()
-        if not self._n_active():
-            return
-        if self._faults.enabled:
-            spec = self._faults.pop("engine.force_preempt", steps=self.decode_steps)
-            if spec is not None:
-                victim = self._pick_victim()
-                if victim is not None:
-                    self._preempt(victim, reason="fault")
-        if not self._n_active():
-            return
-        # speculative decoding: when enabled and at least one slot has a
-        # draft, ONE verify dispatch replaces this iteration's decode block
-        # (it commits 1 + accepted tokens per slot). When no slot drafts —
-        # adversarial text, decayed adaptive caps — fall through to the
-        # plain block path, which is exactly the spec-off engine.
-        if self.spec_len and self._decode_spec():
-            return
-        K = self.decode_block_size
-        if self.kv_layout == "paged":
-            self._ensure_pages_for_block()
-            if not self._n_active():
-                return
-        # Device-resident decode state: the per-slot arrays (tokens,
-        # seq_lens, con_states, budgets, active, rng) round-trip through the
-        # decode block's carry and are fed back DONATED on the next block.
-        # Only a "dirty" block — admission, finish, cancel (anything that
-        # changed host-side slot assignment) — re-uploads the host mirrors.
-        # Through a high-RTT link (axon tunnel ~80ms/transfer) the old
-        # upload-8-arrays-every-block pattern cost ~10x the block compute;
-        # steady-state blocks now cost one dispatch + one result fetch.
+    def _ensure_dev_state(self) -> dict:
+        """Device-resident decode state: the per-slot arrays (tokens,
+        seq_lens, con_states, budgets, active, rng) round-trip through the
+        decode block's carry and are fed back DONATED on the next block.
+        Only a "dirty" block — admission, finish, cancel (anything that
+        changed host-side slot assignment) — re-uploads the host mirrors.
+        Through a high-RTT link (axon tunnel ~80ms/transfer) the old
+        upload-8-arrays-every-block pattern cost ~10x the block compute;
+        steady-state blocks now cost one dispatch + one result fetch.
+        Shared by the split decode block and the megastep's fused decode
+        phase (both must upload the same lanes). Paged block tables ride
+        the same dirty discipline: re-uploaded only when a page was
+        appended (or the state itself was re-uploaded), never per block."""
         if self._state_dirty or self._dev is None:
             # width bucketing: dispatch the smallest compiled width covering
             # the active slots (allocation is lowest-slot-first, so occupancy
@@ -3365,22 +3809,68 @@ class Engine:
             }
             self._state_dirty = False
         d = self._dev
+        if self.kv_layout == "paged" and (
+            self._tables_dirty or "block_tables" not in d
+        ):
+            d["block_tables"] = self._put(self._block_tables[: d["W"]])
+            self._tables_dirty = False
+            self.table_uploads += 1
+        return d
+
+    def _decode_once(self) -> None:  # acp: megastep-seam
+        pending = self._fuse_pending
+        self._fuse_pending = None
+        self._apply_cancels()
+        if not self._n_active():
+            self._megastep_flush(pending)
+            return
+        if self._faults.enabled:
+            spec = self._faults.pop("engine.force_preempt", steps=self.decode_steps)
+            if spec is not None:
+                victim = self._pick_victim()
+                if victim is not None:
+                    self._preempt(victim, reason="fault")
+        if not self._n_active():
+            self._megastep_flush(pending)
+            return
+        # speculative decoding: when enabled and at least one slot has a
+        # draft, ONE verify dispatch replaces this iteration's decode block
+        # (it commits 1 + accepted tokens per slot). When no slot drafts —
+        # adversarial text, decayed adaptive caps — fall through to the
+        # plain block path, which is exactly the spec-off engine. A fused
+        # cycle's pending chunk lanes ride whichever dispatch wins.
+        if self.spec_len and self._decode_spec(pending):
+            return
+        K = self.decode_block_size
+        if self.kv_layout == "paged":
+            self._ensure_pages_for_block()
+            if not self._n_active():
+                self._megastep_flush(pending)
+                return
+        d = self._ensure_dev_state()
         W = d["W"]
+        n_act = self._n_active()
+        KB = self.decode_block_size
+        if pending is not None:
+            out = self._megastep_dispatch(pending, d=d, n_act=n_act)
+            if out is not None:
+                return
+            # fused shape over the program bound: dispatch the pending
+            # chunk lanes through the split programs, then the plain block
+            self._dispatch_pending_split(pending)
+            if not self._n_active():
+                self._publish_decode_gauges()
+                return
+            d = self._ensure_dev_state()  # finals may have joined
+            W = d["W"]
+            n_act = self._n_active()
         common = (
             d["tokens"], d["seq_lens"], d["active"], d["rng"],
             d["temps"], d["top_ks"], d["top_ps"], d["table"],
             d["con_states"], d["constrained"], d["min_close"], d["budgets"],
         )
-        n_act = self._n_active()
         prof_t0 = self.profiler.start()
         if self.kv_layout == "paged":
-            # block tables ride the same dirty discipline: re-uploaded only
-            # when a page was appended (or the state itself was re-uploaded),
-            # not on every block
-            if self._tables_dirty or "block_tables" not in d:
-                d["block_tables"] = self._put(self._block_tables[:W])
-                self._tables_dirty = False
-                self.table_uploads += 1
             cache, tok_block, carry = self._jit_decode_paged(
                 self.params, self.cache, *common, d["block_tables"]
             )
@@ -3388,9 +3878,6 @@ class Engine:
             cache, tok_block, carry = self._jit_decode(
                 self.params, self.cache, *common
             )
-        d["tokens"], d["seq_lens"], con_states_dev, d["budgets"], d["active"], d["rng"] = carry
-        d["con_states"] = con_states_dev
-        KB = self.decode_block_size
         prog_key = (
             f"decode[{self.kv_layout},{W}x{KB}"
             f"{'+tbl' if d['table'] is not self._dummy_table else ''}]"
@@ -3409,9 +3896,24 @@ class Engine:
         # sequential np.asarray fetches double the per-block latency floor.
         # con_states must stay mirrored so the next dirty upload (admission
         # into some other slot) doesn't clobber live automaton states.
-        con_states, tok_block = jax.device_get((con_states_dev, tok_block))
-        self._con_states[:W] = con_states
+        con_states, tok_block = jax.device_get((carry[2], tok_block))
         self.cache = cache
+        self._commit_decode_block(tok_block, con_states, carry, d, prog_key)
+
+    def _commit_decode_block(
+        self,
+        tok_block: np.ndarray,
+        con_states: np.ndarray,
+        carry: tuple,
+        d: dict,
+        prog_key: str,
+    ) -> None:
+        """Host-side commit of one decode-block dispatch (split or fused):
+        re-seat the device-resident carry, mirror constraint states, commit
+        each lane's tokens, and attribute the block's compute."""
+        W = d["W"]
+        d["tokens"], d["seq_lens"], d["con_states"], d["budgets"], d["active"], d["rng"] = carry
+        self._con_states[:W] = con_states
         # tok_block: [K, W]
         K = tok_block.shape[0]
         self.decode_steps += K
@@ -3425,6 +3927,8 @@ class Engine:
         for slot, sl in list(self._slots.items()):
             if sl.parked or sl.prefilling:
                 continue  # parked/mid-prefill lanes were not in this dispatch
+            if slot >= W:
+                continue  # joined after the lanes were built (fused finals)
             n0 = len(sl.generated)
             self._consume_tokens(slot, sl, (int(tok_block[k, slot]) for k in range(K)))
             # sl stays valid after a _finish pops the slot — the delta is
@@ -3443,6 +3947,354 @@ class Engine:
                 pad_width=W * K - emitted - pre_emitted,
             )
         self._publish_decode_gauges()
+
+    # -- fused megastep dispatch ------------------------------------------
+
+    def _validate_pending(self, pending: dict) -> None:
+        """Planning ran before this cycle's decode-site faults and page-
+        pressure preemptions (the split path dispatches chunks first, so
+        its preempts discard ALREADY-landed chunks; fusing inverts that
+        order). Drop planned lanes whose slot was preempted, cancelled or
+        expired since planning — dispatching them would write KV into
+        freed (possibly reallocated) pages. Dropped lanes stay counted as
+        budget spent (split parity: their dispatch would have landed
+        before the preempt discarded it) but never reach the flight/
+        counter record, which covers only real dispatches."""
+
+        def live(c):
+            slot, sl, st, _n = c
+            return (
+                self._slots.get(slot) is sl
+                and sl.prefilling
+                and sl.prefill_pos == st
+                and sl.swap_entry is None
+            )
+
+        pending["mids"] = [c for c in pending["mids"] if live(c)]
+        pending["finals"] = [c for c in pending["finals"] if live(c)]
+
+    def _dispatch_pending_split(self, pending: dict) -> None:
+        """Fallback for a fused cycle that cannot (or should not) compile
+        a new megastep shape: dispatch the planned lanes through the
+        already-compiled split programs, then record the round."""
+        self._validate_pending(pending)
+        mids, conts = pending["mids"], pending["finals"]
+        with self._hol_clock():
+            for batch in _pow2_chunks(mids, self.prefill_batch_max):
+                self._chunk_dispatch(batch)
+            for batch in _pow2_chunks(conts, self.prefill_batch_max):
+                self._prefill_group(
+                    self._chunk_items(batch),
+                    starts_np=np.asarray(
+                        [st for _, _, st, _ in batch], dtype=np.int32
+                    ),
+                )
+        for slot, sl, st, n in mids:
+            sl.prefill_pos = st + n
+            self._seq_lens[slot] = sl.prefill_pos
+        self._record_chunk_round(
+            pending["landed"] + mids + conts, pending["spent"],
+            pending["budget"], pending["restores"],
+        )
+
+    def _megastep_flush(self, pending: Optional[dict]) -> None:
+        """Dispatch a fused cycle's pending chunk lanes when the cycle
+        ended up with no decode/verify phase to fuse with (no active
+        slots, or pressure preempted them all): a chunks-only megastep."""
+        if pending is None:
+            return
+        if self._megastep_dispatch(pending) is None:
+            self._dispatch_pending_split(pending)
+
+    def _fuse_mid_lanes(self, batch: list) -> tuple:
+        # acp: dispatch-lanes toks,lengths,starts,slots,page_ids,tables
+        """Lane arrays for the megastep's mid-chunk phase: one batch,
+        padded to a power of two (the split path's pow2 DECOMPOSITION has
+        no padding rows; fusion trades those rows — accounted as pad_fuse
+        waste — for dispatching once). Padding lanes write harmlessly:
+        the slot layout clamps starts=max_ctx writes to the never-readable
+        max_ctx-1 row (the spec-verify lane-default trick), paged routes
+        every page write to TRASH_PAGE."""
+        B = len(batch)
+        Bp = 1 << (B - 1).bit_length()
+        bucket = _next_bucket(max(n for _, _, _, n in batch), self.prefill_buckets)
+        toks = np.zeros((Bp, bucket), dtype=np.int32)
+        lengths = np.zeros(Bp, dtype=np.int32)
+        starts = np.full(
+            Bp, self.max_ctx if self.kv_layout == "slot" else 0, dtype=np.int32
+        )
+        slots = np.zeros(Bp, dtype=np.int32)
+        for i, (slot, sl, st, n) in enumerate(batch):
+            toks[i, :n] = sl.prefill_row[st : st + n]
+            lengths[i] = n
+            starts[i] = st
+            slots[i] = slot
+        if self.kv_layout == "paged":
+            P = self.page_size
+            page_ids = np.full((Bp, bucket // P), TRASH_PAGE, dtype=np.int32)
+            for i, (slot, _sl, st, n) in enumerate(batch):
+                # chunk boundaries are page-aligned (see _chunk_tokens), so
+                # the commit's whole-page writes touch exactly this chunk's
+                # fresh pages — never a page holding earlier KV
+                sub = self._slot_pages[slot][st // P : -(-(st + n) // P)]
+                page_ids[i, : len(sub)] = sub
+            tables = np.full(
+                (Bp, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
+            )
+            tables[:B] = self._block_tables[[slot for slot, _, _, _ in batch]]
+            lanes = (
+                self._put(toks), self._put(lengths), self._put(starts),
+                self._put(page_ids), self._put(tables),
+            )
+        else:
+            lanes = (
+                self._put(toks), self._put(lengths), self._put(starts),
+                self._put(slots),
+            )
+        return lanes, bucket, Bp
+
+    def _fuse_final_lanes(self, batch: list) -> tuple:
+        """Lane arrays for the megastep's final-chunk phase: the shared
+        _prefill_lanes builder (the budget seam must have one home) padded
+        to a power-of-two batch. Padding lanes sample garbage that is
+        never committed; their writes land on the trash page / clamped
+        never-readable row exactly like _fuse_mid_lanes padding."""
+        chunk = self._chunk_items(batch)
+        starts = np.asarray([st for _, _, st, _ in batch], dtype=np.int32)
+        ln = self._prefill_lanes(chunk, starts)
+        B = len(batch)
+        Bp = 1 << (B - 1).bit_length()
+        bucket = ln["bucket"]
+
+        def pad(a, fill):
+            if Bp == B:
+                return a
+            out = np.full((Bp, *a.shape[1:]), fill, dtype=a.dtype)
+            out[:B] = a
+            return out
+
+        pad_start = self.max_ctx if self.kv_layout == "slot" else 0
+        self._rng, step_rng = jax.random.split(self._rng)
+        sample = (
+            step_rng,
+            self._put(pad(ln["temps"], 0)),
+            self._put(pad(ln["top_ks"], 0)),
+            self._put(pad(ln["top_ps"], 1.0)),
+            ln["table"],
+            self._put(pad(ln["con_states0"], 0)),
+            self._put(pad(ln["constrained0"], False)),
+            ln["min_close"],
+            self._put(pad(ln["budgets"], 1)),
+        )
+        toks_d = self._put(pad(ln["tokens"], 0))
+        lens_d = self._put(pad(ln["lengths"], 0))
+        starts_d = self._put(pad(starts, pad_start))
+        if self.kv_layout == "paged":
+            P = self.page_size
+            page_ids = np.full((Bp, bucket // P), TRASH_PAGE, dtype=np.int32)
+            for i, (slot, _sl, st, _n) in enumerate(batch):
+                fresh = self._slot_pages[slot][st // P :]
+                page_ids[i, : len(fresh)] = fresh
+            tables = np.full(
+                (Bp, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
+            )
+            tables[:B] = self._block_tables[[slot for slot, _, _, _ in batch]]
+            model_lanes = (
+                toks_d, lens_d, starts_d, self._put(page_ids), self._put(tables)
+            )
+        else:
+            model_lanes = (
+                toks_d, lens_d, starts_d, self._put(pad(ln["slots"], 0))
+            )
+        return (model_lanes, sample), bucket, Bp, chunk, ln
+
+    def _megastep_dispatch(  # acp: megastep-seam
+        self,
+        pending: dict,
+        d: Optional[dict] = None,
+        n_act: int = 0,
+        ver: Optional[tuple] = None,
+        ver_meta: Optional[dict] = None,
+    ) -> Optional[bool]:
+        """THE fused dispatch: one compiled program runs this cycle's
+        pending mid chunks + continuation finals + (decode block | spec
+        verify). Returns True when it dispatched and committed; None when
+        the caller must fall back to the split programs (a NEW fused shape
+        past megastep_max_programs — fusion must not turn the jit cache
+        into a combinatorial zoo, so rare shapes reuse the split programs
+        that are already compiled)."""
+        self._validate_pending(pending)
+        mids, finals = pending["mids"], pending["finals"]
+        if not mids and not finals:
+            if d is None and ver is None:
+                # everything the cycle planned was invalidated pre-dispatch
+                self._record_chunk_round(
+                    pending["landed"], pending["spent"], pending["budget"],
+                    pending["restores"],
+                )
+                return True
+            return None  # nothing to fuse; run the plain decode/verify
+        # the shape key is host arithmetic — compute it and apply the
+        # program bound BEFORE building/uploading any lane arrays, so a
+        # fallback cycle never pays device transfers it throws away
+        KB = self.decode_block_size
+        mid_bucket = mid_Bp = fin_bucket = fin_Bp = 0
+        if mids:
+            mid_bucket = _next_bucket(
+                max(n for _, _, _, n in mids), self.prefill_buckets
+            )
+            mid_Bp = 1 << (len(mids) - 1).bit_length()
+        if finals:
+            fin_bucket = max(
+                _next_bucket(len(sl.prefill_row) - st, self.prefill_buckets)
+                for _slot, sl, st, _n in finals
+            )
+            fin_Bp = 1 << (len(finals) - 1).bit_length()
+        tbl = "+tbl" if self._token_table is not None else ""
+        parts = []
+        if mids:
+            parts.append(f"m{mid_bucket}x{mid_Bp}")
+        if finals:
+            parts.append(f"f{fin_bucket}x{fin_Bp}")
+        W = T = 0
+        if d is not None:
+            W = d["W"]
+            parts.append(f"d{W}x{KB}")
+        elif ver is not None:
+            W, T = ver_meta["W"], ver_meta["T"]
+            parts.append(f"v{W}x{T}")
+        shape = (self.kv_layout, tuple(parts), tbl)
+        if (
+            shape not in self._megastep_shapes
+            and len(self._megastep_shapes) >= self.megastep_max_programs
+        ):
+            self.megastep_fallbacks += 1
+            REGISTRY.counter_add(
+                "acp_engine_megastep_fallbacks_total", 1.0,
+                help="fused cycles split-dispatched because a new megastep "
+                "shape would exceed megastep_max_programs (the bound on "
+                "distinct fused jit entries)",
+            )
+            return None
+        mid_lanes = fin_lanes = None
+        fin_chunk = fin_ln = None
+        if mids:
+            mid_lanes, mid_bucket, mid_Bp = self._fuse_mid_lanes(mids)
+        if finals:
+            fin_lanes, fin_bucket, fin_Bp, fin_chunk, fin_ln = (
+                self._fuse_final_lanes(finals)
+            )
+        dec_carry = dec_aux = None
+        if d is not None:
+            dec_carry = (
+                d["tokens"], d["seq_lens"], d["con_states"], d["budgets"],
+                d["active"], d["rng"],
+            )
+            extra = (d["block_tables"],) if self.kv_layout == "paged" else ()
+            dec_aux = (
+                d["temps"], d["top_ks"], d["top_ps"], d["table"],
+                d["constrained"], d["min_close"], extra,
+            )
+        key = f"megastep[{self.kv_layout},{'+'.join(parts)}{tbl}]"
+        new_shape = shape not in self._megastep_shapes
+        self._megastep_shapes.add(shape)
+        prof_t0 = self.profiler.start()
+        cache, f_out, d_out, v_out = self._jit_megastep(
+            self.params, self.cache, mid_lanes, fin_lanes, dec_carry,
+            dec_aux, ver,
+        )
+        self.megastep_dispatches += 1
+        if new_shape:
+            self.flight.record("megastep_shape", program=key)
+        mid_real = sum(n for _, _, _, n in mids)
+        fin_real = int(fin_ln["lengths"].sum()) if finals else 0
+        if self.profiler.enabled:
+            real = mid_real + fin_real
+            padded = 0
+            if mids:
+                padded += mid_Bp * mid_bucket - mid_real
+            if finals:
+                padded += fin_Bp * fin_bucket - fin_real
+            real_slots = len(mids) + len(finals)
+            padded_slots = (mid_Bp - len(mids)) + (fin_Bp - len(finals))
+            if d is not None:
+                real += n_act * KB
+                padded += (W - n_act) * KB
+                real_slots += n_act
+                padded_slots += W - n_act
+            elif ver is not None:
+                real += ver_meta["real_in"]
+                padded += W * T - ver_meta["real_in"]
+                real_slots += ver_meta["n_part"]
+                padded_slots += W - ver_meta["n_part"]
+            out_probe = (
+                d_out[0] if d_out is not None
+                else v_out[0] if v_out is not None
+                else f_out[0] if f_out is not None
+                else cache["k"]  # chunks-only: block on the committed KV
+            )
+            self.profiler.record(
+                key, prof_t0, out=out_probe, real_tokens=real,
+                padded_tokens=padded, real_slots=real_slots,
+                padded_slots=padded_slots,
+            )
+            # the fused phases classify exactly as their split programs
+            # would, plus pad_fuse for the pow2-padding rows fusion adds
+            # (the split pow2 DECOMPOSITION has none)
+            if mids:
+                pre = sum(n for _, sl, _, n in mids if sl.request.prewarm)
+                self.profiler.account(
+                    goodput=mid_real - pre, prewarm=pre,
+                    pad_bucket=len(mids) * mid_bucket - mid_real,
+                    pad_fuse=(mid_Bp - len(mids)) * mid_bucket,
+                )
+            if finals:
+                pre = sum(
+                    int(fin_ln["lengths"][i])
+                    for i, (r, _, _, _) in enumerate(fin_chunk)
+                    if r.prewarm
+                )
+                self.profiler.account(
+                    goodput=fin_real - pre, prewarm=pre,
+                    pad_bucket=len(finals) * fin_bucket - fin_real,
+                    pad_fuse=(fin_Bp - len(finals)) * fin_bucket,
+                )
+        # ONE host round trip for every phase's results (None phases fetch
+        # nothing — device_get maps over the pytree)
+        carry = d_out[1] if d_out is not None else None
+        f_np, dec_fetch, ver_np = jax.device_get((
+            f_out,
+            (carry[2], d_out[0]) if d_out is not None else None,
+            v_out,
+        ))
+        self.cache = cache
+        # commit order matters: mid chunks advance first (bookkeeping
+        # only), then the decode/verify commit — its lanes predate this
+        # cycle's finals, so it must run BEFORE finals flip their slots to
+        # ACTIVE (a freed-and-reused slot id would otherwise read garbage
+        # lanes) — and the finals commit last.
+        for slot, sl, st, n in mids:
+            sl.prefill_pos = st + n
+            self._seq_lens[slot] = sl.prefill_pos
+        if d_out is not None:
+            con_states, tok_block = dec_fetch
+            self._commit_decode_block(tok_block, con_states, carry, d, key)
+        if v_out is not None:
+            out_toks, n_emit, new_states = ver_np
+            self._commit_spec_verify(
+                out_toks, n_emit, new_states, ver_meta, key
+            )
+        if finals:
+            firsts, fstates = f_np
+            B = len(finals)
+            self._finish_prefill_dispatch(
+                fin_chunk, firsts[:B], fstates[:B], fin_ln["full_lens"]
+            )
+        self._record_chunk_round(
+            pending["landed"] + mids + finals, pending["spent"],
+            pending["budget"], pending["restores"],
+        )
+        return True
 
     def _consume_tokens(self, slot: int, sl: _Slot, toks) -> None:
         """Host-side commit of one dispatch's newly sampled tokens for one
@@ -3587,7 +4439,7 @@ class Engine:
             sl.ctx_len = total
         return sl.ctx_buf[:total]
 
-    def _decode_spec(self) -> bool:
+    def _decode_spec(self, pending: Optional[dict] = None) -> bool:  # acp: megastep-seam
         # acp: dispatch-lanes inputs,n_input,starts,active,budgets,proposed
         """One speculative decode iteration: draft host-side (n-gram prompt
         lookup over prompt + generated-so-far), verify every position in a
@@ -3640,6 +4492,7 @@ class Engine:
                 {slot: 1 + len(d) for slot, d in drafts.items()}
             )
             if not self._n_active():
+                self._megastep_flush(pending)
                 return True
             drafts = {s: d for s, d in drafts.items() if s in self._slots}
             if not any(drafts.values()):
@@ -3684,7 +4537,6 @@ class Engine:
             proposed[slot] = len(d)
         use_real = self._token_table is not None
         self._rng, step_rng = jax.random.split(self._rng)
-        prof_t0 = self.profiler.start()
         args = [
             self.params,
             self.cache,
@@ -3705,14 +4557,36 @@ class Engine:
         ]
         if self.kv_layout == "paged":
             args.append(self._put(self._block_tables[:W]))
+        ver_meta = {
+            "W": W, "T": T, "drafts": drafts, "proposed": proposed,
+            "force_reject": force_reject, "real_in": int(n_input.sum()),
+            "n_part": int(active.sum()),
+        }
+        if pending is not None:
+            # fused cycle: the verify pass rides the megastep with the
+            # pending chunk lanes (one dispatch). Shape-bound fallback
+            # split-dispatches the chunks, then verifies standalone below
+            # (finals activated by the fallback join the NEXT cycle's
+            # lanes — per-request greedy bytes are unaffected).
+            if self._megastep_dispatch(
+                pending, ver=tuple(args[2:]), ver_meta=ver_meta
+            ):
+                return True
+            self._dispatch_pending_split(pending)
+            # the fallback's chunk dispatches DONATED the cache args[1]
+            # captured above and reassigned self.cache — verifying against
+            # the stale buffer would crash (deleted buffer) or silently
+            # discard this cycle's chunk KV writes
+            args[1] = self.cache
+        prof_t0 = self.profiler.start()
         cache, out_toks, n_emit, new_states = self._jit_verify(*args)
         self.cache = cache
         spec_prog_key = (
             f"spec_verify[{self.kv_layout},{W}x{T}{'+tbl' if use_real else ''}]"
         )
         if self.profiler.enabled:
-            n_part = int(active.sum())
-            real_in = int(n_input.sum())
+            n_part = ver_meta["n_part"]
+            real_in = ver_meta["real_in"]
             self.profiler.record(
                 spec_prog_key, prof_t0,
                 out=out_toks, real_tokens=real_in,
@@ -3721,13 +4595,34 @@ class Engine:
             )
         # one combined host round trip, same discipline as the block path
         out_toks, n_emit, new_states = jax.device_get((out_toks, n_emit, new_states))
+        self._commit_spec_verify(
+            out_toks, n_emit, new_states, ver_meta, spec_prog_key
+        )
+        return True
+
+    def _commit_spec_verify(
+        self,
+        out_toks: np.ndarray,
+        n_emit: np.ndarray,
+        new_states: np.ndarray,
+        ver_meta: dict,
+        prog_key: str,
+    ) -> None:
+        """Host-side commit of one speculative-verify dispatch (split or
+        fused): mirror constraint states, commit accepted prefixes + the
+        corrected token per slot, feed the AIMD controllers, and attribute
+        the pass's compute."""
+        W, T = ver_meta["W"], ver_meta["T"]
+        drafts = ver_meta["drafts"]
+        proposed = ver_meta["proposed"]
+        force_reject = ver_meta["force_reject"]
         self._con_states[:W] = new_states
         self.decode_steps += 1  # one model forward, however many tokens land
         self.spec_dispatches += 1
         self._state_dirty = True  # host mirrors advanced; next block re-uploads
         sp_emitted = sp_pre = sp_rejected = 0
         for slot, sl in list(self._slots.items()):
-            if sl.parked or sl.prefilling:
+            if sl.parked or sl.prefilling or slot >= W:
                 continue
             n = int(n_emit[slot])
             prop = int(proposed[slot])
@@ -3788,10 +4683,9 @@ class Engine:
                 proposed=int(sum(len(d) for d in drafts.values())),
                 emitted=int(sum(int(n_emit[s]) for s in drafts)),
                 forced_reject=force_reject,
-                program=spec_prog_key,
+                program=prog_key,
             )
         self._publish_decode_gauges()
-        return True
 
     def _finish(self, slot: int, reason: str) -> None:
         sl = self._slots.get(slot)
@@ -4232,7 +5126,7 @@ class Engine:
         self._publish_memory_state()
         return True
 
-    def _extract_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:
+    def _extract_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:  # acp: megastep-seam
         """Gather paged KV pages to host numpy, token-major [L, nP, H, d].
         Dispatches decompose into pow2 page counts (bounded jit entries);
         the device->host copies are issued async and joined at the end so
@@ -4267,7 +5161,7 @@ class Engine:
             np.concatenate(vs, axis=1).reshape(shape),
         )
 
-    def _extract_rows(self, slot: int, cut: int) -> tuple[np.ndarray, np.ndarray]:
+    def _extract_rows(self, slot: int, cut: int) -> tuple[np.ndarray, np.ndarray]:  # acp: megastep-seam
         """Slot layout: slice rows [0, cut) of ``slot`` out of the cache to
         host numpy [L, cut, H, d] (pow2 sub-slices; async fetch)."""
         L, Hkv, d = self.config.n_layers, self.config.n_kv_heads, self.config.head_dim
@@ -4304,7 +5198,7 @@ class Engine:
             np.concatenate([np.asarray(v) for _, v in chunks], axis=1),
         )
 
-    def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:
+    def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:  # acp: megastep-seam
         """Restore rows [start, start+n) of a host entry into ``slot``'s
         KV (page-aligned in paged mode — callers schedule page-grain
         chunks). Returns the engine-thread seconds spent blocked in the

@@ -121,6 +121,12 @@ def verify_engine(engine) -> list[str]:
                     f"prefilling slot {slot}: seq_len {seq} != prefill_pos "
                     f"{sl.prefill_pos}"
                 )
+            if sl.chunk_quota < 1:
+                problems.append(
+                    f"prefilling slot {slot}: chunk_quota {sl.chunk_quota} "
+                    "< 1 — the rate planner must always plan progress (a "
+                    "zero quota would starve the slot forever)"
+                )
             if sl.share_of is not None and sl.prefill_pos != sl.share_of[2]:
                 problems.append(
                     f"prefilling slot {slot}: dedup follower advanced to "

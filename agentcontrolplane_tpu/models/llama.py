@@ -682,6 +682,27 @@ def prefill_continue(
     return cache, logits
 
 
+def prefill_continue_kv(
+    params: dict,
+    cache: dict,
+    tokens: jax.Array,  # [B, T] int32 — chunk tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true chunk lengths (0 = padding lane)
+    starts: jax.Array,  # [B] int32 — absolute chunk start per row
+    slots: jax.Array,  # [B] int32
+    config: LlamaConfig,
+) -> dict:
+    """KV-only continuation (the fused megastep's mid-chunk phase): the
+    exact cache writes of :func:`prefill_continue` with the lm_head
+    projection dropped — non-final chunks never sample, so the split
+    path's discarded logits were pure waste. A padding lane (length 0,
+    start = max_ctx) clamps its garbage write to the never-readable last
+    row (see ``_continue_forward``'s write clamp)."""
+    cache, _x = _continue_forward(
+        params, cache, tokens, lengths, starts, slots, config
+    )
+    return cache
+
+
 def verify_continue(
     params: dict,
     cache: dict,
@@ -863,20 +884,53 @@ def prefill_paged_continue(
     never written here; starts are page-aligned so suffix writes only touch
     fresh pages). Runs the suffix through the model, attending over the
     gathered prefix+suffix pages. Returns (pages, last-token logits [B, V])."""
-    B, T = tokens.shape
-    P = pages["k"].shape[2]
+    B = tokens.shape[0]
     new_k, new_v, x = _paged_continue_forward(
         params, pages, tokens, lengths, starts, block_tables, config
     )
     # one scatter commits the suffix blocks for every layer
+    pages = _commit_whole_pages(pages, new_k, new_v, page_ids)
+    last = x[jnp.arange(B), lengths - 1]
+    logits = _head_logits(last, params, config)
+    return pages, logits
+
+
+def _commit_whole_pages(
+    pages: dict,
+    new_k: jax.Array,  # [L, B, T, H_kv, d]
+    new_v: jax.Array,
+    page_ids: jax.Array,  # [B, T // P] int32
+) -> dict:
+    """Whole-page commit shared by the split continuation and the fused
+    megastep's mid-chunk phase — one copy of the page-write discipline, so
+    the two paths' KV layout can never silently diverge."""
     L = new_k.shape[0]
+    B, T = new_k.shape[1], new_k.shape[2]
+    P = pages["k"].shape[2]
     blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
     flat_ids = page_ids.reshape(-1)
     k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
     v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
-    last = x[jnp.arange(B), lengths - 1]
-    logits = _head_logits(last, params, config)
-    return {"k": k_all, "v": v_all}, logits
+    return {"k": k_all, "v": v_all}
+
+
+def prefill_paged_continue_kv(
+    params: dict,
+    pages: dict,  # {"k": [L, num_pages, P, H_kv, d], "v": ...}
+    tokens: jax.Array,  # [B, T] int32 — chunk tokens (rows padded)
+    lengths: jax.Array,  # [B] int32 — true chunk lengths (0 = padding lane)
+    starts: jax.Array,  # [B] int32 — absolute chunk start (page-aligned)
+    page_ids: jax.Array,  # [B, T // P] int32 — the chunk's pages (TRASH pads)
+    block_tables: jax.Array,  # [B, max_pages] int32
+    config: LlamaConfig,
+) -> dict:
+    """Paged KV-only continuation (the fused megastep's mid-chunk phase):
+    :func:`prefill_paged_continue`'s whole-page commit without the lm_head
+    projection. Padding lanes route every page write to the trash page."""
+    new_k, new_v, _x = _paged_continue_forward(
+        params, pages, tokens, lengths, starts, block_tables, config
+    )
+    return _commit_whole_pages(pages, new_k, new_v, page_ids)
 
 
 def verify_paged_continue(
@@ -1001,6 +1055,7 @@ def decode_step(
     tokens: jax.Array,  # [W] int32 — last sampled token per slot, W <= max_slots
     seq_lens: jax.Array,  # [W] int32 — current length per slot (before this token)
     config: LlamaConfig,
+    active: Optional[jax.Array] = None,  # [W] bool; inactive lanes write to C-1
 ) -> tuple[dict, jax.Array]:
     """One decode step for slots 0..W-1 (the continuous-batching hot loop).
     W may be narrower than the cache's slot count — width bucketing: at low
@@ -1008,6 +1063,19 @@ def decode_step(
     slots, so one live request doesn't pay max_slots of compute. Inactive
     slots inside W compute garbage that is never read; cache rows beyond W
     pass through untouched. Returns (cache, logits [W, V]).
+
+    ``active`` masks the K/V WRITE for inactive lanes to the never-readable
+    row C-1 (attention masks at seq_len, and a lane deactivates before its
+    seq_len reaches C — the same clamp the verify dispatch uses for its
+    absent lanes). Without it an inactive lane writes garbage at its stale
+    uploaded ``seq_lens`` — harmless for a free lane (row 0, overwritten by
+    the next prefill) but CORRUPTING for a mid-prefill slot below the
+    dispatch width, whose chunk loop has already written real prompt KV at
+    that position. The split dispatch path mostly dodged this by accident
+    (chunking slots usually sit above the active width; finals re-upload
+    lanes before the block); the fused megastep's decode phase runs on
+    pre-final lanes and hit it deterministically. Paged decode always had
+    the equivalent mask (inactive targets -> TRASH_PAGE).
 
     HBM discipline (measured on v5e through the hot loop): the cache rides
     the layer scan as READ-ONLY xs, the new token attends via an explicit
@@ -1039,10 +1107,15 @@ def decode_step(
     x, (new_k, new_v) = jax.lax.scan(
         body, x, (params["layers"], cache["k"], cache["v"])
     )
-    # one scatter commits every layer's token: rows (l, s, seq_lens[s])
+    # one scatter commits every layer's token: rows (l, s, seq_lens[s]);
+    # inactive lanes clamp to the never-read last row
     slot_idx = jnp.arange(W)
-    k_all = cache["k"].at[:, slot_idx, seq_lens].set(new_k.astype(cache["k"].dtype))
-    v_all = cache["v"].at[:, slot_idx, seq_lens].set(new_v.astype(cache["v"].dtype))
+    C = cache["k"].shape[2]
+    write_rows = (
+        jnp.where(active, seq_lens, C - 1) if active is not None else seq_lens
+    )
+    k_all = cache["k"].at[:, slot_idx, write_rows].set(new_k.astype(cache["k"].dtype))
+    v_all = cache["v"].at[:, slot_idx, write_rows].set(new_v.astype(cache["v"].dtype))
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)  # [S, D]
     logits = _head_logits(x, params, c)
     return {"k": k_all, "v": v_all}, logits

@@ -388,7 +388,7 @@ class FlightRecorder:
             return None
         durations, windows = attribute_phases(events)
         t0 = events[0]["t"] if events else 0.0
-        return {
+        doc = {
             "request_id": rid,
             "events": [{**e, "t_rel": round(e["t"] - t0, 6)} for e in events],
             "phases": {k: round(v, 6) for k, v in durations.items()},
@@ -398,6 +398,10 @@ class FlightRecorder:
             ],
             "total_s": round(events[-1]["t"] - t0, 6) if events else 0.0,
         }
+        plan = _rate_plan_summary(events)
+        if plan is not None:
+            doc["rate_plan"] = plan
+        return doc
 
     def request_ids(self, last: int = 32) -> list[str]:  # acp: cross-thread
         """Recently finished + live request ids with queryable timelines
@@ -465,6 +469,41 @@ class FlightRecorder:
         except Exception:
             log.exception("crash dump failed (crash itself is re-raised)")
             return None
+
+
+def _rate_plan_summary(events: list) -> Optional[dict[str, Any]]:
+    """Quota-vs-actual for one request's chunk-rate plan (engine/planner.py):
+    from its ``quota`` projection events (admission + reprojections) and
+    the ``prefill_chunk`` dispatches that followed, derive what the
+    planner asked for per cycle and what the scheduler actually delivered.
+    None when the request carried no rate plan (planner off, no chunked
+    prefill, or the timeline predates PR 13)."""
+    quotas = [e for e in events if e["kind"] == "quota"]
+    if not quotas:
+        return None
+    chunks = [e for e in events if e["kind"] == "prefill_chunk"]
+    tokens = sum(int(e["detail"].get("n", 0)) for e in chunks)
+    span = (
+        (chunks[-1]["t"] - quotas[0]["t"]) if chunks else 0.0
+    )
+    return {
+        "quota": quotas[-1]["detail"].get("quota"),
+        "projections": [
+            {
+                "reason": e["detail"].get("reason"),
+                "quota": e["detail"].get("quota"),
+                "tokens_left": e["detail"].get("tokens_left"),
+                "seconds_left": e["detail"].get("seconds_left"),
+            }
+            for e in quotas
+        ],
+        "reprojections": sum(
+            1 for e in quotas if e["detail"].get("reason") != "admit"
+        ),
+        "chunks_dispatched": len(chunks),
+        "chunk_tokens": tokens,
+        "prefill_span_s": round(max(0.0, span), 6),
+    }
 
 
 def phase_summaries() -> dict[str, dict[str, float]]:

@@ -39,7 +39,9 @@ by the engine (``engine.profiler``):
   verify lane+step padding, ``spec_rejected`` rejected draft positions,
   ``preempt_discard`` discarded-and-recomputed KV, ``swap_recompute``
   host-swap-error recompute, ``dedup_rewind`` follower rewinds,
-  ``prewarm`` synthetic warm-up traffic). :meth:`reclassify` moves already-
+  ``prewarm`` synthetic warm-up traffic, ``pad_fuse`` the pow2 padding
+  rows the fused megastep adds over the split path's exact pow2
+  decomposition — the fused-program waste row). :meth:`reclassify` moves already-
   counted goodput into a waste cause when the engine later discards it
   (zero-sum, clamped), so conservation — ``computed == goodput + Σ waste``
   — holds by construction and is audited by the armed invariant checker
@@ -74,6 +76,9 @@ WASTE_CAUSES = (
     "swap_recompute",   # host-tier restore failed; preserved KV recomputed
     "dedup_rewind",     # follower rewound past rows its dead leader wrote
     "prewarm",          # synthetic warm-up traffic (compute, no serving)
+    "pad_fuse",         # pow2 padding rows the fused megastep adds (the
+                        # split path's pow2 DECOMPOSITION has none): the
+                        # compute price paid for one-dispatch cycles
 )
 
 COLD_EVENTS_KEPT = 32  # recent serving-time cold compiles kept for /perf

@@ -60,6 +60,8 @@ ACP_BENCH_PROF=1 / ACP_BENCH_PROF_LEGS (dispatch-profiler on/off overhead
 guard on the headline burst — the compute efficiency observatory's <2%
 contract, emitted as the doc's additive ``prof`` block with the burst's
 goodput ratio),
+ACP_BENCH_MEGASTEP=1 (fused-megastep dispatches-per-cycle A/B; knobs
+ACP_BENCH_MEGASTEP_DECODERS/_PROMPT/_LONGS/_CHUNK/_TAIL_TOKENS/_KV_LAYOUT),
 ACP_BENCH_MEM=1 / ACP_BENCH_MEM_PROMPT / ACP_BENCH_MEM_TASKS /
 ACP_BENCH_MEM_PERSONA / ACP_BENCH_MEM_HOST_BYTES (KV memory-tier
 fixture: preempt->resume swap-in vs recompute-prefill latency, and
@@ -517,6 +519,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["flight"] = val
             elif key == "prof" and "prof" not in doc:
                 doc["prof"] = val
+            elif key == "megastep" and "megastep" not in doc:
+                doc["megastep"] = val
             else:
                 return
             _flush_doc(doc)
@@ -537,6 +541,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT flight", 900))
     if os.environ.get("ACP_BENCH_PROF", "0") == "1":
         main_schedule.append(("RESULT prof", 900))
+    if os.environ.get("ACP_BENCH_MEGASTEP", "0") == "1":
+        main_schedule.append(("RESULT megastep", 900))
     if ttft_on:
         main_schedule.append(("RESULT ttft", ttft_timeout))
 
@@ -967,12 +973,162 @@ def _child(args: argparse.Namespace) -> None:
         except Exception as e:  # the fixture must not lose the headline
             _result("prof", {"error": str(e)})
 
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_MEGASTEP", "0") == "1"
+    ):
+        try:
+            _result("megastep", _bench_megastep())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("megastep", {"error": str(e)})
+
     if ttft_on or args.only_ttft:
         try:
             _result("ttft", _bench_ttft(engine))
         except Exception as e:  # TTFT failure must not lose the headline
             _result("ttft", {"error": str(e)})
     engine.stop()
+
+
+def _bench_megastep() -> dict:
+    """Fused-megastep fixture (ACP_BENCH_MEGASTEP=1): a busy chunked
+    engine — N short decoders streaming while L long prompts chunk
+    through them — run twice against the same warmed engine, megastep OFF
+    (the PR 7 split per-phase dispatches) then ON (one fused program per
+    busy cycle). Reported per leg: model-program dispatches per
+    chunk-carrying scheduler cycle (the headline this PR exists to cut,
+    measured from the PR 12 profiler's program keys against the flight
+    recorder's per-cycle prefill_round events), decoder throughput, and
+    serving-time cold compiles (the engine is mark_prewarmed() after the
+    warm pass, so every first-of-shape in a measured leg is counted — the
+    fused shape zoo's real startup cost, not hidden). Generated tokens
+    must be byte-identical between the legs.
+
+    Knobs: ACP_BENCH_MEGASTEP_DECODERS (default 6),
+    ACP_BENCH_MEGASTEP_PROMPT (1024), ACP_BENCH_MEGASTEP_LONGS (4),
+    ACP_BENCH_MEGASTEP_CHUNK (128), ACP_BENCH_MEGASTEP_TAIL_TOKENS (96),
+    ACP_BENCH_MEGASTEP_KV_LAYOUT (paged)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+
+    n_dec = int(os.environ.get("ACP_BENCH_MEGASTEP_DECODERS", "6"))
+    plen = int(os.environ.get("ACP_BENCH_MEGASTEP_PROMPT", "1024"))
+    n_long = int(os.environ.get("ACP_BENCH_MEGASTEP_LONGS", "4"))
+    chunk = int(os.environ.get("ACP_BENCH_MEGASTEP_CHUNK", "128"))
+    dec_budget = int(os.environ.get("ACP_BENCH_MEGASTEP_TAIL_TOKENS", "96"))
+    kv_layout = os.environ.get("ACP_BENCH_MEGASTEP_KV_LAYOUT", "paged")
+    max_ctx = plen + 2 * chunk
+    cfg = dataclasses.replace(PRESETS["tiny"], max_seq_len=max_ctx, vocab_size=512)
+    engine = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        max_slots=n_dec + 2,
+        max_ctx=max_ctx,
+        prefill_buckets=(64, chunk, plen),
+        decode_block_size=4,
+        kv_layout=kv_layout,
+        page_size=16,
+        prefill_chunk=chunk,
+        prefix_cache_entries=0,  # leg 2 must not skip leg 1's prefills
+        check_invariants=os.environ.get("ACP_INVARIANTS", "") not in ("", "0"),
+    )
+    engine.start()
+    CYCLE_KINDS = (
+        "megastep", "chunk", "decode", "spec_verify", "prefill_cont",
+        "prefill", "spill",
+    )
+
+    def model_dispatches() -> int:
+        return sum(
+            v["dispatches"]
+            for k, v in engine.profiler.stats()["programs"].items()
+            if k.split("[")[0] in CYCLE_KINDS
+        )
+
+    def chunk_cycles() -> int:
+        # prefill_round fires once per scheduler cycle that carried chunk
+        # work — the busy-cycle denominator
+        return sum(1 for _ in engine.flight.events(kind="prefill_round", last=4096))
+
+    try:
+        shorts = [[2 + ((i + j) % 200) for j in range(48)] for i in range(n_dec)]
+        longs = [
+            [1 + ((i + j) % 250) for j in range(plen - 8 * i)]
+            for i in range(n_long)
+        ]
+        dec_sp = SamplingParams(temperature=0.0, max_tokens=dec_budget)
+        one = SamplingParams(temperature=0.0, max_tokens=4)
+
+        def leg(mega_on: bool) -> dict:
+            engine.megastep = mega_on
+            d0, c0 = model_dispatches(), chunk_cycles()
+            cold0 = engine.profiler.stats()["cold_compiles"]["serving"]
+            t0 = time.monotonic()
+            futs = [engine.submit(list(s), dec_sp) for s in shorts]
+            for f in futs:
+                f.admitted.result(timeout=1800)
+            long_futs = [engine.submit(list(p), one) for p in longs]
+            results = [f.result(timeout=1800) for f in futs + long_futs]
+            elapsed = time.monotonic() - t0
+            toks = sum(len(r.tokens) for r in results)
+            cycles = max(1, chunk_cycles() - c0)
+            stats = engine.profiler.stats()
+            return {
+                "dispatches_per_chunk_cycle": round(
+                    (model_dispatches() - d0) / cycles, 2
+                ),
+                "chunk_cycles": cycles,
+                "tok_s": round(toks / elapsed, 1),
+                "serving_cold_compiles": (
+                    stats["cold_compiles"]["serving"] - cold0
+                ),
+                "tokens": [r.tokens for r in results],
+            }
+
+        # warm BOTH paths with the full leg-shaped workload (compiles
+        # land outside the measured legs — on CPU a single fused compile
+        # would otherwise dominate a leg), then declare prewarm so any
+        # REMAINING first-of-shape dispatch in a measured leg is honestly
+        # counted as a serving-time cold compile
+        for mega_on in (False, True):
+            leg(mega_on)
+        engine.profiler.mark_prewarmed()
+
+        off = leg(mega_on=False)
+        on = leg(mega_on=True)
+        identical = off.pop("tokens") == on.pop("tokens")
+        reduction = (
+            round(off["dispatches_per_chunk_cycle"]
+                  / on["dispatches_per_chunk_cycle"], 2)
+            if on["dispatches_per_chunk_cycle"] > 0 else 0.0
+        )
+        return {
+            "decoders": n_dec,
+            "long_prompts": n_long,
+            "prompt_tokens": plen,
+            "chunk": chunk,
+            "kv_layout": kv_layout,
+            "megastep_off": off,
+            "megastep_on": on,
+            "dispatch_reduction_x": reduction,
+            "fused_shapes": len(engine._megastep_shapes),
+            "megastep_fallbacks": engine.megastep_fallbacks,
+            "byte_identical": identical,
+            "note": (
+                f"busy chunked cycles pay {on['dispatches_per_chunk_cycle']} "
+                f"dispatch(es) fused vs {off['dispatches_per_chunk_cycle']} "
+                f"split ({reduction}x fewer); decoder throughput "
+                f"{on['tok_s']} vs {off['tok_s']} tok/s; "
+                f"{on['serving_cold_compiles']} serving-time cold compiles "
+                f"in the fused leg ({len(engine._megastep_shapes)} fused "
+                "shapes), byte-identical"
+            ),
+        }
+    finally:
+        engine.stop()
 
 
 def _bench_tool_turn(engine) -> dict:

@@ -483,6 +483,55 @@ def test_budget_sharing_fires_outside_the_seam(tmp_path):
     assert "_verify" in violations[0].message
 
 
+def test_dispatch_seam_fires_outside_declared_seams(tmp_path):
+    """A compiled-program call (or alias) from an unmarked method of a
+    seam-declaring class is a new dispatch site: the multi-dispatch
+    regression the fused megastep exists to prevent."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _megastep_dispatch(self):  # acp: megastep-seam
+                return self._jit_megastep(self.params)
+
+            def _sneaky_extra_dispatch(self):
+                return self._jit_decode(self.params)
+
+            def _sneaky_alias(self):
+                fn = self._jit_prefill
+                return fn(self.params)
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["dispatch-seam", "dispatch-seam"]
+    assert "_sneaky_extra_dispatch" in violations[0].message
+    assert "_sneaky_alias" in violations[1].message
+
+
+def test_dispatch_seam_allows_builder_stores_and_unmarked_classes(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _megastep_dispatch(self):  # acp: megastep-seam
+                return self._jit_megastep(self.params)
+
+            def _build_jitted(self):
+                # Store context: assignment is construction, not dispatch
+                self._jit_megastep = object()
+
+        class NoSeamsDeclared:
+            def dispatch(self):
+                # a class with no declared seams is out of scope (the rule
+                # binds where the megastep contract was adopted)
+                return self._jit_anything(self.params)
+        """,
+    )
+    assert analyze([root]) == []
+
+
 # -- suppression pragma -------------------------------------------------------
 
 

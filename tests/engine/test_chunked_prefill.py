@@ -199,6 +199,20 @@ def test_decode_never_starved_and_chunks_always_progress():
 def test_deadline_expires_between_chunks_releases_partial_kv():
     eng = make_engine("paged", prefix_cache_entries=0)
     try:
+        # this test exercises the mid-prefill EXPIRY machinery, so the
+        # chunk-rate planner must not rescue the deadline (with it on, a
+        # 0.15s deadline gets a quota-sized chunk that finishes in time —
+        # the arithmetic the planner exists for), and each chunk cycle is
+        # slowed deterministically so a warm compile cache can't finish
+        # the 200-token prefill inside the deadline either
+        eng.rate_planner = False
+        real_chunks = eng._prefill_chunks
+
+        def slow_chunks(budget):
+            time.sleep(0.02)
+            return real_chunks(budget)
+
+        eng._prefill_chunks = slow_chunks
         free0 = eng._allocator.free_count
         expired0 = counter("acp_engine_deadline_expired_total")
         with chunked(eng, 1):

@@ -103,7 +103,10 @@ def test_profiler_on_off_greedy_byte_identity(kv_layout):
 
 
 def test_program_stats_and_ledger_populate():
-    eng = make_engine(kv_layout="paged", spec_len=4, prefill_chunk=16)
+    # megastep OFF: this test pins the SPLIT dispatch zoo (chunk + decode
+    # program keys), which remains the fused path's shape-bound fallback;
+    # the fused zoo is pinned by tests/engine/test_megastep.py
+    eng = make_engine(kv_layout="paged", spec_len=4, prefill_chunk=16, megastep=False)
     try:
         sp = SamplingParams(temperature=0.0, max_tokens=10)
         futs = [eng.submit(f"telemetry {i} " * 3, sp) for i in range(4)]
