@@ -86,6 +86,28 @@ METRICS: tuple[MetricSpec, ...] = (
         ("megastep", "megastep_on", "dispatches_per_chunk_cycle"),
         "lower", rel_tol=0.5,
     ),
+    # quantized serving (PR 14): the capacity multiplier is self-relative
+    # (slots int8 / slots bf16 at one byte budget — judged everywhere);
+    # the accuracy-gate series guard the quantized path's quality: top-1
+    # agreement must not sag below its pinned-trend band, logit MAE must
+    # not swell (tight tolerances — these move only if the quantization
+    # math itself changes, which should be a deliberate act)
+    MetricSpec("quant_slots_x", ("quant", "slot_capacity_x"), "higher", 0.3),
+    MetricSpec(
+        "quant_top1_kv",
+        ("quant", "accuracy_gate", "kv", "top1_agreement"),
+        "higher", rel_tol=0.05,
+    ),
+    MetricSpec(
+        "quant_top1_both",
+        ("quant", "accuracy_gate", "both", "top1_agreement"),
+        "higher", rel_tol=0.05,
+    ),
+    MetricSpec(
+        "quant_logit_mae_both",
+        ("quant", "accuracy_gate", "both", "logit_mae"),
+        "lower", rel_tol=1.0, max_abs=0.05,
+    ),
 )
 
 

@@ -130,7 +130,24 @@ class TPUProviderConfig(APIModel):
     max_sequences: int = 64
     max_context: int = 8192
     page_size: int = 16
+    # Legacy spelling of quantize_weights (kept for existing manifests);
+    # either form selects weight-only int8 serving.
     quantization: Optional[Literal["int8"]] = None
+    # Serve int8 weights (per-output-channel scales, quantized host-side
+    # at checkpoint load so the bf16 copy of a big model never reaches
+    # the device): half the weight HBM, ~2x decode-bandwidth headroom.
+    # Serve-time CLI: --tpu-quantize-weights. See docs/serving-engine.md
+    # "Serving quantized".
+    quantize_weights: bool = False
+    # int8 KV cache with per-row-per-head scales (both KV layouts): a
+    # fixed HBM page/slot budget holds ~2x the tokens, and the host
+    # KV tier + shared-prefix dedup carry the quantized bytes (the
+    # multipliers compound). UNLIKE every other serving knob this relaxes
+    # greedy byte-identity — outputs are gated by the pinned accuracy
+    # fixture (top-1 greedy agreement + logit-MAE bounds vs the bf16
+    # path) instead; both knobs off remains bit-for-bit identical.
+    # Serve-time CLI: --tpu-quantize-kv.
+    quantize_kv: bool = False
     # Per-request generation timeout, measured FROM SLOT ADMISSION (not
     # submit). Defaults to the reference's 30 s LLMRequestTimeout
     # (task_controller.go:25) so a wedged generation cannot hold a task

@@ -66,7 +66,26 @@ def _add_tpu_flags(p) -> None:
         "mesh axis (Mixtral-family presets/checkpoints)",
     )
     p.add_argument("--tpu-kv-layout", choices=["slot", "paged"], default="slot")
-    p.add_argument("--tpu-quantize", choices=["int8"], default=None)
+    p.add_argument(
+        "--tpu-quantize", choices=["int8"], default=None,
+        help="legacy spelling of --tpu-quantize-weights",
+    )
+    p.add_argument(
+        "--tpu-quantize-weights", action="store_true",
+        help="serve int8 weights (per-output-channel scales, quantized "
+        "host-side at checkpoint load so the bf16 copy never reaches the "
+        "device): half the weight HBM and ~2x decode bandwidth headroom "
+        "(see docs/serving-engine.md 'Serving quantized')",
+    )
+    p.add_argument(
+        "--tpu-quantize-kv", action="store_true",
+        help="int8 KV cache with per-row scales (both layouts): a fixed "
+        "HBM page/slot budget holds ~2x the tokens, and the host KV tier "
+        "+ shared-prefix dedup carry the quantized bytes. Relaxes greedy "
+        "byte-identity — outputs are gated by the pinned accuracy fixture "
+        "(top-1 agreement + logit-MAE bounds vs bf16; see "
+        "docs/serving-engine.md 'Serving quantized')",
+    )
     p.add_argument(
         "--tpu-max-queue", type=int, default=0,
         help="admission-queue cap: submissions beyond this many waiting "
@@ -153,11 +172,13 @@ def _build_engine(args, coordination=None):
     from .engine.engine import Engine
     from .engine.tokenizer import ByteTokenizer, HFTokenizer
 
+    quantize = "int8" if args.tpu_quantize_weights else args.tpu_quantize
     kw = dict(
         max_slots=args.tpu_slots,
         max_ctx=args.tpu_ctx,
         kv_layout=args.tpu_kv_layout,
-        quantize=args.tpu_quantize,
+        quantize=quantize,
+        quantize_kv=args.tpu_quantize_kv,
         max_queue=args.tpu_max_queue,
         spec_len=args.tpu_spec_len,
         spec_ngram=args.tpu_spec_ngram,
@@ -183,7 +204,7 @@ def _build_engine(args, coordination=None):
         # reaches the device
         params, config = load_safetensors_dir(
             args.tpu_checkpoint,
-            quantize=args.tpu_quantize,
+            quantize=quantize,
             lora_path=args.tpu_lora,
         )
         if args.tpu_lora:

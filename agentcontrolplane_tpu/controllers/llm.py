@@ -103,6 +103,23 @@ class LLMReconciler:
                         f"engine mesh ep={shape.get('ep', 1)} != spec "
                         f"expertParallelism={want_ep} (set acp-tpu run --tpu-ep)"
                     )
+                # quantization is the same declarative-intent contract: a
+                # spec requesting quantized serving from a bf16 engine must
+                # fail validation, not silently serve unquantized
+                want_qw = bool(
+                    llm.spec.tpu.quantize_weights or llm.spec.tpu.quantization
+                )
+                if want_qw and engine.quantize != "int8":
+                    raise Invalid(
+                        "engine serves bf16 weights but spec requests "
+                        "quantizeWeights (set acp-tpu run "
+                        "--tpu-quantize-weights)"
+                    )
+                if llm.spec.tpu.quantize_kv and not engine.quantize_kv:
+                    raise Invalid(
+                        "engine serves bf16 KV but spec requests quantizeKv "
+                        "(set acp-tpu run --tpu-quantize-kv)"
+                    )
         return ""
 
     async def _probe(self, llm: LLM, api_key: str) -> None:

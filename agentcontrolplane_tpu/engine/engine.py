@@ -400,6 +400,20 @@ class Engine:
         # disarmed (the fault seam's near-free posture).
         check_invariants: Optional[bool] = None,
         quantize: Optional[str] = None,  # "int8" = weight-only int8 serving
+        # alias for quantize="int8" matching the CRD/CLI knob names
+        # (--tpu-quantize-weights / LLM.spec.tpu.quantizeWeights)
+        quantize_weights: bool = False,
+        # int8 KV cache with per-row-per-head scales (both layouts): write
+        # paths quantize on commit, attention dequantizes after the gather,
+        # so a fixed HBM page/slot budget holds ~2x the tokens and the
+        # host-RAM tier + shared-prefix dedup carry the quantized bytes
+        # (both multipliers compound). UNLIKE every other serving knob this
+        # legitimately relaxes greedy byte-identity — outputs are gated by
+        # the pinned accuracy fixture (engine/accuracy.py; top-1 greedy
+        # agreement + logit-MAE bounds vs the bf16 path) instead. Off (the
+        # default) stays bit-for-bit identical to the pre-quantization
+        # engine. CLI: --tpu-quantize-kv; CRD: LLM.spec.tpu.quantizeKv.
+        quantize_kv: bool = False,
         seed: int = 0,
         # Multi-host lockstep serving (engine/coordination.py): rank 0
         # passes a CoordinationLeader (it drains the submit queue and
@@ -502,6 +516,9 @@ class Engine:
         t0 = time.monotonic()
         if quantize not in (None, "int8"):
             raise ValueError(f"unsupported quantization {quantize!r}")
+        if quantize_weights:
+            quantize = "int8"
+        self.quantize_kv = bool(quantize_kv)
         if params is None and quantize == "int8" and tp == 1:
             # host-side quantized random init: the device-init path below
             # peaks at the FULL bf16 model + one tensor (16GB for 8B — by
@@ -532,6 +549,32 @@ class Engine:
                     layers[key] = jax.jit(_q)(layers[key])
         self.quantize = quantize
         self.params = params
+        # per-device bytes held by weights (QuantizedTensor leaves flatten
+        # to their int8 values + f32 scales, so this is the SERVED
+        # footprint — the observable ~2x of quantize_weights). A sharded
+        # leaf's .nbytes is the GLOBAL logical size, so sum per-shard bytes
+        # per device and take the max — the per-chip HBM cost (tp-sharded
+        # leaves count 1/tp per chip, replicated leaves their full size).
+        # Immutable after init.
+        per_device: dict = {}
+        for leaf in jax.tree_util.tree_leaves(params):
+            shards = getattr(leaf, "addressable_shards", None)
+            if not shards:
+                per_device[None] = per_device.get(None, 0) + int(
+                    getattr(leaf, "nbytes", 0)
+                )
+            else:
+                for s in shards:
+                    per_device[s.device] = (
+                        per_device.get(s.device, 0) + int(s.data.nbytes)
+                    )
+        self.weight_bytes = int(max(per_device.values(), default=0))
+        REGISTRY.gauge_set(
+            "acp_engine_weight_bytes", float(self.weight_bytes),
+            help="per-device bytes held by model weights as served, max "
+            "across local devices (int8 values + scales under "
+            "quantize_weights, bf16 otherwise)",
+        )
         if self.kv_layout == "paged":
             if self.max_ctx % self.page_size:
                 raise ValueError(
@@ -560,15 +603,22 @@ class Engine:
             # over its page slices (pos_base masking) and the unnormalized
             # (acc, m, l) states merge across ranks with one pmax + two
             # [S, H]-sized psums (paged_attention.py *_sp_sharded).
+            # quantize_kv also forces the reference path: the Pallas kernel
+            # has no int8 page walk yet, and the XLA reference dequantizes
+            # after the per-slot gather (the pool stays int8 in HBM)
             self._use_pallas = (
-                jax.default_backend() == "tpu" and config.head_dim % 128 == 0
+                jax.default_backend() == "tpu"
+                and config.head_dim % 128 == 0
+                and not self.quantize_kv
             )
             if jax.default_backend() == "tpu" and not self._use_pallas:
                 log.warning(
-                    "paged kv_layout on TPU without the Pallas kernel: "
-                    "head_dim %d is not a multiple of 128; decode uses the "
-                    "XLA gather reference (materializes the gathered context "
-                    "every step)", config.head_dim,
+                    "paged kv_layout on TPU without the Pallas kernel: %s; "
+                    "decode uses the XLA gather reference (materializes the "
+                    "gathered context every step)",
+                    "quantize_kv has no int8 kernel path yet"
+                    if self.quantize_kv
+                    else f"head_dim {config.head_dim} is not a multiple of 128",
                 )
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
@@ -1086,8 +1136,11 @@ class Engine:
         self._tables_dirty = True
         if self.kv_layout == "slot":
             self.cache = jax.jit(
-                lambda: init_kv_cache(self.config, self.max_slots, self.max_ctx),
-                out_shardings=kv_cache_shardings(self.mesh),
+                lambda: init_kv_cache(
+                    self.config, self.max_slots, self.max_ctx,
+                    quantize_kv=self.quantize_kv,
+                ),
+                out_shardings=kv_cache_shardings(self.mesh, self.quantize_kv),
             )()
         else:
             from jax.sharding import NamedSharding, PartitionSpec as P
@@ -1108,11 +1161,21 @@ class Engine:
                 "k": NamedSharding(self.mesh, page_spec),
                 "v": NamedSharding(self.mesh, page_spec),
             }
+            if self.quantize_kv:
+                # scale twins [L, NP, P, H_kv]: value spec minus head_dim
+                scale_spec = NamedSharding(self.mesh, P(None, None, sp_axis, "tp"))
+                page_shardings["ks"] = scale_spec
+                page_shardings["vs"] = scale_spec
             self.cache = jax.jit(
-                lambda: init_paged_cache(self.config, self.num_pages, self.page_size),
+                lambda: init_paged_cache(
+                    self.config, self.num_pages, self.page_size,
+                    quantize_kv=self.quantize_kv,
+                ),
                 out_shardings=page_shardings,
             )()
-            self._allocator = PageAllocator(self.num_pages)
+            self._allocator = PageAllocator(
+                self.num_pages, track_scales=self.quantize_kv
+            )
             self._slot_pages: dict[int, list[int]] = {}
             self._block_tables = np.full(
                 (self.max_slots, self.max_pages_per_seq), TRASH_PAGE, dtype=np.int32
@@ -1636,6 +1699,8 @@ class Engine:
                 "layers": self.config.n_layers,
                 "vocab": self.config.vocab_size,
                 "quantize": self.quantize,
+                "quantize_kv": self.quantize_kv,
+                "weight_bytes": self.weight_bytes,
             },
             "kv_layout": self.kv_layout,
             "max_slots": self.max_slots,
@@ -1733,6 +1798,17 @@ class Engine:
                     "enabled": self.prefix_dedup and self.kv_layout == "paged",
                     "shares": self.prefix_shares,
                     "shared_pages": self._prefix_shared_pages,
+                },
+                # int8 KV cache (quantize_kv): at a fixed HBM budget the
+                # pool holds ~2x the tokens; compounds with the host tier
+                # and dedup above (both carry the quantized bytes)
+                "quantized_kv": {
+                    "enabled": self.quantize_kv,
+                    "pages": (
+                        self._allocator.allocated_count  # acp-lint: disable=thread-ownership
+                        if self.quantize_kv and self.kv_layout == "paged"
+                        else 0
+                    ),
                 },
             },
             "mesh": {
@@ -2895,19 +2971,24 @@ class Engine:
         fn = self._jit_copy_prefix.get(cut)
         if fn is None:
 
-            def copy(cache, slot_, ek, ev):
-                k = jax.lax.dynamic_update_slice(
-                    cache["k"], ek[:, None], (0, slot_, 0, 0, 0)
-                )
-                v = jax.lax.dynamic_update_slice(
-                    cache["v"], ev[:, None], (0, slot_, 0, 0, 0)
-                )
-                return {"k": k, "v": v}
+            def copy(cache, slot_, rows):
+                # dict-generic over the cache's keys so a quantized cache's
+                # scale rows ("ks"/"vs", one rank lower) copy with the values
+                return {
+                    name: jax.lax.dynamic_update_slice(
+                        arr, rows[name][:, None],
+                        (0, slot_) + (0,) * (arr.ndim - 2),
+                    )
+                    for name, arr in cache.items()
+                }
 
             fn = jax.jit(copy, donate_argnums=(0,))
             self._jit_copy_prefix[cut] = fn
         prof_t0 = self.profiler.start()
-        self.cache = fn(self.cache, jnp.int32(slot), entry["k"], entry["v"])
+        self.cache = fn(
+            self.cache, jnp.int32(slot),
+            {name: entry[name] for name in self.cache},
+        )
         self.profiler.record(
             f"prefix_copy[{cut}]", prof_t0, out=self.cache["k"],
             real_tokens=cut, real_slots=1,
@@ -2952,27 +3033,28 @@ class Engine:
             fn = self._jit_extract_prefix.get(cut)
             if fn is None:
                 L = self.config.n_layers
-                Hkv = self.config.n_kv_heads
-                d = self.config.head_dim
 
                 def extract(cache, slot_):
-                    ek = jax.lax.dynamic_slice(
-                        cache["k"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
-                    )[:, 0]
-                    ev = jax.lax.dynamic_slice(
-                        cache["v"], (0, slot_, 0, 0, 0), (L, 1, cut, Hkv, d)
-                    )[:, 0]
-                    return ek, ev
+                    # dict-generic: values [L, cut, H, d] and (quantized)
+                    # scale rows [L, cut, H] slice with the same indices
+                    return {
+                        name: jax.lax.dynamic_slice(
+                            arr,
+                            (0, slot_) + (0,) * (arr.ndim - 2),
+                            (L, 1, cut) + arr.shape[3:],
+                        )[:, 0]
+                        for name, arr in cache.items()
+                    }
 
                 fn = jax.jit(extract)  # read-only: cache NOT donated
                 self._jit_extract_prefix[cut] = fn
             prof_t0 = self.profiler.start()
-            ek, ev = fn(self.cache, jnp.int32(slot))
+            rows = fn(self.cache, jnp.int32(slot))
             self.profiler.record(
-                f"prefix_extract[{cut}]", prof_t0, out=ek,
+                f"prefix_extract[{cut}]", prof_t0, out=rows["k"],
                 real_tokens=cut, real_slots=1,
             )
-            entry = {"cut": cut, "k": ek, "v": ev}
+            entry = {"cut": cut, **rows}
         with self._prefix_lock:
             self._prefix_cache[key] = entry
             while len(self._prefix_cache) > self._prefix_cache_entries or (
@@ -5094,19 +5176,22 @@ class Engine:
             entry = sl.swap_entry
             if entry.rid != req.rid:
                 entry = HostKVEntry(
-                    rid=req.rid, tokens=entry.tokens, k=entry.k, v=entry.v
+                    rid=req.rid, tokens=entry.tokens, k=entry.k, v=entry.v,
+                    k_scale=entry.k_scale, v_scale=entry.v_scale,
                 )
             cut = entry.cut
         else:
             if self.kv_layout == "paged":
-                k_np, v_np = self._extract_pages(
+                rows = self._extract_pages(
                     self._slot_pages[slot][: cut // self.page_size]
                 )
-                k_np, v_np = k_np[:, :cut], v_np[:, :cut]
+                rows = {name: a[:, :cut] for name, a in rows.items()}
             else:
-                k_np, v_np = self._extract_rows(slot, cut)
+                rows = self._extract_rows(slot, cut)
             entry = HostKVEntry(
-                rid=req.rid, tokens=tuple(row[:cut]), k=k_np, v=v_np
+                rid=req.rid, tokens=tuple(row[:cut]),
+                k=rows["k"], v=rows["v"],
+                k_scale=rows.get("ks"), v_scale=rows.get("vs"),
             )
         if not pool.put(entry):
             return False  # bigger than the whole budget: recompute instead
@@ -5126,77 +5211,85 @@ class Engine:
         self._publish_memory_state()
         return True
 
-    def _extract_pages(self, pages: list[int]) -> tuple[np.ndarray, np.ndarray]:  # acp: megastep-seam
-        """Gather paged KV pages to host numpy, token-major [L, nP, H, d].
-        Dispatches decompose into pow2 page counts (bounded jit entries);
-        the device->host copies are issued async and joined at the end so
-        the DMA overlaps the remaining gathers."""
+    def _extract_pages(self, pages: list[int]) -> dict[str, np.ndarray]:  # acp: megastep-seam
+        """Gather paged KV pages to host numpy, token-major
+        ``{"k"/"v": [L, nP, H, d]}`` plus ``"ks"/"vs": [L, nP, H]`` scale
+        rows when the pool is quantized (the host tier carries the int8
+        bytes verbatim — no requantization round trip). Dispatches
+        decompose into pow2 page counts (bounded jit entries); the
+        device->host copies are issued async and joined at the end so the
+        DMA overlaps the remaining gathers."""
         P = self.page_size
         cfg = self.config
-        chunks: list[tuple] = []
+        chunks: list[dict] = []
         i = 0
         for n in _pow2_sizes(len(pages)):
             fn = self._jit_swap_gather.get(n)
             if fn is None:
-                fn = jax.jit(lambda c, ids: (c["k"][:, ids], c["v"][:, ids]))
+                fn = jax.jit(
+                    lambda c, ids: {name: a[:, ids] for name, a in c.items()}
+                )
                 self._jit_swap_gather[n] = fn
             ids = np.asarray(pages[i : i + n], dtype=np.int32)
             prof_t0 = self.profiler.start()
             out = fn(self.cache, self._put(ids))
             self.profiler.record(
-                f"swap_gather[{n}]", prof_t0, out=out[0], real_tokens=n * P
+                f"swap_gather[{n}]", prof_t0, out=out["k"], real_tokens=n * P
             )
             chunks.append(out)
             i += n
-        for k, v in chunks:
-            for a in (k, v):
+        for ch in chunks:
+            for a in ch.values():
                 if hasattr(a, "copy_to_host_async"):
                     a.copy_to_host_async()
-        ks = [np.asarray(k) for k, _ in chunks]
-        vs = [np.asarray(v) for _, v in chunks]
         T = len(pages) * P
-        shape = (cfg.n_layers, T, cfg.n_kv_heads, cfg.head_dim)
-        return (
-            np.concatenate(ks, axis=1).reshape(shape),
-            np.concatenate(vs, axis=1).reshape(shape),
-        )
+        out_np: dict[str, np.ndarray] = {}
+        for name in self.cache:
+            parts = [np.asarray(ch[name]) for ch in chunks]
+            merged = np.concatenate(parts, axis=1)  # [L, nP_total, P, ...]
+            out_np[name] = merged.reshape(
+                (cfg.n_layers, T) + merged.shape[3:]
+            )
+        return out_np
 
-    def _extract_rows(self, slot: int, cut: int) -> tuple[np.ndarray, np.ndarray]:  # acp: megastep-seam
+    def _extract_rows(self, slot: int, cut: int) -> dict[str, np.ndarray]:  # acp: megastep-seam
         """Slot layout: slice rows [0, cut) of ``slot`` out of the cache to
-        host numpy [L, cut, H, d] (pow2 sub-slices; async fetch)."""
-        L, Hkv, d = self.config.n_layers, self.config.n_kv_heads, self.config.head_dim
-        chunks: list[tuple] = []
+        host numpy ``{"k"/"v": [L, cut, H, d]}`` (+ scale rows for a
+        quantized cache); pow2 sub-slices, async fetch."""
+        L = self.config.n_layers
+        chunks: list[dict] = []
         start = 0
         for n in _pow2_sizes(cut):
             fn = self._jit_swap_extract.get(n)
             if fn is None:
 
                 def extract(cache, slot_, start_, n=n):
-                    ek = jax.lax.dynamic_slice(
-                        cache["k"], (0, slot_, start_, 0, 0), (L, 1, n, Hkv, d)
-                    )[:, 0]
-                    ev = jax.lax.dynamic_slice(
-                        cache["v"], (0, slot_, start_, 0, 0), (L, 1, n, Hkv, d)
-                    )[:, 0]
-                    return ek, ev
+                    return {
+                        name: jax.lax.dynamic_slice(
+                            arr,
+                            (0, slot_, start_) + (0,) * (arr.ndim - 3),
+                            (L, 1, n) + arr.shape[3:],
+                        )[:, 0]
+                        for name, arr in cache.items()
+                    }
 
                 fn = jax.jit(extract)  # read-only: cache NOT donated
                 self._jit_swap_extract[n] = fn
             prof_t0 = self.profiler.start()
             out = fn(self.cache, jnp.int32(slot), jnp.int32(start))
             self.profiler.record(
-                f"swap_extract[{n}]", prof_t0, out=out[0], real_tokens=n
+                f"swap_extract[{n}]", prof_t0, out=out["k"], real_tokens=n
             )
             chunks.append(out)
             start += n
-        for k, v in chunks:
-            for a in (k, v):
+        for ch in chunks:
+            for a in ch.values():
                 if hasattr(a, "copy_to_host_async"):
                     a.copy_to_host_async()
-        return (
-            np.concatenate([np.asarray(k) for k, _ in chunks], axis=1),
-            np.concatenate([np.asarray(v) for _, v in chunks], axis=1),
-        )
+        return {
+            name: np.concatenate([np.asarray(ch[name]) for ch in chunks], axis=1)
+            for name in self.cache
+        }
 
     def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:  # acp: megastep-seam
         """Restore rows [start, start+n) of a host entry into ``slot``'s
@@ -5204,6 +5297,13 @@ class Engine:
         chunks). Returns the engine-thread seconds spent blocked in the
         host->device copies (the host_stall phase input)."""
         t0 = time.monotonic()
+        rows = {"k": entry.k, "v": entry.v}
+        if "ks" in self.cache:
+            # quantized cache: the entry MUST carry matching scale rows (a
+            # bf16 entry cannot restore into an int8 pool) — _swap_out on a
+            # quantized engine always records them
+            rows["ks"] = entry.k_scale
+            rows["vs"] = entry.v_scale
         if self.kv_layout == "paged":
             P = self.page_size
             pages = self._slot_pages[slot][start // P : (start + n) // P]
@@ -5212,24 +5312,25 @@ class Engine:
                 fn = self._jit_swap_scatter.get(m)
                 if fn is None:
                     fn = jax.jit(
-                        lambda c, ids, kb, vb: {
-                            "k": c["k"].at[:, ids].set(kb),
-                            "v": c["v"].at[:, ids].set(vb),
+                        lambda c, ids, blocks: {
+                            name: c[name].at[:, ids].set(blocks[name])
+                            for name in c
                         },
                         donate_argnums=(0,),
                     )
                     self._jit_swap_scatter[m] = fn
                 ids = np.asarray(pages[i : i + m], dtype=np.int32)
                 lo = start + i * P
-                kb = entry.k[:, lo : lo + m * P].reshape(
-                    entry.k.shape[0], m, P, *entry.k.shape[2:]
-                )
-                vb = entry.v[:, lo : lo + m * P].reshape(
-                    entry.v.shape[0], m, P, *entry.v.shape[2:]
-                )
+                blocks = {
+                    name: a[:, lo : lo + m * P].reshape(
+                        a.shape[0], m, P, *a.shape[2:]
+                    )
+                    for name, a in rows.items()
+                }
                 prof_t0 = self.profiler.start()
                 self.cache = fn(
-                    self.cache, self._put(ids), self._put(kb), self._put(vb)
+                    self.cache, self._put(ids),
+                    {name: self._put(b) for name, b in blocks.items()},
                 )
                 self.profiler.record(
                     f"swap_scatter[{m}]", prof_t0, out=self.cache["k"],
@@ -5237,31 +5338,30 @@ class Engine:
                 )
                 i += m
         else:
-            L, Hkv, d = (
-                self.config.n_layers, self.config.n_kv_heads, self.config.head_dim,
-            )
             pos = start
             while pos < start + n:
                 m = _pow2_sizes(start + n - pos)[0]
                 fn = self._jit_swap_restore.get(m)
                 if fn is None:
 
-                    def restore(cache, slot_, start_, kb, vb):
-                        k = jax.lax.dynamic_update_slice(
-                            cache["k"], kb[:, None], (0, slot_, start_, 0, 0)
-                        )
-                        v = jax.lax.dynamic_update_slice(
-                            cache["v"], vb[:, None], (0, slot_, start_, 0, 0)
-                        )
-                        return {"k": k, "v": v}
+                    def restore(cache, slot_, start_, blocks):
+                        return {
+                            name: jax.lax.dynamic_update_slice(
+                                arr, blocks[name][:, None],
+                                (0, slot_, start_) + (0,) * (arr.ndim - 3),
+                            )
+                            for name, arr in cache.items()
+                        }
 
                     fn = jax.jit(restore, donate_argnums=(0,))
                     self._jit_swap_restore[m] = fn
                 prof_t0 = self.profiler.start()
                 self.cache = fn(
                     self.cache, jnp.int32(slot), jnp.int32(pos),
-                    self._put(entry.k[:, pos : pos + m]),
-                    self._put(entry.v[:, pos : pos + m]),
+                    {
+                        name: self._put(a[:, pos : pos + m])
+                        for name, a in rows.items()
+                    },
                 )
                 self.profiler.record(
                     f"swap_restore[{m}]", prof_t0, out=self.cache["k"],
@@ -5407,6 +5507,22 @@ class Engine:
         else:
             self._host_kv_used = 0
             self._host_kv_entries = 0
+        # allocated_count is a len() read, same atomic contract as
+        # free_count; every allocated page of a quantized pool holds int8
+        # KV + its scale rows. Published unconditionally so knobs-off and
+        # slot-layout engines export an explicit 0 (dashboards comparing
+        # enabled-vs-disabled deploys need a present series, not a gap).
+        REGISTRY.gauge_set(
+            "acp_engine_quantized_kv_pages",
+            float(
+                self._allocator.allocated_count
+                if self.kv_layout == "paged" and self.quantize_kv
+                else 0
+            ),
+            help="allocated KV pages currently holding int8-"
+            "quantized KV (with per-row scale storage); 0 unless "
+            "quantize_kv is on",
+        )
         if self.kv_layout == "paged":
             self._prefix_shared_pages = self._allocator.shared_count
             REGISTRY.gauge_set(

@@ -30,6 +30,11 @@ armed (``ACP_INVARIANTS=1`` or ``Engine(check_invariants=True)``):
   shared-page counters (cross-request prefix dedup) must equal the truth
   recomputed from the refcount dict — a dedup'd page freed while a second
   slot still owns it shows up as unshared multi-ownership.
+- **quantized-KV accounting** (``quantize_kv``) — the cache carries int8
+  values with scale twins whose dims match exactly (knobs-off engines
+  carry NO scale storage), and in the paged layout every allocated page
+  owns exactly one set of scale rows, released with the page's last
+  reference — no scale-row leaks, no unowned-scale dequantization.
 - **host KV pool conservation** (host-RAM offload tier) — the pool's
   used-bytes equal the sum of its live entries' bytes (a swapped-out
   entry leaking from accounting can never be restored or reclaimed),
@@ -181,8 +186,47 @@ def verify_engine(engine) -> list[str]:
 
     problems.extend(_verify_host_pool(engine))
     problems.extend(_verify_profiler(engine))
+    problems.extend(_verify_quantized_cache(engine))
     if engine.kv_layout == "paged":
         problems.extend(_verify_pages(engine, slots))
+    return problems
+
+
+def _verify_quantized_cache(engine) -> list[str]:
+    """Quantized-KV structural coupling (both layouts): a quantize_kv
+    engine's cache must carry int8 values plus scale twins whose leading
+    dims match the value arrays exactly — a scale array sheared off its
+    values (wrong rows, missing key) dequantizes every later read into
+    garbage. Knobs-off engines must carry NO scale storage (the byte-
+    identical plain cache). Shape/dtype metadata only — no device
+    transfer."""
+    problems: list[str] = []
+    keys = set(engine.cache)
+    if not engine.quantize_kv:
+        if keys != {"k", "v"}:
+            problems.append(
+                f"quantize_kv off but the cache carries keys {sorted(keys)} "
+                "— scale storage must not exist on the bit-identical path"
+            )
+        return problems
+    if keys != {"k", "v", "ks", "vs"}:
+        problems.append(
+            f"quantize_kv on but the cache carries keys {sorted(keys)} "
+            "(want k/v int8 values + ks/vs scale rows)"
+        )
+        return problems
+    for name in ("k", "v"):
+        val, sc = engine.cache[name], engine.cache[name + "s"]
+        if str(val.dtype) != "int8":
+            problems.append(
+                f"quantized cache '{name}' has dtype {val.dtype}, not int8"
+            )
+        if tuple(sc.shape) != tuple(val.shape[:-1]):
+            problems.append(
+                f"scale rows '{name}s' shaped {tuple(sc.shape)} do not "
+                f"match value rows {tuple(val.shape[:-1])} — scale storage "
+                "sheared off its pages/rows"
+            )
     return problems
 
 
@@ -294,6 +338,34 @@ def _verify_pages(engine, slots: dict) -> list[str]:
             f"mirror drift: _prefix_shared_pages {engine._prefix_shared_pages} "
             f"!= {shared_truth} refcount-shared pages"
         )
+
+    # quantized-page scale accounting (quantize_kv): every allocated page
+    # of an int8 pool owns exactly one set of scale rows, released with the
+    # page's last reference — a page without scale ownership dequantizes
+    # reads through untracked rows, a scale row outliving its page is the
+    # quantized twin of a refcount leak
+    scale_set = alloc.scale_audit()
+    if engine.quantize_kv:
+        if scale_set is None:
+            problems.append(
+                "quantize_kv on but the allocator is not tracking scale-row "
+                "ownership (PageAllocator(track_scales=True) required)"
+            )
+        else:
+            missing = set(refs) - scale_set
+            if missing:
+                problems.append(
+                    f"allocated pages without owned scale rows: "
+                    f"{sorted(missing)[:8]} — quantized KV would dequantize "
+                    "through unowned scale storage"
+                )
+            stale = scale_set - set(refs)
+            if stale:
+                problems.append(
+                    f"scale rows owned for freed pages: {sorted(stale)[:8]} "
+                    "— scale-row leak (the quantized twin of a refcount "
+                    "leak)"
+                )
 
     # ownership audit: every reference is held by exactly refcount owners
     owners: Counter = Counter()

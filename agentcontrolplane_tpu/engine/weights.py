@@ -177,12 +177,12 @@ def params_from_state_dict(
                 np.asarray(ab["b"], dtype=np.float32),
             ) * lora[1].scale
         if quantize == "int8" and key in QUANTIZABLE:
-            absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
-            scale = np.maximum(absmax, 1e-8) / 127.0
-            q = np.clip(np.round(stacked / scale), -127, 127).astype(np.int8)
+            from ..ops.quant import quantize_np
+
+            q, scale = quantize_np(stacked)
             params["layers"][key] = QuantizedTensor(
                 q=put(f"layers.{key}.q", q),
-                scale=put(f"layers.{key}.scale", scale.astype(np.float32)),
+                scale=put(f"layers.{key}.scale", scale),
             )
         else:
             params["layers"][key] = put(f"layers.{key}", stacked)
@@ -420,6 +420,8 @@ def random_quantized_init(config: LlamaConfig, seed: int = 0) -> dict:
     schema = jax.eval_shape(lambda: init_params(c, jax.random.key(0)))
 
     def leaf(path, sds) -> Any:
+        from ..ops.quant import quantize_np
+
         name = str(path[-1].key)
         in_layers = len(path) >= 2 and str(path[-2].key) == "layers"
         shape = sds.shape
@@ -430,11 +432,9 @@ def random_quantized_init(config: LlamaConfig, seed: int = 0) -> dict:
         fan_in = shape[-1] if name == "embed" else shape[-2]
         stacked = rng.standard_normal(shape, dtype=np.float32) * fan_in**-0.5
         if in_layers and name in QUANTIZABLE:
-            absmax = np.max(np.abs(stacked), axis=-2, keepdims=True)
-            qscale = np.maximum(absmax, 1e-8) / 127.0
-            q = np.clip(np.round(stacked / qscale), -127, 127).astype(np.int8)
+            q, qscale = quantize_np(stacked)
             return QuantizedTensor(
-                q=put(q, keep_dtype=True), scale=put(qscale.astype(np.float32), True)
+                q=put(q, keep_dtype=True), scale=put(qscale, True)
             )
         return put(stacked)
 

@@ -33,6 +33,7 @@ from ..ops.attention import (
     decode_attention_cache_plus_new,
 )
 from ..ops.norms import rms_norm
+from ..ops.quant import kv_dequantize, kv_quantize
 from ..ops.rope import apply_rope
 
 
@@ -514,14 +515,76 @@ def forward(
 
 
 # ---------------------------------------------------------------------------
+# Serving: KV quantization plumbing (shared by the slot and paged layouts)
+# ---------------------------------------------------------------------------
+#
+# A quantized cache is the SAME dict with int8 "k"/"v" plus per-row-per-head
+# f32 scale arrays "ks"/"vs" shaped like the value arrays minus head_dim
+# ([L, S, C, H_kv] slot / [L, NP, P, H_kv] paged). Presence of "ks" is the
+# trace-time switch: every model program below commits through _kv_commit
+# (quantize-on-commit, same single scatter) and reads through _kv_rows
+# (dequantize-after-gather), so all compiled shapes — prefill, continuation,
+# KV-only megastep chunks, decode, spec verify — serve quantized without a
+# second code path. Scale scatters reuse the value scatter's leading
+# indices, so scale storage is owned/freed with its pages by construction.
+
+
+def _kv_scan_xs(cache: dict) -> tuple:
+    """The read-only KV xs a layer scan carries: ``((k, ks?), (v, vs?))``
+    tuples so quantized caches ride the same scan discipline."""
+    if "ks" in cache:
+        return (cache["k"], cache["ks"]), (cache["v"], cache["vs"])
+    return (cache["k"],), (cache["v"],)
+
+
+def _kv_rows(kv: tuple, idx, dtype) -> jax.Array:
+    """Gather rows/pages from one layer's scanned KV leaf group and
+    dequantize when quantized. ``idx`` is any indexer valid on the value
+    array's leading dims (slice, gather array, block table)."""
+    if len(kv) == 2:
+        return kv_dequantize(kv[0][idx], kv[1][idx], dtype)
+    return kv[0][idx].astype(dtype)
+
+
+def _kv_commit(cache: dict, new_k: jax.Array, new_v: jax.Array, setter) -> dict:
+    """Commit fresh K/V through ``setter(array, values)`` — the SAME
+    scatter applied to the value arrays ([..., H_kv, d]) and, for a
+    quantized cache, to the scale arrays ([..., H_kv]); quantization
+    happens here, once per dispatch, on the already-stacked commit."""
+    if "ks" in cache:
+        qk, sk = kv_quantize(new_k)
+        qv, sv = kv_quantize(new_v)
+        return {
+            "k": setter(cache["k"], qk),
+            "v": setter(cache["v"], qv),
+            "ks": setter(cache["ks"], sk),
+            "vs": setter(cache["vs"], sv),
+        }
+    return {
+        "k": setter(cache["k"], new_k.astype(cache["k"].dtype)),
+        "v": setter(cache["v"], new_v.astype(cache["v"].dtype)),
+    }
+
+
+# ---------------------------------------------------------------------------
 # Serving: slot KV cache
 # ---------------------------------------------------------------------------
 
 
-def init_kv_cache(config: LlamaConfig, max_slots: int, max_ctx: int) -> dict:
-    """[L, S, C, H_kv, d] per k/v, bf16."""
+def init_kv_cache(
+    config: LlamaConfig, max_slots: int, max_ctx: int, quantize_kv: bool = False
+) -> dict:
+    """[L, S, C, H_kv, d] per k/v, bf16 — or int8 plus [L, S, C, H_kv] f32
+    scale rows with ``quantize_kv`` (see the KV quantization plumbing)."""
     c = config
     shape = (c.n_layers, max_slots, max_ctx, c.n_kv_heads, c.head_dim)
+    if quantize_kv:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "ks": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "vs": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
     return {
         "k": jnp.zeros(shape, dtype=c.dtype),
         "v": jnp.zeros(shape, dtype=c.dtype),
@@ -565,13 +628,14 @@ def prefill_batch(
     # scatter — writing inside the scan would copy the whole cache per layer
     # (see decode_step)
     x, (new_k, new_v) = jax.lax.scan(body, x, params["layers"])
-    k_all = cache["k"].at[:, slots, :T].set(new_k.astype(cache["k"].dtype))
-    v_all = cache["v"].at[:, slots, :T].set(new_v.astype(cache["v"].dtype))
+    cache = _kv_commit(
+        cache, new_k, new_v, lambda arr, val: arr.at[:, slots, :T].set(val)
+    )
     # (padded tail is garbage but never read: decode masks by seq_len)
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]  # [B, D]
     logits = _head_logits(last, params, c)
-    return {"k": k_all, "v": v_all}, logits
+    return cache, logits
 
 
 def prefill(
@@ -627,14 +691,14 @@ def _continue_forward(
 
     def body(carry, scanned):
         x = carry
-        layer, k_cache_l, v_cache_l = scanned  # read-only
+        layer, k_kv, v_kv = scanned  # read-only (value + optional scales)
 
         def attn(q, k, v):
             k_full = jnp.concatenate(
-                [k_cache_l[slots], k.astype(k_cache_l.dtype)], axis=1
+                [_kv_rows(k_kv, slots, k.dtype), k], axis=1
             )
             v_full = jnp.concatenate(
-                [v_cache_l[slots], v.astype(v_cache_l.dtype)], axis=1
+                [_kv_rows(v_kv, slots, v.dtype), v], axis=1
             )
             out = continue_attention(
                 q, k_full, v_full, positions, key_pos,
@@ -647,17 +711,15 @@ def _continue_forward(
         return out, attn.new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+        body, x, (params["layers"], *_kv_scan_xs(cache))
     )
     # one scatter commits the suffix K/V for every layer
-    k_all = cache["k"].at[:, slots[:, None], write_pos].set(
-        new_k.astype(cache["k"].dtype)
-    )
-    v_all = cache["v"].at[:, slots[:, None], write_pos].set(
-        new_v.astype(cache["v"].dtype)
+    cache = _kv_commit(
+        cache, new_k, new_v,
+        lambda arr, val: arr.at[:, slots[:, None], write_pos].set(val),
     )
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
-    return {"k": k_all, "v": v_all}, x
+    return cache, x
 
 
 def prefill_continue(
@@ -733,11 +795,14 @@ def verify_continue(
 # ---------------------------------------------------------------------------
 
 
-def init_paged_cache(config: LlamaConfig, num_pages: int, page_size: int) -> dict:
+def init_paged_cache(
+    config: LlamaConfig, num_pages: int, page_size: int, quantize_kv: bool = False
+) -> dict:
     from ..ops.paged import init_kv_pages
 
     return init_kv_pages(
-        config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim, config.dtype
+        config.n_layers, num_pages, page_size, config.n_kv_heads, config.head_dim,
+        config.dtype, quantize=quantize_kv,
     )
 
 
@@ -771,17 +836,11 @@ def prefill_paged_batch(
     # pages stay out of the scan (prompt attention never reads them); one
     # scatter commits all layers' blocks — see prefill_batch/decode_step
     x, (new_k, new_v) = jax.lax.scan(body, x, params["layers"])
-    L = new_k.shape[0]
-    P = pages["k"].shape[2]
-    # [L, B, T, H, d] -> [L, B * T//P, P, H, d] blocks matched to flat ids
-    blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
-    flat_ids = page_ids.reshape(-1)
-    k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
-    v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
+    pages = _commit_whole_pages(pages, new_k, new_v, page_ids)
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     last = x[jnp.arange(B), lengths - 1]
     logits = _head_logits(last, params, c)
-    return {"k": k_all, "v": v_all}, logits
+    return pages, logits
 
 
 def prefill_paged(
@@ -841,17 +900,21 @@ def _paged_continue_forward(
 
     def body(carry, scanned):
         x = carry
-        layer, k_pages_l, v_pages_l = scanned  # read-only
+        layer, k_kv, v_kv = scanned  # read-only (value + optional scales)
 
         def attn(q, k, v):
-            k_rows = jnp.swapaxes(k_pages_l[block_tables], 1, 2).reshape(
-                B, P * max_pages, *k_pages_l.shape[2:]
+            # gather (+ dequantize) each row's pages, then transpose to the
+            # offset-major row order described above
+            k_gath = _kv_rows(k_kv, block_tables, k.dtype)  # [B, M, P, H, d]
+            v_gath = _kv_rows(v_kv, block_tables, v.dtype)
+            k_rows = jnp.swapaxes(k_gath, 1, 2).reshape(
+                B, P * max_pages, *k_gath.shape[3:]
             )
-            v_rows = jnp.swapaxes(v_pages_l[block_tables], 1, 2).reshape(
-                B, P * max_pages, *v_pages_l.shape[2:]
+            v_rows = jnp.swapaxes(v_gath, 1, 2).reshape(
+                B, P * max_pages, *v_gath.shape[3:]
             )
-            k_full = jnp.concatenate([k_rows, k.astype(k_rows.dtype)], axis=1)
-            v_full = jnp.concatenate([v_rows, v.astype(v_rows.dtype)], axis=1)
+            k_full = jnp.concatenate([k_rows, k], axis=1)
+            v_full = jnp.concatenate([v_rows, v], axis=1)
             out = continue_attention(
                 q, k_full, v_full, positions, key_pos,
                 softcap=c.attn_logit_softcap,
@@ -863,7 +926,7 @@ def _paged_continue_forward(
         return out, attn.new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], pages["k"], pages["v"])
+        body, x, (params["layers"], *_kv_scan_xs(pages))
     )
     x = rms_norm(x, _final_norm_w(params, c), c.norm_eps)
     return new_k, new_v, x
@@ -901,17 +964,20 @@ def _commit_whole_pages(
     new_v: jax.Array,
     page_ids: jax.Array,  # [B, T // P] int32
 ) -> dict:
-    """Whole-page commit shared by the split continuation and the fused
-    megastep's mid-chunk phase — one copy of the page-write discipline, so
-    the two paths' KV layout can never silently diverge."""
+    """Whole-page commit shared by the batch prefill, the split
+    continuation, and the fused megastep's mid-chunk phase — one copy of
+    the page-write discipline, so the paths' KV layout can never silently
+    diverge. The blocks reshape generalizes to the scale arrays (values
+    [L, B, T, H, d] and scales [L, B, T, H] both split T into pages)."""
     L = new_k.shape[0]
     B, T = new_k.shape[1], new_k.shape[2]
     P = pages["k"].shape[2]
     blocks = lambda t: t.reshape(L, B * (T // P), P, *t.shape[3:])
     flat_ids = page_ids.reshape(-1)
-    k_all = pages["k"].at[:, flat_ids].set(blocks(new_k).astype(pages["k"].dtype))
-    v_all = pages["v"].at[:, flat_ids].set(blocks(new_v).astype(pages["v"].dtype))
-    return {"k": k_all, "v": v_all}
+    return _kv_commit(
+        pages, new_k, new_v,
+        lambda arr, val: arr.at[:, flat_ids].set(blocks(val)),
+    )
 
 
 def prefill_paged_continue_kv(
@@ -959,9 +1025,11 @@ def verify_paged_continue(
         params, pages, tokens, lengths, starts, block_tables, config
     )
     target, offset = token_write_targets(block_tables, starts, lengths, P, T)
-    k_all = pages["k"].at[:, target, offset].set(new_k.astype(pages["k"].dtype))
-    v_all = pages["v"].at[:, target, offset].set(new_v.astype(pages["v"].dtype))
-    return {"k": k_all, "v": v_all}, _head_logits(x, params, config)
+    pages = _kv_commit(
+        pages, new_k, new_v,
+        lambda arr, val: arr.at[:, target, offset].set(val),
+    )
+    return pages, _head_logits(x, params, config)
 
 
 def decode_step_paged(
@@ -990,6 +1058,12 @@ def decode_step_paged(
     S = tokens.shape[0]
     positions = seq_lens[:, None]
     x = _embed(params, tokens[:, None], c)
+    quantized = "ks" in pages
+    if quantized and use_pallas:
+        # the Pallas kernel has no int8 page walk (future work); the engine
+        # disables the kernel when quantize_kv is on, and this guard keeps a
+        # direct caller from silently reading int8 bytes as bf16
+        raise ValueError("quantized KV pages require the XLA reference path")
     tp_size = sp_size = 1
     if mesh is not None:
         axes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -998,7 +1072,8 @@ def decode_step_paged(
 
     def body(carry, scanned):
         x = carry
-        layer, k_pages_l, v_pages_l = scanned  # read-only
+        layer, k_kv, v_kv = scanned  # read-only (value + optional scales)
+        k_pages_l, v_pages_l = k_kv[0], v_kv[0]
 
         def attn(q, k, v):
             if use_pallas and (tp_size > 1 or sp_size > 1):
@@ -1025,6 +1100,8 @@ def decode_step_paged(
                 out = paged_decode_attention_reference_cache_plus_new(
                     q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
                     k[:, 0], v[:, 0],
+                    k_scales=k_kv[1] if quantized else None,
+                    v_scales=v_kv[1] if quantized else None,
                 )
             attn.new_kv = (k[:, 0], v[:, 0])
             return out[:, None]
@@ -1033,7 +1110,7 @@ def decode_step_paged(
         return out, attn.new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], pages["k"], pages["v"])
+        body, x, (params["layers"], *_kv_scan_xs(pages))
     )
     # one scatter commits all layers: (l, page(slot), offset(slot)); inactive
     # slots land on the trash page
@@ -1042,11 +1119,13 @@ def decode_step_paged(
     offset = seq_lens % P
     target = block_tables[jnp.arange(S), page_idx]
     target = jnp.where(active, target, TRASH_PAGE)
-    k_all = pages["k"].at[:, target, offset].set(new_k.astype(pages["k"].dtype))
-    v_all = pages["v"].at[:, target, offset].set(new_v.astype(pages["v"].dtype))
+    pages = _kv_commit(
+        pages, new_k, new_v,
+        lambda arr, val: arr.at[:, target, offset].set(val),
+    )
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)
     logits = _head_logits(x, params, c)
-    return {"k": k_all, "v": v_all}, logits
+    return pages, logits
 
 
 def decode_step(
@@ -1091,11 +1170,14 @@ def decode_step(
 
     def body(carry, scanned):
         x = carry
-        layer, k_rows, v_rows = scanned  # cache rows: read-only
+        layer, k_kv, v_kv = scanned  # cache rows: read-only (+ scales)
 
         def attn(q, k, v):
             out = decode_attention_cache_plus_new(
-                q[:, 0], k_rows[:W], v_rows[:W], k[:, 0], v[:, 0], seq_lens,
+                q[:, 0],
+                _kv_rows(k_kv, slice(0, W), k.dtype),
+                _kv_rows(v_kv, slice(0, W), v.dtype),
+                k[:, 0], v[:, 0], seq_lens,
                 softcap=c.attn_logit_softcap,
             )
             attn.new_kv = (k[:, 0], v[:, 0])
@@ -1105,7 +1187,7 @@ def decode_step(
         return out, attn.new_kv
 
     x, (new_k, new_v) = jax.lax.scan(
-        body, x, (params["layers"], cache["k"], cache["v"])
+        body, x, (params["layers"], *_kv_scan_xs(cache))
     )
     # one scatter commits every layer's token: rows (l, s, seq_lens[s]);
     # inactive lanes clamp to the never-read last row
@@ -1114,8 +1196,10 @@ def decode_step(
     write_rows = (
         jnp.where(active, seq_lens, C - 1) if active is not None else seq_lens
     )
-    k_all = cache["k"].at[:, slot_idx, write_rows].set(new_k.astype(cache["k"].dtype))
-    v_all = cache["v"].at[:, slot_idx, write_rows].set(new_v.astype(cache["v"].dtype))
+    cache = _kv_commit(
+        cache, new_k, new_v,
+        lambda arr, val: arr.at[:, slot_idx, write_rows].set(val),
+    )
     x = rms_norm(x[:, 0], _final_norm_w(params, c), c.norm_eps)  # [S, D]
     logits = _head_logits(x, params, c)
-    return {"k": k_all, "v": v_all}, logits
+    return cache, logits

@@ -28,9 +28,21 @@ TRASH_PAGE = 0
 
 
 def init_kv_pages(
-    n_layers: int, num_pages: int, page_size: int, n_kv_heads: int, head_dim: int, dtype
+    n_layers: int, num_pages: int, page_size: int, n_kv_heads: int, head_dim: int,
+    dtype, quantize: bool = False,
 ) -> dict:
+    """Page pools [L, NP, P, H_kv, d] per k/v. With ``quantize`` the values
+    are int8 and per-row-per-head f32 scales ride page-shaped twins
+    ("ks"/"vs", [L, NP, P, H_kv]) indexed by the SAME page ids — scale
+    storage is allocated, shared, swapped, and freed with its pages."""
     shape = (n_layers, num_pages, page_size, n_kv_heads, head_dim)
+    if quantize:
+        return {
+            "k": jnp.zeros(shape, dtype=jnp.int8),
+            "v": jnp.zeros(shape, dtype=jnp.int8),
+            "ks": jnp.zeros(shape[:-1], dtype=jnp.float32),
+            "vs": jnp.zeros(shape[:-1], dtype=jnp.float32),
+        }
     return {"k": jnp.zeros(shape, dtype=dtype), "v": jnp.zeros(shape, dtype=dtype)}
 
 
@@ -98,10 +110,14 @@ def paged_decode_attention_reference(
     v_pages: jax.Array,
     block_tables: jax.Array,  # [S, max_pages]
     seq_lens: jax.Array,  # [S] — valid tokens per slot (incl. the new one)
+    k_scales: Optional[jax.Array] = None,  # [num_pages, P, H_kv] (int8 pools)
+    v_scales: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact paged attention by materializing each slot's pages (gather).
     O(S * max_pages * P) HBM traffic + a gathered copy — the thing the
-    Pallas kernel avoids.
+    Pallas kernel avoids. With ``k_scales``/``v_scales`` the pools are
+    int8 and dequantization happens AFTER the gather (only each slot's
+    gathered rows ever exist in float; the pool stays int8).
 
     The (page, offset) axes stay UNMERGED through the whole reduction:
     under context-parallel serving the pools' within-page dim carries the
@@ -114,6 +130,9 @@ def paged_decode_attention_reference(
     max_pages = block_tables.shape[1]
     k = k_pages[block_tables]  # [S, M, P, H_kv, d]
     v = v_pages[block_tables]
+    if k_scales is not None:
+        k = k.astype(jnp.float32) * k_scales[block_tables][..., None]
+        v = v.astype(jnp.float32) * v_scales[block_tables][..., None]
     r = H // H_kv
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
@@ -137,11 +156,16 @@ def paged_decode_attention_reference_cache_plus_new(
     seq_lens: jax.Array,  # [S] — tokens valid in the pages (excl. new)
     k_new: jax.Array,  # [S, H_kv, d]
     v_new: jax.Array,
+    k_scales: Optional[jax.Array] = None,  # [num_pages, P, H_kv] (int8 pools)
+    v_scales: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Exact reference for the read-only-pages + self-term decode form (the
     hot-loop shape: pages stay a read-only operand, the new token attends
     via an explicit term, writes happen once per step outside the layer
-    scan — see models/llama.py decode_step_paged).
+    scan — see models/llama.py decode_step_paged). With scales, the int8
+    pools dequantize after the gather (see
+    :func:`paged_decode_attention_reference`); the NEW token's k/v stay
+    exact — they are quantized only at the post-scan commit.
 
     (page, offset) axes stay unmerged — see
     :func:`paged_decode_attention_reference` for why (sp sharding)."""
@@ -151,6 +175,9 @@ def paged_decode_attention_reference_cache_plus_new(
     r = H // H_kv
     k = k_pages[block_tables]  # [S, M, P, H_kv, d]
     v = v_pages[block_tables]
+    if k_scales is not None:
+        k = k.astype(jnp.float32) * k_scales[block_tables][..., None]
+        v = v.astype(jnp.float32) * v_scales[block_tables][..., None]
     scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
     q4 = q.reshape(S, H_kv, r, d).astype(jnp.float32)
     logits = jnp.einsum("skrd,smpkd->smpkr", q4, k.astype(jnp.float32)) * scale
@@ -178,9 +205,19 @@ class PageAllocator:
     Refcounts enable zero-copy prefix sharing: a cached prompt prefix keeps
     a reference on its (full, immutable) pages, and every sequence whose
     block table borrows them takes another — a page returns to the pool
-    only when its last reference drops."""
+    only when its last reference drops.
 
-    def __init__(self, num_pages: int):
+    With ``track_scales`` (quantized KV pools) the allocator additionally
+    mirrors per-page SCALE-ROW ownership: a quantized page's f32 scale rows
+    live in page-shaped twin arrays indexed by the same page id, so every
+    allocated page must own exactly one set of scale rows and a freed page
+    must relinquish them. The set is maintained incrementally (alloc adds,
+    last-ref free removes) precisely so the invariant checker can cross-
+    check it against the refcount truth — a future alloc/free path that
+    forgets the scale side shows up as a scale-row leak instead of serving
+    garbage dequantization."""
+
+    def __init__(self, num_pages: int, track_scales: bool = False):
         self.num_pages = num_pages
         self._free = list(range(num_pages - 1, 0, -1))  # pop() yields 1,2,...
         self._refs: dict[int, int] = {}
@@ -188,10 +225,17 @@ class PageAllocator:
         # prefix-cache references), maintained incrementally so readers get
         # an atomic int instead of scanning the refcount dict
         self._shared = 0
+        # quantized-page scale-row ownership (None = untracked bf16 pools)
+        self._scale_pages: Optional[set[int]] = set() if track_scales else None
 
     @property
     def free_count(self) -> int:
         return len(self._free)
+
+    @property
+    def allocated_count(self) -> int:
+        """Pages currently referenced (atomic len read, like free_count)."""
+        return len(self._refs)
 
     @property
     def shared_count(self) -> int:
@@ -209,12 +253,21 @@ class PageAllocator:
         aliasing allocator internals."""
         return list(self._free), dict(self._refs)
 
+    def scale_audit(self) -> Optional[set[int]]:
+        """Snapshot the quantized-page scale-row ownership set (None when
+        the pools are bf16 and scales aren't tracked). A copy, like
+        :meth:`audit` — conservation demands it equal the allocated-page
+        set exactly (see engine/invariants.py)."""
+        return None if self._scale_pages is None else set(self._scale_pages)
+
     def alloc(self, n: int) -> list[int]:
         if n > len(self._free):
             raise MemoryError(f"out of KV pages: need {n}, have {len(self._free)}")
         pages = [self._free.pop() for _ in range(n)]
         for p in pages:
             self._refs[p] = 1
+        if self._scale_pages is not None:
+            self._scale_pages.update(pages)
         return pages
 
     def share(self, pages: list[int]) -> None:
@@ -239,6 +292,11 @@ class PageAllocator:
             if left <= 0:
                 del self._refs[p]
                 self._free.append(p)
+                if self._scale_pages is not None:
+                    # the page's scale rows return with it (stale values
+                    # remain in the twin arrays but are never read: block
+                    # tables only reference owned pages)
+                    self._scale_pages.discard(p)
             else:
                 self._refs[p] = left
 
@@ -253,12 +311,19 @@ class HostKVEntry:
     ``[0, cut)`` of a request's prefill row), so an entry can be matched
     either by the rid it was swapped under (preempt -> resume) or by token
     -prefix equality (park expiry / mid-prefill deadline -> a later request
-    re-sending the same conversation or persona prompt)."""
+    re-sending the same conversation or persona prompt).
+
+    Quantized-KV engines swap the int8 bytes VERBATIM plus their per-row
+    scale rows (``k_scale``/``v_scale``, [L, cut, H_kv] f32) — the host
+    tier holds ~2x the tokens per byte, and a restore is bit-exact by
+    construction (no requantization round trip)."""
 
     rid: str
     tokens: tuple
-    k: np.ndarray  # [L, cut, H_kv, d]
+    k: np.ndarray  # [L, cut, H_kv, d] (bf16, or int8 with scales below)
     v: np.ndarray
+    k_scale: Optional[np.ndarray] = None  # [L, cut, H_kv] f32
+    v_scale: Optional[np.ndarray] = None
 
     @property
     def cut(self) -> int:
@@ -266,7 +331,10 @@ class HostKVEntry:
 
     @property
     def nbytes(self) -> int:
-        return int(self.k.nbytes) + int(self.v.nbytes)
+        n = int(self.k.nbytes) + int(self.v.nbytes)
+        if self.k_scale is not None:
+            n += int(self.k_scale.nbytes) + int(self.v_scale.nbytes)
+        return n
 
 
 class HostKVPool:

@@ -1,14 +1,30 @@
-"""Weight-only int8 quantization for serving.
+"""int8 quantization for serving: weights and the paged/slot KV cache.
 
-Symmetric per-output-channel int8: ``W[in, out] -> (q int8, scale[out]
-f32/2)``, dequantized on the fly inside the matmul — on TPU, XLA fuses the
-int8->bf16 convert and the per-channel scale into the operand load of the
-MXU matmul, so the HBM read is half the bf16 bytes (the decode loop is
-weight-bandwidth-bound, so this is ~2x decode headroom and lets Llama-3-8B
-weights (~8GB int8) fit a single 16GB v5e chip).
+**Weights** — symmetric per-output-channel int8: ``W[in, out] -> (q int8,
+scale[out] f32/2)``, dequantized on the fly inside the matmul. Because the
+scale is per OUTPUT channel it commutes with the contraction —
+``x @ (q * s) == (x @ q) * s`` — so :func:`matmul` applies it AFTER the
+int8 matmul and never materializes a dequantized weight matrix; under jit
+the int8->compute-dtype convert fuses into the MXU operand load, so the
+HBM read is half the bf16 bytes (the decode loop is weight-bandwidth-
+bound: ~2x decode headroom, and Llama-3-8B weights (~8GB int8) fit a
+single 16GB v5e chip).
 
-Activations stay bf16 (weight-only), so accuracy loss is the usual
-negligible per-channel-int8 delta.
+**KV cache** — symmetric per-row-per-head int8: a K or V row
+``[..., H_kv, d]`` quantizes over its head_dim to ``(q int8 [..., H_kv, d],
+scale f32 [..., H_kv])``. Write paths quantize ON COMMIT (the one scatter
+per dispatch each model program already does) and attention dequantizes
+after the gather — only the gathered rows ever exist in compute dtype, the
+pool stays int8, so a fixed HBM page budget holds ~2x the tokens
+(`scale` adds 4/d ≈ 3% at d=128). Storage rides the page/slot layout
+itself (scales are pages-shaped arrays indexed by the same page ids /
+slot rows), so page ownership, host-tier swaps, and shared-prefix dedup
+carry the quantized bytes unchanged.
+
+Activations stay bf16 (weight-only), so weight accuracy loss is the usual
+negligible per-channel-int8 delta; KV quantization relaxes greedy byte-
+identity and is gated by the pinned accuracy fixture
+(``engine/accuracy.py``).
 """
 
 from __future__ import annotations
@@ -18,6 +34,12 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+
+# Scale floor for symmetric int8: an all-zero channel/row has absmax 0 and
+# would otherwise divide by zero (NaN scales that poison every later read).
+# Clamping the scale — not the absmax-derived quotient — keeps the
+# round-trip exact for zero inputs: q = round(0 / floor) = 0, dequant = 0.
+SCALE_FLOOR = 1e-8
 
 
 @jax.tree_util.register_pytree_node_class
@@ -47,12 +69,28 @@ class QuantizedTensor:
 
 def quantize(w: jax.Array, axis: int = -2) -> QuantizedTensor:
     """Per-output-channel symmetric int8 over the contraction axis
-    (``axis`` = the 'in' dimension being summed)."""
+    (``axis`` = the 'in' dimension being summed). All-zero channels get the
+    SCALE_FLOOR guard: they quantize to zeros and dequantize to exact
+    zeros instead of NaN."""
     wf = w.astype(jnp.float32)
     absmax = jnp.max(jnp.abs(wf), axis=axis, keepdims=True)
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    scale = jnp.maximum(absmax / 127.0, SCALE_FLOOR)
     q = jnp.clip(jnp.round(wf / scale), -127, 127).astype(jnp.int8)
     return QuantizedTensor(q=q, scale=scale)
+
+
+def quantize_np(w, axis: int = -2):
+    """Host-side (numpy) twin of :func:`quantize`, returning ``(q, scale)``
+    numpy arrays. Load-time weight quantization (engine/weights.py) must
+    agree bit-for-bit with device-side quantization, so the formula lives
+    here once beside SCALE_FLOOR rather than re-derived per call site."""
+    import numpy as np
+
+    wf = np.asarray(w, dtype=np.float32)
+    absmax = np.max(np.abs(wf), axis=axis, keepdims=True)
+    scale = np.maximum(absmax / 127.0, np.float32(SCALE_FLOOR))
+    q = np.clip(np.round(wf / scale), -127, 127).astype(np.int8)
+    return q, scale
 
 
 def dequantize(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
@@ -60,9 +98,19 @@ def dequantize(t: QuantizedTensor, dtype=jnp.bfloat16) -> jax.Array:
 
 
 def matmul(x: jax.Array, w: "jax.Array | QuantizedTensor") -> jax.Array:
-    """x @ w with transparent dequantization (fused by XLA on TPU)."""
+    """``x @ w`` with transparent int8 weights.
+
+    The quantized form computes ``(x @ q) * scale`` — valid because the
+    per-output-channel scale broadcasts over the contracted dim — so no
+    dequantized copy of ``w`` is ever materialized: under jit the int8
+    operand feeds the matmul directly (convert fused into the operand
+    load) and the scale is one cheap [out]-wide multiply on the result."""
     if isinstance(w, QuantizedTensor):
-        return x @ dequantize(w, x.dtype)
+        # scale is [..., 1, out]: squeezing the kept contraction axis makes
+        # it broadcast over the result's row dims regardless of x's rank
+        return (x @ w.q.astype(x.dtype)) * jnp.squeeze(w.scale, axis=-2).astype(
+            x.dtype
+        )
     return x @ w
 
 
@@ -80,3 +128,24 @@ def quantize_params(params: dict) -> dict:
     return out
 
 
+# ---------------------------------------------------------------------------
+# KV-cache quantization (per-row-per-head; see module docstring)
+# ---------------------------------------------------------------------------
+
+
+def kv_quantize(x: jax.Array) -> tuple[jax.Array, jax.Array]:
+    """Quantize K or V rows ``[..., H_kv, d]`` over head_dim ->
+    ``(q int8 [..., H_kv, d], scale f32 [..., H_kv])``. All-zero rows
+    (never-written cache, padding lanes) take the SCALE_FLOOR guard and
+    round-trip to exact zeros."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.maximum(absmax / 127.0, SCALE_FLOOR)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def kv_dequantize(q: jax.Array, scale: jax.Array, dtype: Any = jnp.bfloat16) -> jax.Array:
+    """Inverse of :func:`kv_quantize`: ``q [..., H_kv, d]`` x
+    ``scale [..., H_kv]`` -> values in ``dtype``."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)

@@ -158,28 +158,36 @@ def param_shardings(
     )
 
 
-def kv_cache_specs(mesh: Mesh | None = None) -> dict:
+def kv_cache_specs(mesh: Mesh | None = None, quantized: bool = False) -> dict:
     """Slot cache [L, S, C, H_kv, d]: KV heads shard over tp; on a mesh
     with an 'sp' axis (>1) the ctx dim C additionally shards over sp —
     context-parallel serving. No model-code change is needed: the decode
     and prefill softmax reductions over the sharded C compile to partial
     reductions + [S, H_kv]-sized all-reduces (the online-softmax merge),
-    and the per-token scatter commits land on the owning shard."""
+    and the per-token scatter commits land on the owning shard.
+
+    ``quantized`` adds the int8 cache's per-row scale twins
+    ("ks"/"vs", [L, S, C, H_kv]) — the value spec minus head_dim, so
+    scales land on exactly the shard that owns their rows."""
     seq = (
         "sp"
         if mesh is not None and "sp" in mesh.axis_names and mesh.shape["sp"] > 1
         else None
     )
-    return {
+    specs = {
         "k": P(None, None, seq, "tp", None),
         "v": P(None, None, seq, "tp", None),
     }
+    if quantized:
+        specs["ks"] = P(None, None, seq, "tp")
+        specs["vs"] = P(None, None, seq, "tp")
+    return specs
 
 
-def kv_cache_shardings(mesh: Mesh) -> dict:
+def kv_cache_shardings(mesh: Mesh, quantized: bool = False) -> dict:
     return jax.tree_util.tree_map(
         lambda spec: NamedSharding(mesh, spec),
-        kv_cache_specs(mesh),
+        kv_cache_specs(mesh, quantized),
         is_leaf=lambda x: isinstance(x, P),
     )
 

@@ -66,7 +66,12 @@ ACP_BENCH_MEM=1 / ACP_BENCH_MEM_PROMPT / ACP_BENCH_MEM_TASKS /
 ACP_BENCH_MEM_PERSONA / ACP_BENCH_MEM_HOST_BYTES (KV memory-tier
 fixture: preempt->resume swap-in vs recompute-prefill latency, and
 effective concurrent slots with shared-prefix dedup on/off at a fixed
-page budget — emitted as the doc's additive ``mem`` block).
+page budget — emitted as the doc's additive ``mem`` block),
+ACP_BENCH_QUANT=1 / ACP_BENCH_QUANT_PROMPT / ACP_BENCH_QUANT_TASKS /
+ACP_BENCH_QUANT_BASE_TASKS (quantized-serving fixture: effective
+concurrent slots bf16 vs int8 KV at a fixed HBM byte budget, bar >=
+1.5x, plus the byte-identity-relaxed accuracy-gate numbers — emitted as
+the doc's additive ``quant`` block).
 
 ``ACP_INVARIANTS=1`` additionally arms the engine's runtime invariant
 checker (engine/invariants.py) for every bench engine — per-dispatch state
@@ -515,6 +520,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["hol"] = val
             elif key == "mem" and "mem" not in doc:
                 doc["mem"] = val
+            elif key == "quant" and "quant" not in doc:
+                doc["quant"] = val
             elif key == "flight" and "flight" not in doc:
                 doc["flight"] = val
             elif key == "prof" and "prof" not in doc:
@@ -537,6 +544,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT hol", 900))
     if os.environ.get("ACP_BENCH_MEM", "0") == "1":
         main_schedule.append(("RESULT mem", 900))
+    if os.environ.get("ACP_BENCH_QUANT", "0") == "1":
+        main_schedule.append(("RESULT quant", 900))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
     if os.environ.get("ACP_BENCH_PROF", "0") == "1":
@@ -954,6 +963,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("mem", _bench_mem())
         except Exception as e:  # the fixture must not lose the headline
             _result("mem", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_QUANT", "0") == "1"
+    ):
+        try:
+            _result("quant", _bench_quant())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("quant", {"error": str(e)})
 
     if (
         not args.only_ttft
@@ -1636,6 +1654,159 @@ def _bench_mem() -> dict:
             f"{slots_off} -> {slots_on} concurrent slots "
             f"({ratio}x); byte-identical="
             f"{swap_identical and dedup_identical}"
+        ),
+    }
+
+
+def _bench_quant() -> dict:
+    """Quantized-serving fixture (ACP_BENCH_QUANT=1) — the capacity
+    multiplier ISSUE 14 ships plus its accuracy price, recorded together:
+
+    (a) **concurrent slots at a fixed HBM byte budget**: the SAME budget
+    B is spent two ways — a bf16 KV pool of B / bf16_page_bytes pages, or
+    an int8+scales pool of B / int8_page_bytes pages (~1.6x at tiny's
+    head_dim 16; ~1.9x at production d=128). A burst of independent
+    same-length tasks is driven through each engine and the peak
+    concurrently-admitted slots measured; the bar is >= 1.5x (the
+    acceptance criterion). Dedup/prefix caching are disabled so the
+    multiplier is quantization's alone.
+
+    (b) **the accuracy gate**: top-1 greedy agreement + logit MAE vs the
+    bf16 path over the pinned fixture (engine/accuracy.py), for
+    weights-only / kv-only / both, evaluated against the same pinned
+    thresholds the test suite enforces — the bench doc records the
+    numbers so the accuracy trajectory is inspectable next to the
+    capacity it buys.
+
+    Knobs: ACP_BENCH_QUANT_PROMPT (default 240), ACP_BENCH_QUANT_TASKS
+    (12), ACP_BENCH_QUANT_BASE_TASKS (6, sizes the bf16 pool)."""
+    import dataclasses
+
+    import jax as _jax
+
+    from agentcontrolplane_tpu.engine.accuracy import (
+        accuracy_report,
+        check_accuracy_gate,
+        pinned_fixture,
+        teacher_forced_logits,
+    )
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS, init_params
+
+    MIN_TOP1, MAX_MAE = 0.92, 0.05  # pinned with tests/engine/test_quant_kv.py
+    plen = int(os.environ.get("ACP_BENCH_QUANT_PROMPT", "240"))
+    n_tasks = int(os.environ.get("ACP_BENCH_QUANT_TASKS", "12"))
+    base_tasks = int(os.environ.get("ACP_BENCH_QUANT_BASE_TASKS", "6"))
+    page = 16
+    max_tokens = 16
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+    cfg = dataclasses.replace(PRESETS["tiny"], max_seq_len=1024, vocab_size=512)
+
+    # the fixed budget, in BYTES of KV pool: page bytes are computed for a
+    # bf16 baseline (2 bytes/elem) vs int8+per-row-f32-scales, so the
+    # multiplier reflects production serving even though the tiny CPU
+    # config computes in f32 (the serving dtype never changes how many
+    # pages a page-count-limited pool admits)
+    elems = cfg.n_layers * page * cfg.n_kv_heads  # per page, per k/v side
+    bf16_page_bytes = elems * cfg.head_dim * 2 * 2
+    int8_page_bytes = elems * (cfg.head_dim + 4) * 2
+    task_pages = -(-(plen + max_tokens) // page) + 1
+    pages_bf16 = base_tasks * task_pages + 2
+    budget_bytes = pages_bf16 * bf16_page_bytes
+    pages_int8 = budget_bytes // int8_page_bytes
+
+    from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+    def burst_leg(quantize_kv: bool, kv_pages: int) -> tuple[dict, int]:
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            # tp=1 explicitly: the fixture measures pool capacity, not
+            # sharding, and must not depend on the host's device count
+            mesh=make_mesh({"tp": 1}, devices=_jax.devices()[:1]),
+            max_slots=n_tasks,
+            max_ctx=512,
+            prefill_buckets=(64, 256),
+            decode_block_size=4,
+            kv_layout="paged",
+            page_size=page,
+            kv_pages=kv_pages + 1,  # + the trash page
+            page_lookahead_blocks=1,
+            prefix_cache_entries=0,
+            prefix_dedup=False,
+            quantize_kv=quantize_kv,
+            check_invariants=armed,
+        )
+        eng.start()
+        try:
+            sp = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+            prompts = [
+                [1 + ((i * 7 + j) % 250) for j in range(plen)]
+                for i in range(n_tasks)
+            ]
+            eng.generate(list(prompts[0]), sp)  # warm every shape
+            peak = [0]
+
+            def on_tokens(_t):
+                s = eng.stats()
+                peak[0] = max(peak[0], s["active_slots"] + s["prefilling_slots"])
+
+            with eng.hold_admission():
+                futs = [
+                    eng.submit(list(p), sp, on_tokens=on_tokens)
+                    for p in prompts
+                ]
+            toks = {i: f.result(timeout=1800).tokens for i, f in enumerate(futs)}
+            return toks, peak[0]
+        finally:
+            eng.stop()
+
+    _, slots_bf16 = burst_leg(False, pages_bf16)
+    toks_a, slots_int8 = burst_leg(True, pages_int8)
+    toks_b, _ = burst_leg(True, pages_int8)
+    ratio = round(slots_int8 / slots_bf16, 2) if slots_bf16 else 0.0
+
+    # (b) the accuracy gate, scored through the real serving numerics;
+    # the bf16 baseline pass is shared across the three configurations
+    params = init_params(PRESETS["tiny"], _jax.random.key(0))
+    rows = pinned_fixture(PRESETS["tiny"].vocab_size)
+    base_logits = teacher_forced_logits(params, PRESETS["tiny"], rows)
+    gate: dict = {"min_top1": MIN_TOP1, "max_logit_mae": MAX_MAE}
+    ok = True
+    for name, (qw, qkv) in {
+        "weights": (True, False), "kv": (False, True), "both": (True, True),
+    }.items():
+        rep = accuracy_report(
+            PRESETS["tiny"], params, quantize_weights=qw, quantize_kv=qkv,
+            rows=rows, baseline=base_logits,
+        )
+        rep["violations"] = check_accuracy_gate(rep, MIN_TOP1, MAX_MAE)
+        ok = ok and not rep["violations"]
+        gate[name] = rep
+
+    return {
+        "prompt_tokens": plen,
+        "tasks": n_tasks,
+        "page_budget_bytes": budget_bytes,
+        "pages_bf16": pages_bf16,
+        "pages_int8": pages_int8,
+        "effective_slots_bf16": slots_bf16,
+        "effective_slots_int8": slots_int8,
+        "slot_capacity_x": ratio,
+        "bar_x": 1.5,
+        "capacity_bar_met": ratio >= 1.5,
+        "deterministic": toks_a == toks_b,
+        "accuracy_gate": gate,
+        "accuracy_gate_passed": ok,
+        "note": (
+            f"{n_tasks} tasks x {plen}-token prompts at a fixed "
+            f"{budget_bytes >> 10}KiB KV budget: bf16 {pages_bf16} pages -> "
+            f"{slots_bf16} concurrent slots, int8 {pages_int8} pages -> "
+            f"{slots_int8} slots ({ratio}x, bar 1.5x); accuracy gate "
+            f"kv top-1 {gate['kv']['top1_agreement']}, both "
+            f"{gate['both']['top1_agreement']} (min {MIN_TOP1}), "
+            f"passed={ok}"
         ),
     }
 

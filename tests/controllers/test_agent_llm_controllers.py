@@ -246,6 +246,9 @@ async def test_llm_controller_tpu_mesh_mismatch_is_invalid(store):
     from agentcontrolplane_tpu.api.resources import TPUProviderConfig
 
     class FakeEngine:
+        quantize = None
+        quantize_kv = False
+
         class mesh:
             shape = {"sp": 1, "tp": 2}
 
@@ -281,3 +284,63 @@ async def test_llm_controller_tpu_mesh_mismatch_is_invalid(store):
     await rec.reconcile(("LLM", "default", "tpu-ok"))
     llm = store.get("LLM", "tpu-ok")
     assert llm.status.status_detail == "" or "Parallelism" not in llm.status.status_detail
+
+
+async def test_llm_controller_tpu_quantize_mismatch_is_invalid(store):
+    """quantizeWeights/quantizeKv are the same declarative-intent contract
+    as the parallelism fields: a spec requesting quantized serving from a
+    bf16 engine must fail validation, not silently serve unquantized."""
+    from agentcontrolplane_tpu.api.resources import TPUProviderConfig
+
+    class Bf16Engine:
+        quantize = None
+        quantize_kv = False
+
+        class mesh:
+            shape = {"tp": 1}
+
+    class QuantEngine(Bf16Engine):
+        quantize = "int8"
+        quantize_kv = True
+
+    class Factory:
+        def __init__(self, engine):
+            self.engine = engine
+
+    rec = LLMReconciler(store, EventRecorder(store), Factory(Bf16Engine()), probe=False)
+    for name, cfg in (
+        ("q-weights", TPUProviderConfig(preset="bench-1b", quantize_weights=True)),
+        ("q-legacy", TPUProviderConfig(preset="bench-1b", quantization="int8")),
+        ("q-kv", TPUProviderConfig(preset="bench-1b", quantize_kv=True)),
+    ):
+        store.create(
+            LLM(
+                metadata=ObjectMeta(name=name),
+                spec=LLMSpec(
+                    provider="tpu",
+                    parameters=BaseConfig(model="bench-1b"),
+                    tpu=cfg,
+                ),
+            )
+        )
+        await rec.reconcile(("LLM", "default", name))
+        llm = store.get("LLM", name)
+        assert not llm.status.ready
+        assert "quantize" in llm.status.status_detail.lower()
+
+    rec_q = LLMReconciler(store, EventRecorder(store), Factory(QuantEngine()), probe=False)
+    store.create(
+        LLM(
+            metadata=ObjectMeta(name="q-ok"),
+            spec=LLMSpec(
+                provider="tpu",
+                parameters=BaseConfig(model="bench-1b"),
+                tpu=TPUProviderConfig(
+                    preset="bench-1b", quantize_weights=True, quantize_kv=True
+                ),
+            ),
+        )
+    )
+    await rec_q.reconcile(("LLM", "default", "q-ok"))
+    llm = store.get("LLM", "q-ok")
+    assert "quantize" not in llm.status.status_detail.lower()

@@ -12,7 +12,11 @@ bug class into a healthy engine's state and asserts the audit catches it —
   pages an in-flight dispatch was granted);
 - **parked-KV coverage break** (the PR 7 garbage-lane class in its
   host-observable form: a parked slot no longer holding exactly its
-  prompt-covering pages means adoption would resume over corrupt KV).
+  prompt-covering pages means adoption would resume over corrupt KV);
+- **quantized scale-row corruption** (ISSUE 14: int8 KV pages whose
+  per-page scale ownership leaks past a free, vanishes under a live
+  allocation, or shears off the cache structurally — each means later
+  reads dequantize through wrong/unowned scale storage).
 
 Every corruption is reverted so the module-scoped engine stays healthy
 between tests; the audit itself is read-only.
@@ -231,6 +235,70 @@ def test_host_resident_page_leak_is_detected():
         assert verify_engine(e) == []
     finally:
         e.stop()
+
+
+def test_quantized_scale_row_corruption_classes_are_detected():
+    """The quantized-page accounting class (ISSUE 14): an engine serving
+    int8 KV must own exactly one set of scale rows per allocated page.
+    Both corruption directions — a scale row leaking past its page's
+    free, and an allocated page whose scale ownership vanished — plus the
+    structural cache coupling (scale twins sheared off, scale storage on
+    a knobs-off engine) must all trip the audit."""
+    e = make_engine(quantize_kv=True)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=6)
+        assert e.generate("warm quantized pages", sp).finish_reason in (
+            "stop", "length",
+        )
+        _settle(e)
+        assert verify_engine(e) == []
+
+        scale_pages = e._allocator._scale_pages
+        # direction 1: scale rows owned for a page that was freed
+        stale = max(set(range(1, e.num_pages)) - set(e._allocator._refs))
+        scale_pages.add(stale)
+        try:
+            problems = verify_engine(e)
+        finally:
+            scale_pages.discard(stale)
+        assert any("scale-row leak" in p for p in problems)
+
+        # direction 2: an allocated page without owned scale rows — seed a
+        # live allocation first (the idle engine may hold none)
+        pages = e._allocator.alloc(1)
+        try:
+            scale_pages.discard(pages[0])
+            problems = verify_engine(e)
+            scale_pages.add(pages[0])
+        finally:
+            e._allocator.free(pages)
+        assert any("without owned scale rows" in p for p in problems)
+
+        # structural coupling: scale twin sheared off its values
+        ks = e.cache.pop("ks")
+        try:
+            problems = verify_engine(e)
+        finally:
+            e.cache["ks"] = ks
+        assert any("cache carries keys" in p for p in problems)
+        assert verify_engine(e) == []
+    finally:
+        e.stop()
+
+
+def test_off_knob_engine_with_scale_storage_is_detected(eng):
+    """The purity direction: a knobs-off engine carrying scale storage is
+    itself a violation (the bit-identical plain path must have none)."""
+    _settle(eng)
+    import jax.numpy as jnp
+
+    eng.cache["ks"] = jnp.zeros((1,), dtype=jnp.float32)
+    try:
+        problems = verify_engine(eng)
+    finally:
+        del eng.cache["ks"]
+    assert any("quantize_kv off" in p for p in problems)
+    assert verify_engine(eng) == []
 
 
 def test_shared_page_refcount_drift_is_detected(eng):

@@ -34,6 +34,44 @@ def test_quantize_roundtrip_error_bounded():
     assert err.max() <= float(np.asarray(qt.scale).max()) * 0.51
 
 
+def test_quantize_all_zero_channel_takes_scale_floor():
+    """Division-by-zero guard: an all-zero output channel has absmax 0 —
+    the scale clamps to SCALE_FLOOR so the channel quantizes to zeros and
+    dequantizes to EXACT zeros (finite everywhere, no NaN poisoning the
+    whole matmul)."""
+    from agentcontrolplane_tpu.ops.quant import SCALE_FLOOR
+
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(16, 8)).astype(np.float32)
+    w[:, 2] = 0.0  # one dead channel
+    qt = quantize(jnp.asarray(w))
+    scales = np.asarray(qt.scale)[0]
+    assert scales[2] == SCALE_FLOOR and np.all(np.isfinite(scales))
+    deq = np.asarray(dequantize(qt, jnp.float32))
+    assert np.all(np.isfinite(deq))
+    assert np.all(deq[:, 2] == 0.0)
+    # the dead channel contributes exact zeros through the fused matmul too
+    out = np.asarray(matmul(jnp.ones((1, 16), jnp.float32), qt))
+    assert np.all(np.isfinite(out)) and out[0, 2] == 0.0
+
+
+def test_matmul_stays_fused_no_dequantized_operand():
+    """The fused form ``(x @ q) * scale``: the compiled HLO must contain
+    no weight-shaped MULTIPLY — the scale is applied to the [rows, out]
+    RESULT, never to a materialized [in, out] dequantized matrix (the
+    int8 operand feeds the dot through a bare convert, which TPU folds
+    into the MXU operand load)."""
+    rng = np.random.default_rng(4)
+    w = quantize(jnp.asarray(rng.normal(size=(256, 64)), dtype=jnp.float32))
+    x = jnp.asarray(rng.normal(size=(2, 256)), dtype=jnp.float32)
+    hlo = jax.jit(matmul).lower(x, w).compile().as_text()
+    weight_shaped_multiplies = [
+        line for line in hlo.splitlines()
+        if "multiply" in line and "[256,64]" in line
+    ]
+    assert not weight_shaped_multiplies, weight_shaped_multiplies
+
+
 def test_matmul_quant_close_to_dense():
     rng = np.random.default_rng(1)
     x = jnp.asarray(rng.normal(size=(4, 64)), dtype=jnp.float32)
