@@ -57,8 +57,19 @@ lint:
 		$(PY) -m compileall -q agentcontrolplane_tpu tests bench.py; \
 	fi
 
+# pinned gates: ACP_LINT_SUPPRESSIONS is the live '# acp-lint: disable='
+# count (growth fails with the justification list — raise it only in the
+# PR that adds the pragma); ACP_LINT_BUDGET_S bounds the whole pass pack's
+# wall time on a bare checkout so a rule can't silently become the slow
+# CI step (current full run ~4s; 30s leaves cold-cache headroom).
+ACP_LINT_SUPPRESSIONS ?= 4
+ACP_LINT_BUDGET_S ?= 30
+
 lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness contracts
 	$(PY) -m agentcontrolplane_tpu.analysis --metrics-docs docs/observability.md \
+		--timing --timing-budget $(ACP_LINT_BUDGET_S) \
+		--suppression-budget $(ACP_LINT_SUPPRESSIONS) \
+		--json acplint-findings.json \
 		agentcontrolplane_tpu tests bench.py
 	-$(PY) -m agentcontrolplane_tpu.analysis --bench-trend .  # advisory: perf-trajectory sentinel
 

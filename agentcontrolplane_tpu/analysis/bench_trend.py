@@ -108,6 +108,16 @@ METRICS: tuple[MetricSpec, ...] = (
         ("quant", "accuracy_gate", "both", "logit_mae"),
         "lower", rel_tol=1.0, max_abs=0.05,
     ),
+    # acplint (PR 15): the pass-pack size should only grow (a dropped rule
+    # is a deliberate act — tight tolerance so any shrink trips the
+    # advisory), and suppression debt should trend down (the hard gate is
+    # --suppression-budget in make lint-acp; this series just keeps the
+    # trajectory visible in the trend table).
+    MetricSpec("lint_rules_total", ("lint", "rules_total"), "higher", 0.05),
+    MetricSpec(
+        "suppressions_total", ("lint", "suppressions_total"), "lower",
+        rel_tol=0.5,
+    ),
 )
 
 

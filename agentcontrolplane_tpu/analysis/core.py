@@ -1,4 +1,4 @@
-"""acplint core: source loading, marker/pragma parsing, the pass protocol.
+"""acplint core: source loading, markers, and the flow-sensitive framework.
 
 The pass pack (``analysis/passes/``) encodes this repo's load-bearing
 correctness contracts as machine-checked rules — each one extracted from a
@@ -6,8 +6,24 @@ real shipped bug (see docs/debugging-guide.md "Static analysis & invariant
 mode" for the catalogue). This module is deliberately **stdlib-only** (ast +
 tokenize): the lint must run in a bare CI checkout with no jax installed.
 
+v1 was a marker/pragma layer over per-function syntax walks. The PR 11–14
+review cycle kept catching *flow* bugs v1 structurally cannot see — a
+donated device buffer re-dispatched from a stale local, a scale twin
+dropped on one copy path, a future resolved before its flight record, a
+page sweep returning to idle without republishing mirrors. Those are
+def-use chains and statement orderings, so the core now also provides:
+
+- :class:`FlowGraph` — an intra-function control-flow graph at statement
+  granularity, with path/ordering queries ("is X reachable after Y",
+  "does some path from X to Y avoid every blocker Z");
+- :func:`taint_fixpoint` — the generic taint lattice over plain name
+  bindings (the fixpoint that was hand-rolled inside the coord-wallclock
+  pass, promoted so every pass shares one propagation semantics);
+- class/method registry helpers (:func:`iter_classes`, :func:`methods_of`,
+  :func:`marked_methods`) so passes stop re-deriving seam sets by hand.
+
 Declarations ride in comments so the contract lives next to the code it
-covers:
+covers (several markers may share one line):
 
 - ``# acp: mirror`` — on an attribute assignment: this attribute is a
   cross-thread-readable mirror (plain int/tuple replaced atomically, or an
@@ -23,11 +39,24 @@ covers:
   explicit-default constructor (``np.zeros``/``np.ones``/``np.full``).
 - ``# acp: budget-seam`` — on a ``def``: token-budget arithmetic is allowed
   here (and nowhere else in the class).
+- ``# acp: megastep-seam`` — on a ``def``: compiled-program (``_jit_*``)
+  access is allowed here (and nowhere else in the class).
+- ``# acp: donated`` — on an attribute assignment: dispatches consume
+  (donate) this buffer; a stale local capture of it must not flow into a
+  later dispatch (the donated-after-dispatch pass).
+- ``# acp: kv-seam`` — on a ``def``: this function extracts/copies/swaps
+  KV cache leaves and must handle them generically (scale twins ``ks``/
+  ``vs`` ride every path a literal ``"k"``/``"v"`` takes).
+- ``# acp: idle-loop`` — on a ``def``: this is the engine's wait-for-work
+  loop; memory-tier mutations inside it must republish the memory mirrors
+  before the loop can return to idle.
 
 Suppression: a trailing ``# acp-lint: disable=<rule>[,<rule>...]`` on the
 flagged line silences that rule there. Every suppression should carry a
 justifying comment — the pragma is an auditable claim that the rule's
-assumption doesn't apply, not an escape hatch.
+assumption doesn't apply, not an escape hatch — and the in-tree count is a
+pinned budget (``--suppression-budget``): growth fails CI with the full
+justification list printed.
 """
 
 from __future__ import annotations
@@ -35,12 +64,15 @@ from __future__ import annotations
 import ast
 import io
 import re
+import time
 import tokenize
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Iterable, Iterator, Optional
+from typing import Callable, Iterable, Iterator, Optional
 
-MARKER_RE = re.compile(r"#\s*acp:\s*([\w-]+)\s*(.*)$")
+# a comment line may carry several markers ("# acp: megastep-seam # acp:
+# kv-seam"): each marker's argument runs to the next '#' or end of line
+MARKER_RE = re.compile(r"#\s*acp:\s*([\w-]+)\s*([^#]*)")
 DISABLE_RE = re.compile(r"#\s*acp-lint:\s*disable=([\w,\s-]+)")
 
 
@@ -76,14 +108,14 @@ class SourceFile:
     # -- markers ---------------------------------------------------------
 
     def markers_on(self, first: int, last: Optional[int] = None) -> dict[str, str]:
-        """``{marker-name: arg-string}`` for comments on lines [first, last]."""
+        """``{marker-name: arg-string}`` for comments on lines [first, last].
+        One line may declare several markers."""
         out: dict[str, str] = {}
         for line in range(first, (last or first) + 1):
             comment = self.comments.get(line)
             if not comment:
                 continue
-            m = MARKER_RE.search(comment)
-            if m:
+            for m in MARKER_RE.finditer(comment):
                 out[m.group(1)] = m.group(2).strip()
         return out
 
@@ -132,17 +164,31 @@ class LintPass:
 # -- helpers shared by passes ------------------------------------------------
 
 
-def dotted_name(node: ast.AST) -> Optional[str]:
-    """'time.monotonic' for ``time.monotonic`` / 'np.random.rand' for the
-    chained form; None when the chain doesn't root in a plain Name."""
+def chain_parts(node: ast.AST) -> list[str]:
+    """The attribute chain as root-first parts — ``['self', '_allocator',
+    'free']`` for ``self._allocator.free``; the root is omitted when the
+    chain doesn't start at a plain Name (membership tests still work)."""
     parts: list[str] = []
     while isinstance(node, ast.Attribute):
         parts.append(node.attr)
         node = node.value
     if isinstance(node, ast.Name):
         parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
+    parts.reverse()
+    return parts
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'time.monotonic' for ``time.monotonic`` / 'np.random.rand' for the
+    chained form; None when the chain doesn't root in a plain Name."""
+    if not isinstance(node, (ast.Attribute, ast.Name)):
+        return None
+    root = node
+    while isinstance(root, ast.Attribute):
+        root = root.value
+    if not isinstance(root, ast.Name):
+        return None
+    return ".".join(chain_parts(node))
 
 
 def is_self_attr(node: ast.AST) -> Optional[str]:
@@ -154,6 +200,386 @@ def is_self_attr(node: ast.AST) -> Optional[str]:
     ):
         return node.attr
     return None
+
+
+def iter_classes(sf: "SourceFile") -> Iterator[ast.ClassDef]:
+    for node in ast.walk(sf.tree):
+        if isinstance(node, ast.ClassDef):
+            yield node
+
+
+def iter_functions(sf: "SourceFile") -> Iterator[ast.AST]:
+    """Every def in the module (top-level, methods, nested)."""
+    for node in ast.walk(sf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+def methods_of(cls: ast.ClassDef) -> list[ast.AST]:
+    """Direct ``def``s of a class body (the unit every class-scoped pass
+    iterates)."""
+    return [
+        n
+        for n in cls.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    ]
+
+
+def marked_methods(sf: "SourceFile", cls: ast.ClassDef, marker: str) -> set[str]:
+    """Names of the class's methods carrying ``# acp: <marker>`` — the seam
+    set every seam-scoped rule audits against."""
+    return {
+        m.name
+        for m in methods_of(cls)
+        if sf.func_marker(m, marker) is not None
+    }
+
+
+def transitive_methods(
+    cls: ast.ClassDef, seed: Callable[[ast.AST], bool]
+) -> set[str]:
+    """Method names satisfying ``seed``, closed transitively over
+    same-class ``self.<m>()`` calls — a method acquires the property by
+    calling one that has it (donated_dispatch: the fallback donates
+    because its chunk dispatch does; mirror_publish: the sweep mutates
+    because the release it calls frees pages)."""
+    methods = {m.name: m for m in methods_of(cls)}
+    out = {name for name, fn in methods.items() if seed(fn)}
+    grew = True
+    while grew:
+        grew = False
+        for name, fn in methods.items():
+            if name in out:
+                continue
+            if any(
+                isinstance(n, ast.Call)
+                and (m := is_self_attr(n.func)) is not None
+                and m in out
+                for n in ast.walk(fn)
+            ):
+                out.add(name)
+                grew = True
+    return out
+
+
+# -- def-use / taint (flow-insensitive name lattice) -------------------------
+
+
+def binding_names(target: ast.AST) -> Iterator[str]:
+    """Plain local names a target BINDS. ``obj.field = x`` stores into a
+    field — it does not make ``obj`` itself carry the value, so Attribute/
+    Subscript bases are deliberately excluded (tainting ``self`` would flag
+    every use in the method)."""
+    if isinstance(target, ast.Name):
+        yield target.id
+    elif isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            yield from binding_names(e)
+    elif isinstance(target, ast.Starred):
+        yield from binding_names(target.value)
+
+
+def taint_fixpoint(fn: ast.AST, seed: Callable[[ast.AST], bool]) -> set[str]:
+    """Local names carrying a value matched by ``seed``, propagated to a
+    FIXPOINT through plain name bindings: ``now = clock(); age = now - t0``
+    taints ``age`` too (single-hop propagation would let the derived value
+    evade a rule). Propagation runs through Assign / AnnAssign / NamedExpr /
+    AugAssign only — attribute and subscript stores never taint their base
+    (see :func:`binding_names`). This is the shared lattice every
+    taint-shaped pass builds on; ``seed(node) -> bool`` marks the base
+    sources (a clock call, a donated-buffer read, ...)."""
+    tainted: set[str] = set()
+
+    def carries(expr: ast.AST) -> bool:
+        return any(
+            seed(n) or (isinstance(n, ast.Name) and n.id in tainted)
+            for n in ast.walk(expr)
+        )
+
+    while True:
+        grew = False
+        for node in ast.walk(fn):
+            targets: list[ast.AST] = []
+            if isinstance(node, ast.Assign) and carries(node.value):
+                targets = list(node.targets)
+            elif (
+                isinstance(node, ast.AnnAssign)
+                and node.value is not None
+                and carries(node.value)
+            ):
+                targets = [node.target]
+            elif isinstance(node, ast.NamedExpr) and carries(node.value):
+                targets = [node.target]
+            elif isinstance(node, ast.AugAssign) and carries(node.value):
+                targets = [node.target]
+            for t in targets:
+                for name in binding_names(t):
+                    if name not in tainted:
+                        tainted.add(name)
+                        grew = True
+        if not grew:
+            break
+    return tainted
+
+
+# -- FlowGraph: statement-level CFG with ordering queries --------------------
+
+
+class FlowGraph:
+    """Intra-function control flow at STATEMENT granularity.
+
+    Nodes are the function's ``ast.stmt`` objects plus two sentinels:
+    :data:`EXIT` (normal return / falling off the end) and :data:`RAISE`
+    (an uncaught raise). Edges model sequencing, if/else, loop entry +
+    back edge + skip, break/continue, try/except/finally (coarsely: any
+    statement in a ``try`` body may jump to any of its handlers, and a
+    break/continue/return leaving a protected region routes through the
+    ``finally`` entry — exit kinds merge there), and ``match`` cases. The graph is an over-approximation by design — a
+    pass asks "CAN this ordering happen", never "must it".
+
+    The queries flow-sensitive rules compose from:
+
+    - :meth:`exists_path` — is there a path from ``src`` to ``dst`` that
+      avoids every node in ``avoiding``? (donated-after-dispatch: stale
+      use reachable from a donating dispatch avoiding every re-capture;
+      mirror-publish: loop back edge reachable from a page free avoiding
+      every mirror republish)
+    - :meth:`reachable_after` — can ``b`` execute after ``a``?
+      (resolve-after-record: a future resolution with no flight finish
+      able to precede it)
+    - :meth:`stmt_of` — the enclosing statement of any expression node
+      (how expression-level findings anchor into the graph). Bodies of
+      NESTED def/lambda statements are deliberately unowned: a closure's
+      statements are not control flow of the builder that defines it.
+    """
+
+    EXIT = "<exit>"
+    RAISE = "<raise>"
+
+    def __init__(self, fn: ast.AST):
+        self.fn = fn
+        self.succ: dict[object, set[object]] = {}
+        self.stmts: list[ast.stmt] = []
+        self.loop_of: dict[int, Optional[ast.stmt]] = {}  # id(stmt) -> While/For
+        self.entry = self._seq(
+            fn.body, self.EXIT, None, None, (self.RAISE,), None, self.EXIT
+        )
+        self._owner: dict[int, ast.stmt] = {}
+        for st in self.stmts:
+            for sub in self._shallow(st):
+                self._owner[id(sub)] = st
+
+    # -- construction ----------------------------------------------------
+
+    def _seq(self, body, follow, brk, cont, raise_to, loop, ret):
+        """Wire a statement list; returns its entry node (``follow`` when
+        empty). ``brk``/``cont`` are the innermost loop's break/continue
+        targets, ``raise_to`` the handler entries a raise can reach,
+        ``loop`` the innermost enclosing While/For, ``ret`` where a
+        ``return`` goes (EXIT, or the enclosing finally's entry)."""
+        entry = follow
+        for st in reversed(body):
+            entry = self._stmt(st, entry, brk, cont, raise_to, loop, ret)
+        return entry
+
+    def _stmt(self, st, follow, brk, cont, raise_to, loop, ret):
+        self.stmts.append(st)
+        self.loop_of[id(st)] = loop
+        if isinstance(st, ast.If):
+            self.succ[st] = {
+                self._seq(st.body, follow, brk, cont, raise_to, loop, ret),
+                self._seq(st.orelse, follow, brk, cont, raise_to, loop, ret),
+            }
+        elif isinstance(st, (ast.While, ast.For, ast.AsyncFor)):
+            skip = (
+                self._seq(st.orelse, follow, brk, cont, raise_to, loop, ret)
+                if st.orelse
+                else follow
+            )
+            body = self._seq(st.body, st, follow, st, raise_to, st, ret)
+            self.succ[st] = {body, skip}
+        elif isinstance(st, (ast.With, ast.AsyncWith)):
+            self.succ[st] = {
+                self._seq(st.body, follow, brk, cont, raise_to, loop, ret)
+            }
+        elif isinstance(st, ast.Try):
+            if st.finalbody:
+                fmark = len(self.stmts)
+                fin = self._seq(
+                    st.finalbody, follow, brk, cont, raise_to, loop, ret
+                )
+                # the finally's tail: statements that fall through to
+                # ``follow`` (where a deferred exit resumes its journey)
+                fin_tail = [
+                    s
+                    for s in self.stmts[fmark:]
+                    if follow in self.succ.get(s, ())
+                ]
+            else:
+                fin, fin_tail = follow, []
+            # a break/continue/return leaving the protected region runs the
+            # finally FIRST — route those exits through its entry; without
+            # a finalbody the targets pass through unchanged
+            brk_t, cont_t, ret_t = (
+                (fin, fin, fin) if st.finalbody else (brk, cont, ret)
+            )
+            rmark = len(self.stmts)
+            handlers = [
+                self._seq(h.body, fin, brk_t, cont_t, raise_to, loop, ret_t)
+                for h in st.handlers
+            ]
+            # a raise in the body reaches the handlers; an unmatched one
+            # still propagates (keep the outer targets too — coarse)
+            inner_raise = tuple(handlers) + tuple(raise_to)
+            after_body = (
+                self._seq(st.orelse, fin, brk_t, cont_t, raise_to, loop, ret_t)
+                if st.orelse
+                else fin
+            )
+            # mark AFTER the orelse is built: only try-BODY statements may
+            # raise into these handlers (the else block runs past them)
+            mark = len(self.stmts)
+            body = self._seq(
+                st.body, after_body, brk_t, cont_t, inner_raise, loop, ret_t
+            )
+            # any statement in the try body may raise into any handler
+            for s in self.stmts[mark:]:
+                self.succ[s] = self.succ[s] | set(handlers)
+            if fin_tail:
+                # AFTER the finally, a deferred exit resumes: the tail also
+                # reaches each deferred target occurring anywhere in the
+                # protected region (over-approximation — normal completion
+                # gains these edges too, and an inner-loop break counts —
+                # but the continue→finally→loop-head path must exist or a
+                # publish skipped by the continue looks reachable)
+                defer: set[object] = set()
+                for s in self.stmts[rmark:]:
+                    if isinstance(s, ast.Break) and brk is not None:
+                        defer.add(brk)
+                    elif isinstance(s, ast.Continue) and cont is not None:
+                        defer.add(cont)
+                    elif isinstance(s, ast.Return):
+                        defer.add(ret)
+                for t in fin_tail:
+                    self.succ[t] = self.succ[t] | defer
+            self.succ[st] = {body}
+        elif isinstance(st, ast.Match):
+            entries = {
+                self._seq(c.body, follow, brk, cont, raise_to, loop, ret)
+                for c in st.cases
+            }
+            entries.add(follow)  # no case may match
+            self.succ[st] = entries
+        elif isinstance(st, ast.Return):
+            self.succ[st] = {ret}
+        elif isinstance(st, ast.Raise):
+            self.succ[st] = set(raise_to)
+        elif isinstance(st, ast.Break):
+            self.succ[st] = {brk if brk is not None else follow}
+        elif isinstance(st, ast.Continue):
+            self.succ[st] = {cont if cont is not None else follow}
+        else:
+            # plain statement — including nested def/class (a definition is
+            # one sequential step of THIS function; its body is not)
+            self.succ[st] = {follow}
+        return st
+
+    @staticmethod
+    def _shallow(stmt: ast.stmt) -> Iterator[ast.AST]:
+        """The statement and its expression descendants, stopping at nested
+        statements (they own themselves) and at nested def/lambda bodies
+        (closure code is not this function's control flow)."""
+        yield stmt
+        stack = (
+            []
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef))
+            else list(ast.iter_child_nodes(stmt))
+        )
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.stmt) or isinstance(n, ast.Lambda):
+                continue
+            yield n
+            stack.extend(ast.iter_child_nodes(n))
+
+    # -- queries ---------------------------------------------------------
+
+    def stmt_of(self, node: ast.AST) -> Optional[ast.stmt]:
+        """The enclosing statement of an expression node (the node itself
+        when it is a statement), or None for nodes outside this function's
+        own control flow (closure bodies)."""
+        if isinstance(node, ast.stmt) and id(node) in self.loop_of:
+            return node
+        return self._owner.get(id(node))
+
+    def exists_path(self, src, dst, avoiding: Iterable = ()) -> bool:
+        """True when some CFG path runs from ``src`` (exclusive) to ``dst``
+        without passing through any node in ``avoiding`` — i.e. ``dst`` can
+        execute after ``src`` with no blocker in between."""
+        blocked = {id(n) for n in avoiding}
+        seen: set[int] = set()
+        stack = list(self.succ.get(src, ()))
+        while stack:
+            n = stack.pop()
+            if n is dst or (isinstance(dst, str) and n == dst):
+                return True
+            if id(n) in seen or id(n) in blocked or isinstance(n, str):
+                continue
+            seen.add(id(n))
+            stack.extend(self.succ.get(n, ()))
+        return False
+
+    def reachable_after(self, a, b) -> bool:
+        """Can ``b`` execute after ``a``? (alias of :meth:`exists_path`
+        with no blockers — the statement-ordering query)"""
+        return self.exists_path(a, b)
+
+
+# -- suppression inventory ---------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Suppression:
+    """One live ``# acp-lint: disable=`` pragma (the unit of suppression
+    debt)."""
+
+    path: str
+    line: int
+    rules: tuple[str, ...]
+    comment: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: disable={','.join(self.rules)} ({self.comment})"
+
+
+def collect_suppressions(paths: Iterable[str | Path]) -> list[Suppression]:
+    """Every suppression pragma in real COMMENTS under ``paths`` (tokenize-
+    based, so pragma text inside string-literal fixtures does not count).
+    This inventory is the suppression-debt gate's input: the in-tree count
+    is pinned and growth fails CI with this list printed."""
+    out: list[Suppression] = []
+    for path, rel in iter_py_files(paths):
+        try:
+            text = path.read_text(encoding="utf-8")
+        except (OSError, UnicodeDecodeError):
+            continue
+        try:
+            toks = list(tokenize.generate_tokens(io.StringIO(text).readline))
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            continue
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            m = DISABLE_RE.search(tok.string)
+            if not m:
+                continue
+            rules = tuple(
+                r.strip() for r in m.group(1).split(",") if r.strip()
+            )
+            out.append(
+                Suppression(rel, tok.start[0], rules, tok.string.lstrip("# ").strip())
+            )
+    return sorted(out, key=lambda s: (s.path, s.line))
 
 
 # -- runner ------------------------------------------------------------------
@@ -178,12 +604,17 @@ def iter_py_files(paths: Iterable[str | Path]) -> Iterator[tuple[Path, str]]:
 
 
 def analyze(
-    paths: Iterable[str | Path], rules: Optional[Iterable[str]] = None
+    paths: Iterable[str | Path],
+    rules: Optional[Iterable[str]] = None,
+    timings: Optional[dict[str, float]] = None,
 ) -> list[Violation]:
     """Run the pass pack over files/directories; returns live (unsuppressed)
     violations sorted by location. A file that fails to parse is itself a
     violation (rule ``parse-error``) rather than a crash — the linter must
-    survive fixture trees."""
+    survive fixture trees. Pass a dict as ``timings`` to accumulate per-rule
+    wall seconds (``{rule: s}``, plus ``"<parse>"`` for source loading) —
+    the ``--timing`` budget's input, so a slow pass can't silently become
+    the slow CI step."""
     from .passes import ALL_PASSES
 
     wanted = set(rules) if rules is not None else None
@@ -198,14 +629,25 @@ def analyze(
                 Violation("missing-path", str(p), 1, "path does not exist")
             )
     for path, rel in iter_py_files(paths):
+        t0 = time.perf_counter()
         try:
             text = path.read_text(encoding="utf-8")
             sf = SourceFile(path, text, relpath=rel)
         except (SyntaxError, UnicodeDecodeError) as e:
             out.append(Violation("parse-error", rel, getattr(e, "lineno", 1) or 1, str(e)))
             continue
+        finally:
+            if timings is not None:
+                timings["<parse>"] = timings.get("<parse>", 0.0) + (
+                    time.perf_counter() - t0
+                )
         for p in passes:
+            t0 = time.perf_counter()
             for v in p.run(sf):
                 if v.rule not in sf.disabled_rules(v.line):
                     out.append(v)
+            if timings is not None:
+                timings[p.name] = timings.get(p.name, 0.0) + (
+                    time.perf_counter() - t0
+                )
     return sorted(out, key=lambda v: (v.path, v.line, v.rule))

@@ -1,20 +1,32 @@
 """The acplint pass pack: one pass per shipped-bug class.
 
-| rule             | contract                                         | origin |
-|------------------|--------------------------------------------------|--------|
-| thread-ownership | engine-private state is engine-thread-only       | PR 6   |
-| lane-defaults    | batched dispatches default every absent lane     | PR 7   |
-| jit-purity       | no host clock/RNG/global in traced/forward code  | PR 4   |
-| coord-wallclock  | wall-clock decisions are leader-local            | PR 4/7 |
-| budget-sharing   | token budgets computed only in the declared seam | PR 5   |
-| dispatch-seam    | compiled-program calls only at declared seams    | PR 13  |
+| rule                   | contract                                         | origin |
+|------------------------|--------------------------------------------------|--------|
+| thread-ownership       | engine-private state is engine-thread-only       | PR 6   |
+| lane-defaults          | batched dispatches default every absent lane     | PR 7   |
+| jit-purity             | no host clock/RNG/global in traced/forward code  | PR 4   |
+| coord-wallclock        | wall-clock decisions are leader-local            | PR 4/7 |
+| budget-sharing         | token budgets computed only in the declared seam | PR 5   |
+| dispatch-seam          | compiled-program calls only at declared seams    | PR 13  |
+| donated-after-dispatch | stale donated-buffer captures never re-dispatch  | PR 13  |
+| kv-leaf-completeness   | KV seams move cache leaves generically (ks/vs)   | PR 14  |
+| resolve-after-record   | flight finish precedes future resolution         | PR 9   |
+| mirror-publish         | idle-loop memory mutations republish mirrors     | PR 11  |
+
+The first six are syntactic/per-function (v1); the last four are
+flow-sensitive, built on :class:`core.FlowGraph` ordering queries and the
+shared :func:`core.taint_fixpoint` lattice (v2).
 """
 
 from .budget_seam import BudgetSeamPass
 from .coord_wallclock import CoordWallclockPass
 from .dispatch_seam import DispatchSeamPass
+from .donated_dispatch import DonatedDispatchPass
 from .jit_purity import JitPurityPass
+from .kv_leaf import KvLeafPass
 from .lane_defaults import LaneDefaultsPass
+from .mirror_publish import MirrorPublishPass
+from .resolve_record import ResolveRecordPass
 from .thread_ownership import ThreadOwnershipPass
 
 ALL_PASSES = [
@@ -24,6 +36,10 @@ ALL_PASSES = [
     CoordWallclockPass(),
     BudgetSeamPass(),
     DispatchSeamPass(),
+    DonatedDispatchPass(),
+    KvLeafPass(),
+    ResolveRecordPass(),
+    MirrorPublishPass(),
 ]
 
 RULES = tuple(p.name for p in ALL_PASSES)
@@ -34,7 +50,11 @@ __all__ = [
     "BudgetSeamPass",
     "CoordWallclockPass",
     "DispatchSeamPass",
+    "DonatedDispatchPass",
     "JitPurityPass",
+    "KvLeafPass",
     "LaneDefaultsPass",
+    "MirrorPublishPass",
+    "ResolveRecordPass",
     "ThreadOwnershipPass",
 ]

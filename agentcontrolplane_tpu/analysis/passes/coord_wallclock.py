@@ -20,6 +20,12 @@ The rule, applied to methods of any class that carries coordination state
 
 Metric/latency arithmetic (``now - t0`` fed to a histogram) never compares,
 so observability code passes untouched; only decisions are gated.
+
+v2 note: the wall-clock taint propagation that used to live as a hand-
+rolled fixpoint loop inside this pass IS the repo's generic taint lattice —
+it moved to :func:`core.taint_fixpoint` and this pass now seeds it with
+clock calls (findings pinned byte-identical across the migration by
+``tests/analysis/test_acplint.py``).
 """
 
 from __future__ import annotations
@@ -27,7 +33,15 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import LintPass, SourceFile, Violation, dotted_name
+from ..core import (
+    LintPass,
+    SourceFile,
+    Violation,
+    dotted_name,
+    iter_classes,
+    methods_of,
+    taint_fixpoint,
+)
 
 _CLOCKS = {"time.time", "time.monotonic", "time.perf_counter", "time.time_ns"}
 
@@ -58,20 +72,6 @@ def _affirmative_follower_ref(expr: ast.AST, negated: bool = False) -> bool:
     )
 
 
-def _binding_names(target: ast.AST):
-    """Plain local names a target BINDS. ``obj.field = now`` stores the
-    clock value into a field — it does not make ``obj`` itself a clock
-    value, so Attribute/Subscript bases are deliberately excluded (tainting
-    ``self`` would flag every comparison in the method)."""
-    if isinstance(target, ast.Name):
-        yield target.id
-    elif isinstance(target, (ast.Tuple, ast.List)):
-        for e in target.elts:
-            yield from _binding_names(e)
-    elif isinstance(target, ast.Starred):
-        yield from _binding_names(target.value)
-
-
 def _has_follower_guard(fn: ast.AST) -> bool:
     for node in ast.walk(fn):
         if not isinstance(node, ast.If):
@@ -87,14 +87,10 @@ class CoordWallclockPass(LintPass):
     name = "coord-wallclock"
 
     def run(self, sf: SourceFile) -> Iterator[Violation]:
-        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+        for cls in iter_classes(sf):
             if not _mentions_coord(cls):
                 continue
-            for fn in (
-                n
-                for n in cls.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            ):
+            for fn in methods_of(cls):
                 yield from self._check_method(sf, fn)
 
     def _check_method(self, sf: SourceFile, fn: ast.AST) -> Iterator[Violation]:
@@ -109,38 +105,11 @@ class CoordWallclockPass(LintPass):
                 "followers would fork lockstep on their local clock",
             )
             return
-        # taint: locals carrying a wall-clock value, propagated to a
-        # fixpoint through derived assignments ('now = time.monotonic();
-        # age = now - t0' taints 'age' too — single-hop taint would let
-        # the derived comparison evade the rule)
-        tainted: set[str] = set()
-        while True:
-            def carries_clock(expr: ast.AST) -> bool:
-                return any(
-                    _is_clock_call(n)
-                    or (isinstance(n, ast.Name) and n.id in tainted)
-                    for n in ast.walk(expr)
-                )
-
-            grew = False
-            for node in ast.walk(fn):
-                targets: list[ast.AST] = []
-                if isinstance(node, ast.Assign) and carries_clock(node.value):
-                    targets = list(node.targets)
-                elif isinstance(node, ast.NamedExpr) and carries_clock(node.value):
-                    targets = [node.target]
-                elif (
-                    isinstance(node, ast.AugAssign)
-                    and carries_clock(node.value)
-                ):
-                    targets = [node.target]
-                for t in targets:
-                    for name in _binding_names(t):
-                        if name not in tainted:
-                            tainted.add(name)
-                            grew = True
-            if not grew:
-                break
+        # locals carrying a wall-clock value: the shared taint lattice,
+        # seeded with clock calls ('now = time.monotonic(); age = now - t0'
+        # taints 'age' too — single-hop taint would let the derived
+        # comparison evade the rule)
+        tainted = taint_fixpoint(fn, _is_clock_call)
 
         def wallclock_in(expr: ast.AST) -> bool:
             return any(

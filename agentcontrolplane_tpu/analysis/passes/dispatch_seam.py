@@ -29,7 +29,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import LintPass, SourceFile, Violation
+from ..core import LintPass, SourceFile, Violation, iter_classes, marked_methods, methods_of
 
 _MARKER = "megastep-seam"
 _PREFIX = "_jit_"
@@ -39,18 +39,11 @@ class DispatchSeamPass(LintPass):
     name = "dispatch-seam"
 
     def run(self, sf: SourceFile) -> Iterator[Violation]:
-        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
-            methods = [
-                n
-                for n in cls.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-            ]
-            seams = {
-                m.name for m in methods if sf.func_marker(m, _MARKER) is not None
-            }
+        for cls in iter_classes(sf):
+            seams = marked_methods(sf, cls, _MARKER)
             if not seams:
                 continue
-            for fn in methods:
+            for fn in methods_of(cls):
                 if fn.name in seams:
                     continue
                 for node in ast.walk(fn):

@@ -1,0 +1,207 @@
+"""donated-after-dispatch: a stale capture of a donated buffer must not
+flow into a later dispatch.
+
+Bug class (PR 13, caught in review, never linted until now): the
+speculative-verify path snapshotted its dispatch arguments —
+``args = [self.params, self.cache, ...]`` — then, on the megastep's
+shape-bound fallback, ran the split chunk dispatches (which DONATE the KV
+cache buffer and reassign ``self.cache``) before calling
+``self._jit_verify(*args)``. The ``args`` list still held the donated
+(deleted) device buffer: a crash on a deleted buffer at best, a silent
+verify against pre-chunk KV at worst. The fix was one line —
+``args[1] = self.cache`` re-captures after the fallback — and nothing
+machine-checked it.
+
+The rule, in any class that declares a donated attribute (``# acp:
+donated`` on its assignment — ``self.cache`` in the engine):
+
+- a *donating* method is one whose body reassigns a donated attribute, or
+  calls another donating method of the class (transitive — the split
+  fallback donates because its chunk dispatches do);
+- a local is *tainted* when the shared taint lattice shows it carries a
+  value derived from a donated-attribute read (``args = [.., self.cache,
+  ..]`` taints ``args``);
+- a tainted local flowing into a dispatch call — ``self._jit_*(...)`` or a
+  donating method — is a violation when some CFG path from an intervening
+  donating statement reaches that use without passing a *re-capture* of
+  the local (any rebinding of the name, or a subscript store into it:
+  ``args[1] = self.cache``).
+
+Reads of the donated attribute AT the call site (``self._jit_x(self.params,
+self.cache, ...)``) are always fresh and never flagged — only the captured
+local goes stale. The taint is an over-approximation (a value *derived
+from* the cache, like a dispatch's output arrays, taints too); in practice
+the pattern only fires where a captured argument pack crosses a donating
+dispatch, which is exactly the bug.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FlowGraph,
+    LintPass,
+    SourceFile,
+    Violation,
+    is_self_attr,
+    iter_classes,
+    marked_methods,
+    methods_of,
+    taint_fixpoint,
+    transitive_methods,
+)
+
+_JIT_PREFIX = "_jit_"
+
+
+def _assign_target_elts(node: ast.AST) -> Iterator[ast.AST]:
+    """Flattened assignment-target elements of an Assign/AnnAssign/
+    AugAssign (tuple/list targets unpacked one level)."""
+    if not isinstance(node, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+        return
+    targets = node.targets if isinstance(node, ast.Assign) else [node.target]
+    for t in targets:
+        yield from t.elts if isinstance(t, (ast.Tuple, ast.List)) else [t]
+
+
+def _stores_donated(node: ast.AST, donated: set[str]) -> bool:
+    """This statement reassigns a donated ``self`` attribute — the act
+    that consumes (deletes) the old device buffer."""
+    return any(
+        (a := is_self_attr(e)) is not None and a in donated
+        for e in _assign_target_elts(node)
+    )
+
+
+def _donated_attrs(cls: ast.ClassDef, sf: SourceFile) -> set[str]:
+    out: set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, (ast.Assign, ast.AnnAssign)) and (
+            sf.node_marker(node, "donated") is not None
+        ):
+            out.update(
+                a for e in _assign_target_elts(node) if (a := is_self_attr(e))
+            )
+    return out
+
+
+def _assigns_attr(fn: ast.AST, attrs: set[str]) -> bool:
+    return any(_stores_donated(node, attrs) for node in ast.walk(fn))
+
+
+def _donating_methods(cls: ast.ClassDef, donated: set[str]) -> set[str]:
+    """Methods that consume a donated buffer, to a fixpoint through
+    same-class calls (one-level interprocedural summary — the fallback
+    dispatcher donates because the chunk dispatch it calls does)."""
+    return transitive_methods(cls, lambda fn: _assigns_attr(fn, donated))
+
+
+def _reads_donated(node: ast.AST, donated: set[str]) -> bool:
+    return (
+        isinstance(node, ast.Attribute)
+        and isinstance(node.ctx, ast.Load)
+        and (a := is_self_attr(node)) is not None
+        and a in donated
+    )
+
+
+class DonatedDispatchPass(LintPass):
+    name = "donated-after-dispatch"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in iter_classes(sf):
+            donated = _donated_attrs(cls, sf)
+            if not donated:
+                continue
+            donating = _donating_methods(cls, donated)
+            seams = marked_methods(sf, cls, "megastep-seam")
+            dispatchy = donating | seams
+            for fn in methods_of(cls):
+                yield from self._check_method(sf, fn, donated, donating, dispatchy)
+
+    def _check_method(
+        self,
+        sf: SourceFile,
+        fn: ast.AST,
+        donated: set[str],
+        donating: set[str],
+        dispatchy: set[str],
+    ) -> Iterator[Violation]:
+        tainted = taint_fixpoint(fn, lambda n: _reads_donated(n, donated))
+        if not tainted:
+            return
+        flow = FlowGraph(fn)
+        # dispatch-call uses of a tainted local, keyed by enclosing stmt
+        uses: list[tuple[ast.stmt, ast.Call, str]] = []  # (stmt, call, local)
+        donate_stmts: list[ast.stmt] = []
+        for st in flow.stmts:
+            shallow = list(FlowGraph._shallow(st))
+            is_donate = False
+            for node in shallow:
+                if _stores_donated(node, donated):
+                    is_donate = True
+                if not isinstance(node, ast.Call):
+                    continue
+                callee = is_self_attr(node.func)
+                if callee is None:
+                    continue
+                if callee in donating:
+                    is_donate = True
+                if callee.startswith(_JIT_PREFIX) or callee in dispatchy:
+                    for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                        for sub in ast.walk(arg):
+                            if isinstance(sub, ast.Name) and sub.id in tainted:
+                                uses.append((st, node, sub.id))
+            if is_donate:
+                donate_stmts.append(st)
+        if not uses or not donate_stmts:
+            return
+        seen: set[tuple[int, str]] = set()
+        for st, call, local in uses:
+            key = (call.lineno, local)
+            if key in seen:
+                continue
+            seen.add(key)
+            blockers = self._recaptures(flow, local)
+            for d in donate_stmts:
+                if d is st and not flow.exists_path(st, st, avoiding=blockers):
+                    # the use's own statement donates AFTER the call — safe
+                    # only when no loop back edge re-enters it (a second
+                    # iteration would dispatch the buffer donated by the
+                    # first; exists_path is src-exclusive, so self-reach
+                    # means a real cycle)
+                    continue
+                if flow.exists_path(d, st, avoiding=blockers):
+                    yield self.violation(
+                        sf,
+                        call,
+                        f"'{local}' captures donated state "
+                        f"({'/'.join(sorted(donated))}) and flows into a "
+                        f"dispatch after a donating dispatch on line "
+                        f"{d.lineno} without re-capture — the buffer it "
+                        "holds was donated (deleted); re-capture from "
+                        "self before re-dispatching "
+                        "(e.g. args[i] = self.cache)",
+                    )
+                    break
+
+    @staticmethod
+    def _recaptures(flow: FlowGraph, local: str) -> list[ast.stmt]:
+        """Statements that re-bind ``local`` (wholly, or via a subscript
+        store — ``args[1] = self.cache``): past one of these the capture is
+        fresh again. NOT AugAssign: ``args += [...]`` extends the list in
+        place, so the stale donated element survives it."""
+        out = []
+        for st in flow.stmts:
+            if not isinstance(st, (ast.Assign, ast.AnnAssign)):
+                continue
+            for e in _assign_target_elts(st):
+                if (isinstance(e, ast.Name) and e.id == local) or (
+                    isinstance(e, ast.Subscript)
+                    and isinstance(e.value, ast.Name)
+                    and e.value.id == local
+                ):
+                    out.append(st)
+        return out

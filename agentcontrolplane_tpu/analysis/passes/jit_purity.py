@@ -28,7 +28,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator, Optional
 
-from ..core import LintPass, SourceFile, Violation, dotted_name
+from ..core import LintPass, SourceFile, Violation, dotted_name, iter_functions
 
 _JIT_NAMES = {"jit", "pjit", "shard_map"}
 _BANNED_ROOTS = {"time", "random"}
@@ -82,9 +82,8 @@ class JitPurityPass(LintPass):
             "/models/" in rel or "/ops/" in rel
         )
         defs_by_name: dict[str, list[ast.AST]] = {}
-        for node in ast.walk(sf.tree):
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                defs_by_name.setdefault(node.name, []).append(node)
+        for node in iter_functions(sf):
+            defs_by_name.setdefault(node.name, []).append(node)
 
         checked: set[int] = set()
 

@@ -1,0 +1,182 @@
+"""kv-leaf-completeness: KV-seam code handles cache leaves generically.
+
+Bug class (PR 14, the scale-shear class): the quantized KV cache carries
+per-row scale twins — ``"ks"``/``"vs"`` leaves riding beside ``"k"``/
+``"v"`` in the same page/slot layout. Every extract/copy/swap path must
+move the twins with the values: a host-swap extract that gathered only
+``rows["k"]``/``rows["v"]`` would restore int8 codes against the WRONG
+scales after a round trip — silent numeric shear, invisible to refcount
+audits because the page accounting stays perfectly consistent. PR 14
+closed every such seam by hand (dict-generic comprehensions over
+``cache.items()``); this pass pins the discipline.
+
+The rule, for functions declared ``# acp: kv-seam`` (the engine's
+extract/copy/swap surface — ``_extract_pages``, ``_extract_rows``,
+``_swap_in_rows``, ``_copy_prefix_into_slot``, ``_save_prefix``, and
+``_swap_out`` where ``HostKVEntry`` is built):
+
+- the function satisfies leaf completeness when it either iterates the
+  leaves *generically* (a loop/comprehension over ``.items()``/``.keys()``/
+  ``.values()``, or over a bare mapping whose loop variable is then used
+  as a key — new leaves ride for free; a loop over an unrelated list does
+  NOT qualify), or
+  *explicitly handles the scale twins* (the literals ``"ks"``/``"vs"`` or
+  the ``k_scale``/``v_scale`` fields appear);
+- a literal ``"k"``/``"v"`` leaf access (subscript, dict key, ``.get``)
+  in a marked function with NEITHER escape is the PR 14 bug shape and is
+  flagged;
+- a marked function showing no leaf handling at all is flagged too — the
+  marker would be a lie (kv-seam code that never touches a leaf has no
+  business carrying the pragma).
+
+A bare ``cache["k"]`` probe (the profiler's representative-array argument)
+stays legal in functions that ALSO iterate generically: the probe reads a
+shape, it doesn't copy a leaf set.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation, iter_functions
+
+_LEAVES = {"k", "v"}
+_TWINS = {"ks", "vs"}
+_TWIN_FIELDS = {"k_scale", "v_scale"}
+_DICT_ITERS = {"items", "keys", "values"}
+
+
+def _is_const(node: ast.AST, values: set[str]) -> bool:
+    return isinstance(node, ast.Constant) and node.value in values
+
+
+def _leaf_literal_uses(fn: ast.AST) -> Iterator[ast.AST]:
+    """Literal ``"k"``/``"v"`` LEAF accesses: subscripts, dict-literal
+    keys, and ``.get("k")`` first arguments."""
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Subscript) and _is_const(node.slice, _LEAVES):
+            yield node
+        elif isinstance(node, ast.Dict):
+            for key in node.keys:
+                if key is not None and _is_const(key, _LEAVES):
+                    yield key
+        elif (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "get"
+            and node.args
+            and _is_const(node.args[0], _LEAVES)
+        ):
+            yield node
+
+
+def _handles_twins(fn: ast.AST) -> bool:
+    for node in ast.walk(fn):
+        if _is_const(node, _TWINS):
+            return True
+        if isinstance(node, ast.Attribute) and node.attr in _TWIN_FIELDS:
+            return True
+        if isinstance(node, ast.keyword) and node.arg in _TWIN_FIELDS:
+            return True
+    return False
+
+
+def _used_as_key(var: str, scope: ast.AST | list[ast.AST]) -> bool:
+    """``var`` is used as a mapping KEY somewhere in ``scope``: a
+    subscript slice (``x[var]``), a dict-literal key, or a ``.get(var)``
+    first argument."""
+    roots = scope if isinstance(scope, list) else [scope]
+    for root in roots:
+        for node in ast.walk(root):
+            if (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.slice, ast.Name)
+                and node.slice.id == var
+            ):
+                return True
+            if isinstance(node, ast.Dict) and any(
+                isinstance(k, ast.Name) and k.id == var for k in node.keys
+            ):
+                return True
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "get"
+                and node.args
+                and isinstance(node.args[0], ast.Name)
+                and node.args[0].id == var
+            ):
+                return True
+    return False
+
+
+def _iterates_generically(fn: ast.AST) -> bool:
+    """A for-loop or comprehension that walks cache LEAVES generically:
+    an ``.items()``/``.keys()``/``.values()`` call, or bare name/attribute
+    iteration whose loop variable is then used as a key — ``for name in
+    cache: ... x[name]``. A loop over an unrelated list (``for ch in
+    chunks:``) does NOT qualify: its body can still hardcode ``"k"``/
+    ``"v"`` and shear the scale twins."""
+
+    def dict_call(it: ast.AST) -> bool:
+        return (
+            isinstance(it, ast.Call)
+            and isinstance(it.func, ast.Attribute)
+            and it.func.attr in _DICT_ITERS
+        )
+
+    def key_iter(it: ast.AST, target: ast.AST, scope) -> bool:
+        return (
+            isinstance(it, (ast.Name, ast.Attribute))
+            and isinstance(target, ast.Name)
+            and _used_as_key(target.id, scope)
+        )
+
+    for node in ast.walk(fn):
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if dict_call(node.iter) or key_iter(
+                node.iter, node.target, node.body
+            ):
+                return True
+        if isinstance(node, (ast.DictComp, ast.ListComp, ast.SetComp, ast.GeneratorExp)):
+            if any(
+                dict_call(gen.iter) or key_iter(gen.iter, gen.target, node)
+                for gen in node.generators
+            ):
+                return True
+    return False
+
+
+class KvLeafPass(LintPass):
+    name = "kv-leaf-completeness"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for fn in iter_functions(sf):
+            if sf.func_marker(fn, "kv-seam") is None:
+                continue
+            generic = _iterates_generically(fn)
+            twins = _handles_twins(fn)
+            uses = list(_leaf_literal_uses(fn))
+            if generic or twins:
+                continue
+            if not uses:
+                yield self.violation(
+                    sf,
+                    fn,
+                    f"{fn.name} is declared '# acp: kv-seam' but shows no "
+                    "leaf handling (no generic iteration, no scale twins, "
+                    "no leaf literals) — the marker is a lie; drop it or "
+                    "route the KV copy through this function",
+                )
+                continue
+            for use in uses:
+                yield self.violation(
+                    sf,
+                    use,
+                    f'literal "k"/"v" leaf access in kv-seam {fn.name} with '
+                    "no ks/vs twin handling and no generic leaf iteration — "
+                    "a quantized cache's scale rows would be sheared off "
+                    "this path (iterate cache leaves generically, or carry "
+                    'the "ks"/"vs" twins explicitly)',
+                )

@@ -23,7 +23,7 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import LintPass, SourceFile, Violation, dotted_name
+from ..core import LintPass, SourceFile, Violation, dotted_name, iter_functions
 
 _CTORS = {"zeros", "ones", "full", "full_like", "zeros_like", "ones_like"}
 _NP_ROOTS = {"np", "numpy", "jnp"}
@@ -47,11 +47,7 @@ class LaneDefaultsPass(LintPass):
     name = "lane-defaults"
 
     def run(self, sf: SourceFile) -> Iterator[Violation]:
-        for fn in (
-            n
-            for n in ast.walk(sf.tree)
-            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-        ):
+        for fn in iter_functions(sf):
             arg = sf.func_marker(fn, "dispatch-lanes")
             if arg is None:
                 continue

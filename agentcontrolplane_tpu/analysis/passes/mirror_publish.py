@@ -1,0 +1,168 @@
+"""mirror-publish: memory mutations on the idle loop republish mirrors.
+
+Bug class (PR 11, the sweep-without-dispatch class): the engine publishes
+its memory mirrors (host-pool bytes, shared-page and parked gauges —
+everything ``stats()`` and the scrape thread read) once per dispatch
+cycle. But the wait-for-work loop also mutates memory WITHOUT a dispatch
+following: a park sweep frees shared pages, admission pressure swaps KV
+to the host pool, then the loop parks idle — and the mirrors advertise
+pages that no longer exist until the next request happens to arrive. The
+fix was publishing on the idle path too; nothing pinned it, and any new
+idle-side mutation (a future sweep, an eviction timer) silently re-opens
+the gap.
+
+The rule, for methods declared ``# acp: idle-loop`` (the engine's
+``_run``; the publish hook may be inherited — only call sites matter):
+
+- a *memory-mutating* statement is one that (transitively, through
+  same-class calls) frees/allocs pages (``self._allocator.free/alloc/
+  share``) or mutates the host pool (``self._host_pool.put/pop/...`` —
+  including through a local alias the def-use chains trace back to
+  ``self._host_pool``);
+- from every such statement inside a ``while`` loop, every CFG path back
+  to the loop head (the "return to idle" edge) must pass through a
+  ``self._publish_memory_state()`` call — a path that avoids every
+  publish is the bug;
+- a method carrying the marker but containing no publish call at all is
+  itself flagged (the declaration would be a lie).
+
+``for`` loops and post-loop drain code are exempt: bounded iteration and
+shutdown teardown never "return to idle" — the rule targets the edge
+where the engine goes back to sleep advertising stale state.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import (
+    FlowGraph,
+    LintPass,
+    SourceFile,
+    Violation,
+    chain_parts,
+    is_self_attr,
+    iter_classes,
+    methods_of,
+    taint_fixpoint,
+    transitive_methods,
+)
+
+_PUBLISH = "_publish_memory_state"
+_ALLOCATOR = "_allocator"
+_ALLOC_MUTATORS = {"free", "alloc", "share"}
+_POOL = "_host_pool"
+_POOL_MUTATORS = {"put", "pop", "evict", "clear", "set_budget"}
+
+
+def _pool_locals(fn: ast.AST) -> set[str]:
+    return taint_fixpoint(
+        fn,
+        lambda n: isinstance(n, ast.Attribute)
+        and n.attr == _POOL
+        and isinstance(n.ctx, ast.Load),
+    )
+
+
+def _direct_mut(node: ast.AST, pool_locals: set[str]) -> bool:
+    """A direct page/pool mutation: ``self._allocator.free/alloc/share``
+    or ``self._host_pool.put/...`` (also through a traced local alias)."""
+    if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+        return False
+    leaf = node.func.attr
+    chain = chain_parts(node.func)
+    if leaf in _ALLOC_MUTATORS and _ALLOCATOR in chain:
+        return True
+    return leaf in _POOL_MUTATORS and (
+        _POOL in chain
+        or (
+            isinstance(node.func.value, ast.Name)
+            and node.func.value.id in pool_locals
+        )
+    )
+
+
+def _mutates_memory_directly(fn: ast.AST) -> bool:
+    locals_ = _pool_locals(fn)
+    return any(_direct_mut(node, locals_) for node in ast.walk(fn))
+
+
+def _mutating_methods(cls: ast.ClassDef) -> set[str]:
+    """Memory-mutating methods to a fixpoint through same-class calls
+    (``_sweep_parked`` mutates because ``_release_parked`` frees pages)."""
+    return transitive_methods(cls, _mutates_memory_directly)
+
+
+class MirrorPublishPass(LintPass):
+    name = "mirror-publish"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in iter_classes(sf):
+            # no "class defines _PUBLISH" gate: _check_loop scans for
+            # publish CALL SITES (an inherited publisher counts), and a
+            # marked loop with no call at all must fire — a rename of the
+            # publish hook must not silently turn the whole rule off
+            marked = [
+                m
+                for m in methods_of(cls)
+                if sf.func_marker(m, "idle-loop") is not None
+            ]
+            if not marked:
+                continue
+            mutating = _mutating_methods(cls)
+            for fn in marked:
+                yield from self._check_loop(sf, fn, mutating)
+
+    def _check_loop(
+        self, sf: SourceFile, fn: ast.AST, mutating: set[str]
+    ) -> Iterator[Violation]:
+        flow = FlowGraph(fn)
+        publish_stmts = [
+            st
+            for st in flow.stmts
+            if any(
+                isinstance(n, ast.Call) and is_self_attr(n.func) == _PUBLISH
+                for n in FlowGraph._shallow(st)
+            )
+        ]
+        if not publish_stmts:
+            yield self.violation(
+                sf,
+                fn,
+                f"{fn.name} is declared '# acp: idle-loop' but never calls "
+                f"{_PUBLISH}() — the idle path would advertise stale memory "
+                "mirrors forever",
+            )
+            return
+        locals_ = _pool_locals(fn)
+        for st in flow.stmts:
+            mut_line: Optional[int] = None
+            for n in FlowGraph._shallow(st):
+                if not isinstance(n, ast.Call):
+                    continue
+                # a call INTO a mutating method, or a direct allocator/
+                # pool mutation written inline in the loop body itself
+                if (
+                    (m := is_self_attr(n.func)) is not None and m in mutating
+                ) or _direct_mut(n, locals_):
+                    mut_line = n.lineno
+                    break
+            if mut_line is None:
+                continue
+            loop = flow.loop_of.get(id(st))
+            while loop is not None and not isinstance(loop, ast.While):
+                loop = flow.loop_of.get(id(loop))
+            if loop is None:
+                continue  # not on a wait-for-work loop: no idle edge
+            if flow.exists_path(st, loop, avoiding=publish_stmts):
+                yield self.violation(
+                    sf,
+                    st,
+                    f"memory-mutating call on line {mut_line} can reach the "
+                    f"idle-loop back edge (line {loop.lineno}) without "
+                    f"passing {_PUBLISH}() — pages freed or host-pool state "
+                    "changed here would be invisible to stats()/scrape "
+                    "until the next dispatch (publish on the idle path "
+                    "too)",
+                )

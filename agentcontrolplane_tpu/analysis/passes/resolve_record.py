@@ -1,0 +1,115 @@
+"""resolve-after-record: flight-record the finish BEFORE resolving the
+future.
+
+Bug class (PR 9, prose until now): the flight recorder's finish call
+exports a request's phase attribution and retires its timeline. It must
+run BEFORE the request's future resolves — a caller that queries
+``/v1/requests/{id}/timeline`` the moment ``result()`` returns must see a
+complete record, never race the engine thread ("record BEFORE resolution
+so callers never race", the standing PR 9 review rule). A refactor that
+hoists the ``set_result`` above the ``flight.finish`` re-opens the race
+and nothing fails — callers just *sometimes* read half a timeline.
+
+The rule, in any function that calls ``*.flight.finish(...)``: every
+resolution of a request future — ``<x>.set_result`` / ``.set_exception``
+/ ``.cancel()`` where ``<x>`` is an attribute chain through ``future``
+(``req.future``, ``sl.request.future``) or a local the def-use chains
+show was bound from one — must have some ``flight.finish`` call that can
+precede it (the statement-ordering query: the resolution is reachable
+AFTER a finish). A function with no finish call is out of scope: plenty
+of paths legitimately resolve without a terminal record (sheds and
+expiries record their own event kinds).
+
+The finish commonly sits inside a ``prewarm`` guard while the resolution
+does not, so strict domination is deliberately NOT required — the
+contract is ordering (finish-then-resolve whenever both run), not
+unconditional recording.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import (
+    FlowGraph,
+    LintPass,
+    SourceFile,
+    Violation,
+    chain_parts,
+    iter_functions,
+    taint_fixpoint,
+)
+
+_RESOLVERS = {"set_result", "set_exception", "cancel"}
+
+
+def _is_finish_call(node: ast.AST) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    # a COMPONENT equal to 'flight', not a suffix match — 'inflight.finish'
+    # / 'preflight.finish' are unrelated and must neither pull a function
+    # into scope nor count as the required record
+    parts = chain_parts(node.func)
+    return len(parts) >= 2 and parts[-1] == "finish" and parts[-2] == "flight"
+
+
+def _future_read(node: ast.AST) -> bool:
+    """An expression that reaches through a ``future`` attribute (or the
+    conventional ``future`` name) — the seed for "this local IS a request
+    future"."""
+    if isinstance(node, ast.Attribute) and node.attr == "future":
+        return True
+    return isinstance(node, ast.Name) and node.id == "future"
+
+
+class ResolveRecordPass(LintPass):
+    name = "resolve-after-record"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for fn in iter_functions(sf):
+            finishes = [n for n in ast.walk(fn) if _is_finish_call(n)]
+            if not finishes:
+                continue
+            yield from self._check(sf, fn, finishes)
+
+    def _check(
+        self, sf: SourceFile, fn: ast.AST, finishes: list[ast.AST]
+    ) -> Iterator[Violation]:
+        future_locals = taint_fixpoint(fn, _future_read)
+        flow = FlowGraph(fn)
+        finish_stmts = [s for n in finishes if (s := flow.stmt_of(n)) is not None]
+        if not finish_stmts:
+            # every finish lives in a nested closure/callback — none anchors
+            # in THIS function's control flow, so the function is out of
+            # scope (same as having no finish call at all), not a function
+            # where every resolution is unorderable
+            return
+        for node in ast.walk(fn):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _RESOLVERS
+            ):
+                continue
+            recv = node.func.value
+            chain = chain_parts(recv)
+            is_future = "future" in chain or (
+                isinstance(recv, ast.Name) and recv.id in future_locals
+            )
+            if not is_future:
+                continue
+            st = flow.stmt_of(node)
+            if st is None:
+                continue  # closure body: not this function's control flow
+            if any(f is not st and flow.reachable_after(f, st) for f in finish_stmts):
+                continue
+            yield self.violation(
+                sf,
+                node,
+                f"request future resolved via .{node.func.attr}() with no "
+                "flight.finish able to precede it in this function — the "
+                "PR 9 contract is record BEFORE resolution so a caller "
+                "querying the timeline at result() never races the engine "
+                "thread (move flight.finish above the resolution)",
+            )

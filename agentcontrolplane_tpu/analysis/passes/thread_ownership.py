@@ -39,7 +39,15 @@ from __future__ import annotations
 import ast
 from typing import Iterator
 
-from ..core import LintPass, SourceFile, Violation, is_self_attr
+from ..core import (
+    LintPass,
+    SourceFile,
+    Violation,
+    is_self_attr,
+    iter_classes,
+    marked_methods,
+    methods_of,
+)
 
 _LOCK_FACTORIES = {"Lock", "RLock", "Condition"}
 
@@ -48,12 +56,8 @@ def _collect_registry(cls: ast.ClassDef, sf: SourceFile):
     """(mirrors, locks, cross_thread_methods, all_method_names)."""
     mirrors: set[str] = set()
     locks: set[str] = set()
-    cross: set[str] = set()
-    methods: set[str] = set()
-    for fn in (n for n in cls.body if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))):
-        methods.add(fn.name)
-        if sf.func_marker(fn, "cross-thread") is not None:
-            cross.add(fn.name)
+    cross = marked_methods(sf, cls, "cross-thread")
+    methods = {fn.name for fn in methods_of(cls)}
     for node in ast.walk(cls):
         if isinstance(node, (ast.Assign, ast.AnnAssign)):
             targets = node.targets if isinstance(node, ast.Assign) else [node.target]
@@ -170,16 +174,11 @@ class ThreadOwnershipPass(LintPass):
     name = "thread-ownership"
 
     def run(self, sf: SourceFile) -> Iterator[Violation]:
-        for cls in (n for n in ast.walk(sf.tree) if isinstance(n, ast.ClassDef)):
+        for cls in iter_classes(sf):
             mirrors, locks, cross, methods = _collect_registry(cls, sf)
             if not cross:
                 continue
-            for fn in (
-                n
-                for n in cls.body
-                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and n.name in cross
-            ):
+            for fn in (n for n in methods_of(cls) if n.name in cross):
                 checker = _Checker(self, sf, mirrors, locks, cross, methods)
                 for stmt in fn.body:
                     checker.visit(stmt)

@@ -1135,7 +1135,7 @@ class Engine:
         self._state_dirty = True
         self._tables_dirty = True
         if self.kv_layout == "slot":
-            self.cache = jax.jit(
+            self.cache = jax.jit(  # acp: donated
                 lambda: init_kv_cache(
                     self.config, self.max_slots, self.max_ctx,
                     quantize_kv=self.quantize_kv,
@@ -1166,7 +1166,7 @@ class Engine:
                 scale_spec = NamedSharding(self.mesh, P(None, None, sp_axis, "tp"))
                 page_shardings["ks"] = scale_spec
                 page_shardings["vs"] = scale_spec
-            self.cache = jax.jit(
+            self.cache = jax.jit(  # acp: donated
                 lambda: init_paged_cache(
                     self.config, self.num_pages, self.page_size,
                     quantize_kv=self.quantize_kv,
@@ -1865,7 +1865,7 @@ class Engine:
 
     # -- engine loop -----------------------------------------------------
 
-    def _run(self) -> None:
+    def _run(self) -> None:  # acp: idle-loop
         try:
             while not self._stopping:
                 admitted = self._admit(block=not self._has_work())
@@ -2966,7 +2966,7 @@ class Engine:
             self._prefix_cache.move_to_end(best_key)
             return (best_key, best)
 
-    def _copy_prefix_into_slot(self, slot: int, entry: dict) -> None:  # acp: megastep-seam
+    def _copy_prefix_into_slot(self, slot: int, entry: dict) -> None:  # acp: megastep-seam # acp: kv-seam
         cut = entry["cut"]
         fn = self._jit_copy_prefix.get(cut)
         if fn is None:
@@ -2994,7 +2994,7 @@ class Engine:
             real_tokens=cut, real_slots=1,
         )
 
-    def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:  # acp: megastep-seam
+    def _save_prefix(self, full: list[int], prompt_len: int, slot: int) -> None:  # acp: megastep-seam # acp: kv-seam
         """After a prefill: snapshot the slot's leading KV as a reusable
         prefix entry (LRU-capped). Slot layout: a device COPY at the largest
         bucket/chunk boundary. Paged layout: zero-copy — take a reference on
@@ -5120,7 +5120,7 @@ class Engine:
         even small KV wins; recompute only beats the copy near zero rows."""
         return self.page_size if self.kv_layout == "paged" else 8
 
-    def _swap_out(self, slot: int, sl: _Slot, reason: str) -> bool:
+    def _swap_out(self, slot: int, sl: _Slot, reason: str) -> bool:  # acp: kv-seam
         """Offload a slot's written KV rows to the host pool right before
         its HBM pages are released (preemption, park expiry, mid-prefill
         deadline). The entry holds a bit-exact copy of rows [0, cut), so a
@@ -5211,7 +5211,7 @@ class Engine:
         self._publish_memory_state()
         return True
 
-    def _extract_pages(self, pages: list[int]) -> dict[str, np.ndarray]:  # acp: megastep-seam
+    def _extract_pages(self, pages: list[int]) -> dict[str, np.ndarray]:  # acp: megastep-seam # acp: kv-seam
         """Gather paged KV pages to host numpy, token-major
         ``{"k"/"v": [L, nP, H, d]}`` plus ``"ks"/"vs": [L, nP, H]`` scale
         rows when the pool is quantized (the host tier carries the int8
@@ -5252,7 +5252,7 @@ class Engine:
             )
         return out_np
 
-    def _extract_rows(self, slot: int, cut: int) -> dict[str, np.ndarray]:  # acp: megastep-seam
+    def _extract_rows(self, slot: int, cut: int) -> dict[str, np.ndarray]:  # acp: megastep-seam # acp: kv-seam
         """Slot layout: slice rows [0, cut) of ``slot`` out of the cache to
         host numpy ``{"k"/"v": [L, cut, H, d]}`` (+ scale rows for a
         quantized cache); pow2 sub-slices, async fetch."""
@@ -5291,7 +5291,7 @@ class Engine:
             for name in self.cache
         }
 
-    def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:  # acp: megastep-seam
+    def _swap_in_rows(self, slot: int, entry, start: int, n: int) -> float:  # acp: megastep-seam # acp: kv-seam
         """Restore rows [start, start+n) of a host entry into ``slot``'s
         KV (page-aligned in paged mode — callers schedule page-grain
         chunks). Returns the engine-thread seconds spent blocked in the

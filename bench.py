@@ -417,6 +417,34 @@ def _write_pr_doc(doc: dict) -> None:
         _log(f"could not write PR perf doc {path}: {e}")
 
 
+def _bench_lint() -> dict:
+    """acplint self-measure (PR 15): rule/suppression counts + wall time,
+    recorded into the per-PR doc so the bench-trend sentinel can watch the
+    pass pack's size and the suppression-debt trajectory. Parent-side and
+    stdlib-only — the analysis package never imports jax, so this runs even
+    when the accelerator probe later fails."""
+    from agentcontrolplane_tpu.analysis.core import analyze, collect_suppressions
+    from agentcontrolplane_tpu.analysis.passes import RULES
+
+    root = os.path.dirname(os.path.abspath(__file__))
+    targets = [
+        os.path.join(root, "agentcontrolplane_tpu"),
+        os.path.join(root, "tests"),
+        os.path.join(root, "bench.py"),
+    ]
+    per_rule: dict[str, float] = {}
+    t0 = time.perf_counter()
+    violations = analyze(targets, timings=per_rule)
+    wall = time.perf_counter() - t0
+    return {
+        "rules_total": len(RULES),
+        "suppressions_total": len(collect_suppressions(targets)),
+        "violations": len(violations),
+        "wall_s": round(wall, 3),
+        "per_rule_s": {k: round(v, 4) for k, v in sorted(per_rule.items())},
+    }
+
+
 def _parent() -> None:
     """Orchestrates the phases. The one JSON line is emitted no matter what
     — a parent-side exception must never eat an already-captured headline."""
@@ -447,6 +475,15 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
             signal.signal(sig, _parent_signal_cleanup)
         except (ValueError, OSError):  # non-main thread (tests) / unsupported
             pass
+    if os.environ.get("ACP_BENCH_LINT", "0") == "1":
+        # before the device probe: the lint series must land in the doc
+        # even when the accelerator is unreachable
+        try:
+            with _FLUSH_LOCK:
+                doc["lint"] = _bench_lint()
+                _flush_doc(doc)
+        except Exception as e:
+            notes.append(f"lint section failed: {e!r}")
     # r3 failure (b): 4500s default exceeded the driver's own timeout, so the
     # driver SIGKILLed the parent before anything flushed. 1500s leaves
     # comfortable headroom inside any plausible driver budget (VERDICT r3

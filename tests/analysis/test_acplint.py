@@ -654,3 +654,932 @@ def test_rule_scoped_run_skips_metrics_docs(tmp_path, capsys):
         "--quiet", "--rule", "jit-purity", "--metrics-docs", str(stale),
         str(PKG_ROOT / "analysis"),
     ]) == 0
+
+
+# -- rule: donated-after-dispatch (PR 13 stale-capture class) -----------------
+
+
+def test_donated_dispatch_fires_on_stale_capture(tmp_path):
+    """The re-introduced PR 13 bug, distilled: an argument pack captures
+    ``self.cache``, a donating fallback runs, and the pack re-dispatches
+    without re-capture — the buffer it holds was donated (deleted)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self.cache = init()  # acp: donated
+
+            def _chunk_dispatch(self, ln):
+                self.cache = self._jit_chunk(self.cache, ln)
+
+            def _verify(self, pending):  # acp: megastep-seam
+                args = [self.params, self.cache, self.extra]
+                if pending:
+                    self._chunk_dispatch(pending)
+                cache, toks = self._jit_verify(*args)
+                self.cache = cache
+        """,
+    )
+    violations = analyze([root], rules=["donated-after-dispatch"])
+    assert _rules(violations) == ["donated-after-dispatch"]
+    assert "'args' captures donated state" in violations[0].message
+    assert "re-capture" in violations[0].message
+
+
+def test_donated_dispatch_clean_with_recapture(tmp_path):
+    """The shipped one-line fix: ``args[1] = self.cache`` after the
+    fallback re-captures the fresh buffer, so the re-dispatch is legal."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self.cache = init()  # acp: donated
+
+            def _chunk_dispatch(self, ln):
+                self.cache = self._jit_chunk(self.cache, ln)
+
+            def _verify(self, pending):  # acp: megastep-seam
+                args = [self.params, self.cache, self.extra]
+                if pending:
+                    self._chunk_dispatch(pending)
+                    args[1] = self.cache
+                cache, toks = self._jit_verify(*args)
+                self.cache = cache
+        """,
+    )
+    assert analyze([root], rules=["donated-after-dispatch"]) == []
+
+
+def test_donated_dispatch_clean_without_intervening_donation(tmp_path):
+    """A capture that dispatches straight away (no donating statement on
+    any path in between) is the normal dispatch idiom, never flagged —
+    and direct ``self.cache`` reads AT the call site are always fresh."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self.cache = init()  # acp: donated
+
+            def _decode(self):  # acp: megastep-seam
+                args = [self.params, self.cache]
+                cache, toks = self._jit_decode(*args)
+                self.cache = cache
+
+            def _direct(self, ln):  # acp: megastep-seam
+                self.cache = self._jit_chunk(self.cache, ln)
+                out = self._jit_probe(self.cache)
+                return out
+        """,
+    )
+    assert analyze([root], rules=["donated-after-dispatch"]) == []
+
+
+def test_donated_dispatch_resurrects_pr13_bug_in_real_engine(tmp_path):
+    """The historical-bug gate: delete the shipped fix (``args[1] =
+    self.cache`` after the spec-verify fallback) from the REAL engine
+    source and the rule must fire; the shipped source must stay clean."""
+    src = (PKG_ROOT / "engine" / "engine.py").read_text()
+    fix = "            args[1] = self.cache\n"
+    assert fix in src, "the PR 13 re-capture moved; update this fixture"
+    assert analyze(
+        [PKG_ROOT / "engine" / "engine.py"], rules=["donated-after-dispatch"]
+    ) == []
+    broken = tmp_path / "engine_pr13.py"
+    broken.write_text(src.replace(fix, ""))
+    violations = analyze([broken], rules=["donated-after-dispatch"])
+    assert violations, "removing the PR 13 fix must re-fire the rule"
+    assert all(v.rule == "donated-after-dispatch" for v in violations)
+    assert any("'args'" in v.message for v in violations)
+
+
+# -- rule: kv-leaf-completeness (PR 14 scale-shear class) ---------------------
+
+
+def test_kv_leaf_fires_on_scale_dropping_extract(tmp_path):
+    """The re-introduced PR 14 bug: an extract that moves only the "k"/"v"
+    leaves — a quantized cache's ks/vs scale rows would be sheared off."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _extract_rows(self, slot, cut):  # acp: kv-seam
+                return {
+                    "k": self.cache["k"][:, slot, :cut],
+                    "v": self.cache["v"][:, slot, :cut],
+                }
+        """,
+    )
+    violations = analyze([root], rules=["kv-leaf-completeness"])
+    assert len(violations) == 4  # two dict keys + two subscripts
+    assert all(v.rule == "kv-leaf-completeness" for v in violations)
+    assert "sheared" in violations[0].message
+
+
+def test_kv_leaf_clean_with_generic_iteration_or_twins(tmp_path):
+    """Both escapes: dict-generic iteration (new leaves ride for free) or
+    explicit ks/vs twin handling. A bare cache["k"] shape probe stays
+    legal beside generic iteration."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _extract_rows(self, slot, cut):  # acp: kv-seam
+                rows = {n: a[:, slot, :cut] for n, a in self.cache.items()}
+                probe = self.cache["k"].shape
+                return rows
+
+            def _swap_in_rows(self, slot, entry):  # acp: kv-seam
+                self.cache["k"] = entry["k"]
+                self.cache["v"] = entry["v"]
+                if "ks" in entry:
+                    self.cache["ks"] = entry["ks"]
+                    self.cache["vs"] = entry["vs"]
+        """,
+    )
+    assert analyze([root], rules=["kv-leaf-completeness"]) == []
+
+
+def test_kv_leaf_flags_marker_with_no_leaf_handling(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _swap_out(self, slot):  # acp: kv-seam
+                return self._budget - slot
+        """,
+    )
+    violations = analyze([root], rules=["kv-leaf-completeness"])
+    assert _rules(violations) == ["kv-leaf-completeness"]
+    assert "marker is a lie" in violations[0].message
+
+
+def test_kv_leaf_ignores_unmarked_functions(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def probe(self):
+                return self.cache["k"].shape
+        """,
+    )
+    assert analyze([root], rules=["kv-leaf-completeness"]) == []
+
+
+# -- rule: resolve-after-record (PR 9 record-before-resolution) ---------------
+
+
+def test_resolve_record_fires_on_resolve_before_record(tmp_path):
+    """The reorder the PR 9 prose rule forbids: set_result hoisted above
+    flight.finish — a caller querying the timeline at result() races."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _finish(self, sl, res):
+                sl.request.future.set_result(res)
+                self.flight.finish(sl.request.rid, res)
+        """,
+    )
+    violations = analyze([root], rules=["resolve-after-record"])
+    assert _rules(violations) == ["resolve-after-record"]
+    assert "record BEFORE resolution" in violations[0].message
+
+
+def test_resolve_record_clean_when_finish_precedes(tmp_path):
+    """The shipped ordering, including the prewarm-guarded finish (strict
+    domination is NOT required — ordering is the contract) and a local
+    bound from the future attribute (def-use chains must see through it)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _finish(self, sl, res):
+                if not sl.request.prewarm:
+                    self.flight.finish(sl.request.rid, res)
+                fut = sl.request.future
+                fut.set_result(res)
+        """,
+    )
+    assert analyze([root], rules=["resolve-after-record"]) == []
+
+
+def test_resolve_record_tracks_future_locals(tmp_path):
+    """A resolution through a LOCAL the def-use chains trace to a future
+    read must still be ordered after the record."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _fail(self, sl, err):
+                fut = sl.request.future
+                fut.set_exception(err)
+                self.flight.finish(sl.request.rid, None)
+        """,
+    )
+    violations = analyze([root], rules=["resolve-after-record"])
+    assert _rules(violations) == ["resolve-after-record"]
+
+
+def test_resolve_record_skips_functions_without_finish(tmp_path):
+    """Sheds/expiries resolve without a terminal record by design — a
+    function with no flight.finish call is out of scope."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _shed(self, sl, err):
+                sl.request.future.set_exception(err)
+        """,
+    )
+    assert analyze([root], rules=["resolve-after-record"]) == []
+
+
+# -- rule: mirror-publish (PR 11 sweep-without-dispatch class) ----------------
+
+
+def test_mirror_publish_fires_on_publish_skipping_sweep(tmp_path):
+    """The re-introduced PR 11 bug: the idle loop sweeps (frees pages
+    transitively) then parks without republishing — mirrors advertise
+    pages that no longer exist until the next request arrives."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _release(self, slot):
+                self._allocator.free(self._slot_pages.pop(slot))
+
+            def _sweep(self):
+                for slot in list(self._parked):
+                    self._release(slot)
+
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    self._sweep()
+                    if not self._has_work():
+                        continue
+                    self._dispatch_once()
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+    assert "idle-loop back edge" in violations[0].message
+
+
+def test_mirror_publish_clean_when_idle_path_publishes(tmp_path):
+    """The shipped fix: publish on the idle path too — every route from
+    the mutation back to the loop head passes a publish."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _release(self, slot):
+                self._allocator.free(self._slot_pages.pop(slot))
+
+            def _sweep(self):
+                for slot in list(self._parked):
+                    self._release(slot)
+
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    self._sweep()
+                    if not self._has_work():
+                        self._publish_memory_state()
+                        continue
+                    self._dispatch_once()
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    assert analyze([root], rules=["mirror-publish"]) == []
+
+
+def test_mirror_publish_flags_marker_with_no_publish(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    self._host_pool.put("x", 1)
+
+            def _publish_memory_state(self):
+                self._mirror = 0
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+    assert "never calls" in violations[0].message
+
+
+def test_mirror_publish_exempts_bounded_drains(tmp_path):
+    """for-loops and post-loop teardown never return to idle — only the
+    while-loop back edge is the gap the rule exists to close."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _drain(self):  # acp: idle-loop
+                for slot in list(self._parked):
+                    self._allocator.free(self._slot_pages.pop(slot))
+                self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    assert analyze([root], rules=["mirror-publish"]) == []
+
+
+def test_mirror_publish_resurrects_pr11_bug_in_real_engine(tmp_path):
+    """The historical-bug gate: delete the idle-path publish (the shipped
+    PR 11 fix) from the REAL engine source and the rule must fire; the
+    shipped source must stay clean."""
+    src = (PKG_ROOT / "engine" / "engine.py").read_text()
+    fix = (
+        "                        self._publish_memory_state()\n"
+        "                        continue\n"
+    )
+    assert fix in src, "the PR 11 idle-path publish moved; update this fixture"
+    assert analyze(
+        [PKG_ROOT / "engine" / "engine.py"], rules=["mirror-publish"]
+    ) == []
+    broken = tmp_path / "engine_pr11.py"
+    broken.write_text(src.replace(fix, "                        continue\n"))
+    violations = analyze([broken], rules=["mirror-publish"])
+    assert violations, "removing the PR 11 fix must re-fire the rule"
+    assert all(v.rule == "mirror-publish" for v in violations)
+
+
+# -- coord-wallclock v1→v2 migration pin --------------------------------------
+
+
+def test_coord_wallclock_migration_findings_pinned():
+    """The migration proof: coord-wallclock now rides the shared
+    ``core.taint_fixpoint`` lattice; its findings over a composite of the
+    v1 fixture shapes are pinned byte-identical (path:line:rule:message),
+    so a lattice change that shifts this rule's output fails loudly."""
+    import textwrap as _tw
+
+    src = _tw.dedent(
+        """
+        import time
+
+        class Engine:
+            def __init__(self, coordination=None):
+                self._coord_follower = coordination is not None
+
+            def _expire(self, deadline):
+                return time.monotonic() > deadline
+
+            def _expire_marked(self, deadline):  # acp: leader-local
+                now = time.monotonic()
+                return now > deadline
+
+            def _derived(self, started_at, limit):
+                now = time.monotonic()
+                age = now - started_at
+                return age > limit
+
+            def _inverted(self, deadline):  # acp: leader-local
+                if not self._coord_follower:
+                    return False
+                return time.monotonic() > deadline
+
+            def _expire_good(self, deadline):  # acp: leader-local
+                if self._coord_follower:
+                    return False
+                return time.monotonic() > deadline
+
+            def _metric(self, t0, hist):
+                hist.observe(time.monotonic() - t0)
+        """
+    )
+    from agentcontrolplane_tpu.analysis.core import SourceFile
+    from agentcontrolplane_tpu.analysis.passes import CoordWallclockPass
+
+    sf = SourceFile("eng.py", src, relpath="eng.py")
+    found = [str(v) for v in CoordWallclockPass().run(sf)]
+    assert found == [
+        "eng.py:9: [coord-wallclock] wall-clock comparison in _expire, "
+        "which is not declared '# acp: leader-local' — coordinated ranks "
+        "would diverge on local clocks (route the decision through the "
+        "leader seam)",
+        "eng.py:11: [coord-wallclock] _expire_marked is declared "
+        "'# acp: leader-local' but has no follower guard (if "
+        "self._coord_follower: return) — followers would fork lockstep "
+        "on their local clock",
+        "eng.py:18: [coord-wallclock] wall-clock comparison in _derived, "
+        "which is not declared '# acp: leader-local' — coordinated ranks "
+        "would diverge on local clocks (route the decision through the "
+        "leader seam)",
+        "eng.py:20: [coord-wallclock] _inverted is declared "
+        "'# acp: leader-local' but has no follower guard (if "
+        "self._coord_follower: return) — followers would fork lockstep "
+        "on their local clock",
+    ]
+
+
+# -- the flow framework (core) ------------------------------------------------
+
+
+def test_flowgraph_ordering_queries():
+    import ast as _ast
+
+    from agentcontrolplane_tpu.analysis.core import FlowGraph
+
+    src = textwrap.dedent(
+        """
+        def f(xs):
+            a = 1
+            while xs:
+                b = 2
+                if cond():
+                    c = 3
+                    continue
+                d = 4
+            e = 5
+        """
+    )
+    fn = _ast.parse(src).body[0]
+    g = FlowGraph(fn)
+    by_line = {s.lineno: s for s in g.stmts}
+    a, loop, b, c, d, e = (by_line[n] for n in (3, 4, 5, 7, 9, 10))
+    assert g.reachable_after(a, e)
+    assert g.reachable_after(b, b)          # loop back edge
+    assert g.reachable_after(c, loop)       # continue returns to the head
+    assert not g.reachable_after(e, a)      # no path backwards out of exit
+    assert not g.reachable_after(e, b)      # the loop is never re-entered
+    assert g.reachable_after(c, d)          # via the back edge, next iteration
+    assert g.exists_path(b, loop, avoiding=[])
+    assert not g.exists_path(b, loop, avoiding=[c, d])  # both arms blocked
+
+
+def test_taint_fixpoint_propagates_through_derived_bindings():
+    import ast as _ast
+
+    from agentcontrolplane_tpu.analysis.core import taint_fixpoint
+
+    src = textwrap.dedent(
+        """
+        def f(t0):
+            now = clock()
+            age = now - t0
+            msg = "age=%s" % age
+            clean = t0 + 1
+            self.field = now
+        """
+    )
+    fn = _ast.parse(src).body[0]
+    tainted = taint_fixpoint(
+        fn,
+        lambda n: isinstance(n, _ast.Call)
+        and isinstance(n.func, _ast.Name)
+        and n.func.id == "clock",
+    )
+    assert tainted == {"now", "age", "msg"}  # attribute store never taints
+
+
+def test_collect_suppressions_counts_comments_not_strings(tmp_path):
+    from agentcontrolplane_tpu.analysis.core import collect_suppressions
+
+    _write(
+        tmp_path,
+        "a.py",
+        """
+        x = 1  # justified: fixture  # acp-lint: disable=jit-purity
+        s = "text with # acp-lint: disable=jit-purity inside a string"
+        """,
+    )
+    sups = collect_suppressions([tmp_path])
+    assert len(sups) == 1
+    assert sups[0].path == "a.py" and sups[0].rules == ("jit-purity",)
+
+
+# -- runner: --json / --timing / --suppression-budget -------------------------
+
+
+def test_runner_json_findings_doc(tmp_path, capsys):
+    root = _write(
+        tmp_path,
+        "models/bad.py",
+        """
+        import time
+
+        def forward(x):
+            return x * time.time()  # acp-lint: disable=coord-wallclock
+        """,
+    )
+    import json as _json
+
+    out = tmp_path / "findings.json"
+    assert lint_main(["--quiet", "--json", str(out), str(root)]) == 1
+    doc = _json.loads(out.read_text())
+    assert doc["version"] == 1
+    assert doc["counts"]["violations"] == 1
+    assert doc["counts"]["by_rule"] == {"jit-purity": 1}
+    assert doc["counts"]["rules_total"] == 10
+    assert doc["counts"]["suppressions_total"] == 1
+    [v] = doc["violations"]
+    assert v["rule"] == "jit-purity" and v["path"] == "models/bad.py"
+    assert isinstance(v["line"], int) and "host call" in v["message"]
+    [s] = doc["suppressions"]
+    assert s["rules"] == ["coord-wallclock"]
+    capsys.readouterr()
+
+
+def test_runner_json_to_stdout(tmp_path, capsys):
+    import json as _json
+
+    root = _write(tmp_path, "clean.py", "x = 1\n")
+    assert lint_main(["--quiet", "--json", "-", str(root)]) == 0
+    doc = _json.loads(capsys.readouterr().out)
+    assert doc["counts"]["violations"] == 0
+
+
+def test_runner_timing_report_and_budget(tmp_path, capsys):
+    root = _write(tmp_path, "clean.py", "x = 1\n")
+    assert lint_main(["--quiet", "--timing", str(root)]) == 0
+    err = capsys.readouterr().err
+    assert "acplint timing" in err and "total" in err
+    for rule in ("jit-purity", "donated-after-dispatch", "mirror-publish"):
+        assert rule in err  # every requested rule reports, even at ~0s
+    # an impossible budget must flip the exit code even on a clean tree
+    assert lint_main([
+        "--quiet", "--timing-budget", "0", str(root)
+    ]) == 1
+    assert "TIMING BUDGET EXCEEDED" in capsys.readouterr().err
+
+
+def test_runner_suppression_budget_gate(tmp_path, capsys):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _slot_budget(self, sl):  # acp: budget-seam
+                return sl.sampling.max_tokens - len(sl.generated)
+
+            def _verify(self, sl):
+                return sl.sampling.max_tokens - 1  # fixture debt  # acp-lint: disable=budget-sharing
+        """,
+    )
+    assert lint_main(["--quiet", "--suppression-budget", "1", str(root)]) == 0
+    capsys.readouterr()
+    assert lint_main(["--quiet", "--suppression-budget", "0", str(root)]) == 1
+    err = capsys.readouterr().err
+    assert "SUPPRESSION DEBT OVER BUDGET" in err
+    assert "disable=budget-sharing" in err  # the justification list prints
+
+
+def test_tree_suppression_debt_within_pinned_budget():
+    """The same pin make lint-acp / CI enforce (ACP_LINT_SUPPRESSIONS):
+    growth is a deliberate act taken in the PR that adds the pragma, never
+    drift. If this fails, either remove the new suppression or raise the
+    budget here, in the Makefile, and in ci.yml — in the same change."""
+    from agentcontrolplane_tpu.analysis.core import collect_suppressions
+
+    sups = collect_suppressions([PKG_ROOT, TESTS_ROOT])
+    listing = "\n".join(str(s) for s in sups)
+    assert len(sups) <= 4, f"suppression debt grew:\n{listing}"
+
+
+def test_mirror_publish_fires_on_direct_inline_mutation(tmp_path):
+    """Verify-drive regression: a page free written INLINE in the idle
+    loop (no helper method) must anchor a violation too — the statement
+    scan covers direct allocator/pool mutations, not just calls into
+    mutating methods."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    self._allocator.free(self._expired_pages())
+                    if not self._has_work():
+                        continue
+                    self._dispatch_once()
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+    assert "idle-loop back edge" in violations[0].message
+
+
+def test_mirror_publish_try_else_block_is_not_a_raise_path(tmp_path):
+    """Review regression: only try-BODY statements may raise into their
+    handlers. A free in the ``else`` block runs past them — every real
+    path hits the publish below, so this loop is clean (the CFG used to
+    wire spurious else→handler edges and flag it)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    try:
+                        batch = self._poll()
+                    except TimeoutError:
+                        continue
+                    else:
+                        self._allocator.free(batch)
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    assert analyze([root], rules=["mirror-publish"]) == []
+
+
+def test_mirror_publish_try_body_raise_path_still_counts(tmp_path):
+    """The dual pin: a mutation IN the try body can raise into a handler
+    whose ``continue`` skips the publish — that escape path must keep
+    firing (the CFG is deliberately coarse about which calls raise)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    try:
+                        self._allocator.free(self._expired_pages())
+                    except TimeoutError:
+                        continue
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+
+
+def test_kv_leaf_list_loop_does_not_exempt_literal_leaves(tmp_path):
+    """Review regression: a for-loop over an unrelated LIST (``for ch in
+    chunks:``) is not generic leaf iteration — hardcoded "k"/"v" copies
+    inside it are exactly the PR 14 shear shape and must fire. Bare-name
+    iteration still qualifies when the loop variable is used as a KEY."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _extract_pages(self, chunks):  # acp: kv-seam
+                out = {}
+                for ch in chunks:
+                    out["k"] = ch["k"]
+                    out["v"] = ch["v"]
+                return out
+
+            def _merge(self, chunks):  # acp: kv-seam
+                out = {}
+                for name in self.cache:
+                    out[name] = [ch[name] for ch in chunks]
+                return out
+        """,
+    )
+    violations = analyze([root], rules=["kv-leaf-completeness"])
+    assert violations and all(v.rule == "kv-leaf-completeness" for v in violations)
+    assert all(v.line < 9 for v in violations), "_merge must stay clean"
+
+
+def test_donated_dispatch_fires_on_loop_carried_self_donation(tmp_path):
+    """Review regression: when the donate and the use share ONE statement
+    inside a loop, the back edge makes iteration N's donation precede
+    iteration N+1's use — the second dispatch consumes a deleted buffer.
+    A re-capture inside the loop body makes it legal again."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self.cache = init()  # acp: donated
+
+            def _fallback(self, chunks):  # acp: megastep-seam
+                args = [self.params, self.cache]
+                for ln in chunks:
+                    self.cache = self._jit_chunk(*args)
+
+            def _fallback_ok(self, chunks):  # acp: megastep-seam
+                args = [self.params, self.cache]
+                for ln in chunks:
+                    self.cache = self._jit_chunk(*args)
+                    args[1] = self.cache
+        """,
+    )
+    violations = analyze([root], rules=["donated-after-dispatch"])
+    assert _rules(violations) == ["donated-after-dispatch"]
+    assert violations[0].line == 9, "_fallback_ok must stay clean"
+
+
+def test_json_stdout_stays_parseable_with_violations(tmp_path, capsys):
+    """Review regression: ``--json -`` owns stdout. With findings present
+    the human violation lines move to stderr, so downstream tooling can
+    always ``json.loads`` the payload — exactly the case (failure) where
+    CI consumes it."""
+    import json as _json
+
+    root = _write(
+        tmp_path,
+        "models/bad.py",
+        """
+        import time
+
+        def forward(x):
+            return x * time.time()
+        """,
+    )
+    assert lint_main(["--quiet", "--json", "-", str(root)]) == 1
+    captured = capsys.readouterr()
+    doc = _json.loads(captured.out)
+    assert doc["counts"]["violations"] == 1
+    assert "jit-purity" in captured.err
+
+
+def test_mirror_publish_fires_without_publish_method_defined(tmp_path):
+    """Review regression: a class whose idle loop never calls the publish
+    hook must fire even when the class doesn't DEFINE
+    _publish_memory_state — a rename of the hook must not silently turn
+    the rule off (call sites are what the pass scans, so an inherited
+    publisher still counts)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    self._allocator.free(self._expired_pages())
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+    assert "never calls" in violations[0].message
+
+
+def test_donated_dispatch_augassign_is_not_a_recapture(tmp_path):
+    """Review regression: ``args += [...]`` extends the capture list IN
+    PLACE — the stale donated buffer survives it, so it must not count as
+    a re-capture blocker."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def __init__(self):
+                self.cache = init()  # acp: donated
+
+            def _chunk_dispatch(self, ln):
+                self.cache = self._jit_chunk(self.cache, ln)
+
+            def _verify(self, pending):  # acp: megastep-seam
+                args = [self.params, self.cache, self.extra]
+                if pending:
+                    self._chunk_dispatch(pending)
+                    args += [self.flag]
+                cache, toks = self._jit_verify(*args)
+                self.cache = cache
+        """,
+    )
+    violations = analyze([root], rules=["donated-after-dispatch"])
+    assert _rules(violations) == ["donated-after-dispatch"]
+    assert "'args' captures donated state" in violations[0].message
+
+
+def test_resolve_record_ignores_flight_lookalike_chains(tmp_path):
+    """Review regression: 'inflight.finish'/'preflight.finish' are not the
+    flight recorder. A lookalike must neither pull a function into scope
+    (false positive) nor count as the required record when a real
+    flight.finish sits after the resolution (false negative)."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _done(self, req):
+                self.inflight.finish(req.rid)
+                req.future.set_result(1)
+
+            def _late(self, req):
+                self.preflight.finish(req.rid)
+                req.future.set_result(1)
+                self.flight.finish(req.rid, 1)
+        """,
+    )
+    violations = analyze([root], rules=["resolve-after-record"])
+    assert _rules(violations) == ["resolve-after-record"]
+    assert violations[0].line == 9, "_done must stay out of scope"
+
+
+def test_resolve_record_closure_only_finish_is_out_of_scope(tmp_path):
+    """Review regression: a flight.finish living only inside a nested
+    callback anchors nowhere in THIS function's CFG — the function is out
+    of scope, not a guaranteed violation on every resolution."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _done(self, req):
+                def _cb():
+                    req.flight.finish("ok")
+                self.pool.submit(_cb)
+                req.future.set_result(1)
+        """,
+    )
+    assert analyze([root], rules=["resolve-after-record"]) == []
+
+
+def test_mirror_publish_continue_runs_the_finally_first(tmp_path):
+    """Review regression: a ``continue`` leaving a try body runs the
+    ``finally`` before reaching the loop head — a publish living in the
+    finally covers every such path (the CFG used to wire continue straight
+    to the back edge, bypassing it). Without the publish the escape still
+    fires."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _run(self):  # acp: idle-loop
+                while not self._stopping:
+                    try:
+                        self._allocator.free(self._expired_pages())
+                        if not self._has_work():
+                            continue
+                        self._dispatch_once()
+                    finally:
+                        self._publish_memory_state()
+
+            def _bare(self):  # acp: idle-loop
+                while not self._stopping:
+                    try:
+                        self._allocator.free(self._expired_pages())
+                        if not self._has_work():
+                            continue
+                        self._dispatch_once()
+                    finally:
+                        self._log_cycle()
+                    self._publish_memory_state()
+
+            def _publish_memory_state(self):
+                self._pages_mirror = self._allocator.pages_free
+        """,
+    )
+    violations = analyze([root], rules=["mirror-publish"])
+    assert _rules(violations) == ["mirror-publish"]
+    assert violations[0].line > 12, "_run (publish in finally) must be clean"
+
+
+def test_resolve_record_return_routes_through_finally_finish(tmp_path):
+    """The same CFG fix seen from resolve-after-record: an early return
+    runs the finally, so a flight.finish there precedes a resolution made
+    by the caller path below the try."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _done(self, req, res):
+                try:
+                    if res is None:
+                        return
+                finally:
+                    self.flight.finish(req.rid, res)
+                req.future.set_result(res)
+        """,
+    )
+    assert analyze([root], rules=["resolve-after-record"]) == []
