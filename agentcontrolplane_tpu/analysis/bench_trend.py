@@ -118,6 +118,26 @@ METRICS: tuple[MetricSpec, ...] = (
         "suppressions_total", ("lint", "suppressions_total"), "lower",
         rel_tol=0.5,
     ),
+    # fleet tier (PR 16): affinity routing must keep beating round-robin
+    # on prefix reuse (self-relative hit rates, loose bands — the control
+    # arm rides in the same doc), and the affinity arm's tail TTFT should
+    # not regress; handoff wire bytes are a sanity series (a collapse to
+    # zero means the disaggregated leg silently stopped exporting).
+    MetricSpec(
+        "fleet_affinity_hit_rate",
+        ("fleet", "routing", "affinity", "prefix_hit_rate"),
+        "higher", rel_tol=0.3,
+    ),
+    MetricSpec(
+        "fleet_affinity_ttft_p99_ms",
+        ("fleet", "routing", "affinity", "ttft_p99_ms"),
+        "lower", rel_tol=1.0,
+    ),
+    MetricSpec(
+        "fleet_handoff_bytes",
+        ("fleet", "handoff", "handoff_bytes"),
+        "higher", rel_tol=0.5,
+    ),
 )
 
 

@@ -22,9 +22,11 @@ Public (non-underscore) attributes are the deliberate stats surface and are
 always readable. Any WRITE to engine state from a cross-thread function is
 flagged unless lock-guarded.
 
-Separately, in ``server/`` modules (the scrape side), reaching into
-``engine._anything`` is flagged outright — REST code must consume
-``stats()`` and public counters, never engine internals. This covers
+Separately, in ``server/`` modules (the scrape side) and ``fleet/``
+modules (the replica-pool router, which drives many engines from
+router/caller threads), reaching into ``engine._anything`` is flagged
+outright — that code must consume ``stats()``, public counters, and the
+purpose-built public seams, never engine internals. This covers
 CHAINED reaches too (``engine.flight._events``,
 ``engine._allocator.audit()``): the flight recorder hangs off the engine
 as a public attribute, and its ring buffer / per-request index are just as
@@ -188,10 +190,24 @@ class ThreadOwnershipPass(LintPass):
     def _check_server_scope(self, sf: SourceFile) -> Iterator[Violation]:
         rel = sf.relpath
         base = rel.rsplit("/", 1)[-1]
-        if not (rel.startswith("server/") or "/server/" in rel):
+        # fleet/ (the replica-pool router) is held to the same standard as
+        # server/: it drives MANY engines from router/caller threads, so an
+        # engine._* reach there is a cross-thread race on a foreign engine's
+        # loop state — the pool consumes submit()/stats()/cancel() and the
+        # purpose-built public seams (inject_host_kv, fleet_replica_id) only
+        scope = next(
+            (
+                s
+                for s in ("server/", "fleet/")
+                if rel.startswith(s) or f"/{s}" in rel
+            ),
+            None,
+        )
+        if scope is None:
             return
         if base.startswith(("test_", "conftest")):
             return  # tests are white-box by design
+        who = "server" if scope == "server/" else "fleet"
         for node in ast.walk(sf.tree):
             if (
                 isinstance(node, ast.Attribute)
@@ -202,7 +218,7 @@ class ThreadOwnershipPass(LintPass):
                 yield self.violation(
                     sf,
                     node,
-                    f"server code reaches into engine...{node.attr} — the "
+                    f"{who} code reaches into engine...{node.attr} — the "
                     "scrape surface is stats(), public counters, and the "
                     "flight recorder's declared cross-thread read methods",
                 )

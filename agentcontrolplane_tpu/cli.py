@@ -788,6 +788,64 @@ def cmd_perf(args) -> int:
         return 0
 
 
+def cmd_fleet(args) -> int:
+    """Fleet observatory: the replica table (role, liveness, lease holder
+    + epoch, queue depth, goodput, affinity keys homed) plus the router's
+    routing/failover/handoff ledgers — GET /v1/fleet."""
+    with _client(args) as http:
+        resp = http.get("/v1/fleet")
+        if resp.status_code != 200:
+            print(f"error: {resp.text}", file=sys.stderr)
+            return 1
+        doc = resp.json()
+        if args.json:
+            print(json.dumps(doc, indent=2))
+            return 0
+        replicas = doc.get("replicas", [])
+        routing = doc.get("routing", {})
+        print(
+            f"fleet: {sum(1 for r in replicas if r.get('alive'))}/"
+            f"{len(replicas)} replicas live, policy={routing.get('policy')}"
+        )
+        print(
+            f"{'REPLICA':<12}{'ROLE':<9}{'ALIVE':<7}{'LEASE HOLDER':<22}"
+            f"{'EPOCH':>6}{'QUEUE':>7}{'ACTIVE':>8}{'GOODPUT':>9}{'KEYS':>6}"
+        )
+        for r in replicas:
+            lease = r.get("lease", {})
+            goodput = r.get("goodput_ratio")
+            print(
+                f"{r['id']:<12}{r.get('role', '?'):<9}"
+                f"{('yes' if r.get('alive') else 'DEAD'):<7}"
+                f"{(lease.get('holder') or '-'):<22}{lease.get('epoch', 0):>6}"
+                f"{r.get('queue_depth', 0):>7}{r.get('active_slots', 0):>8}"
+                f"{goodput if goodput is None else format(goodput, '.1%'):>9}"
+                f"{r.get('affinity_keys', 0):>6}"
+            )
+        print(
+            f"routing: {routing.get('routed', 0)} routed, "
+            f"{routing.get('affinity_hits', 0)} affinity hits / "
+            f"{routing.get('affinity_misses', 0)} misses, "
+            f"{routing.get('sheds_skipped', 0)} shed replicas skipped, "
+            f"{routing.get('inflight', 0)} in flight"
+        )
+        fo = doc.get("failover", {})
+        print(
+            f"failover: {fo.get('failovers', 0)} failovers, "
+            f"max {fo.get('failover_max', 0)} per request"
+        )
+        ho = doc.get("handoff", {})
+        if ho.get("enabled"):
+            print(
+                f"handoff: {ho.get('handoffs', 0)} prefill->decode handoffs "
+                f"({ho.get('bytes', 0)} KV bytes), {ho.get('errors', 0)} "
+                f"errors, min {ho.get('min_tokens', 0)} prompt tokens"
+            )
+        else:
+            print("handoff: disabled (handoff_min_tokens=0)")
+        return 0
+
+
 def cmd_timeline(args) -> int:
     """Flight-recorder introspection: with a request id, replay that
     request's full decision sequence (admit, chunks, preempts, park/adopt,
@@ -983,6 +1041,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="program rows to show (sorted by total host time)",
     )
     pf.set_defaults(fn=cmd_perf)
+
+    fl = sub.add_parser(
+        "fleet",
+        help="fleet replica pool: replica table (lease holder, goodput, "
+        "queue depth, affinity keys) + routing/failover/handoff ledgers",
+    )
+    fl.add_argument("--json", action="store_true", help="raw JSON payload")
+    fl.set_defaults(fn=cmd_fleet)
 
     tl = sub.add_parser(
         "timeline",

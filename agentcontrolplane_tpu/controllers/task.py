@@ -232,7 +232,8 @@ class TaskReconciler:
         try:
             llm = self.store.get("LLM", agent.spec.llm_ref.name, task.namespace)
             assert isinstance(llm, LLM)
-            if llm.spec.provider == "tpu" and getattr(self.llm_factory, "engine", None) is None:
+            engine_handle = getattr(self.llm_factory, "engine", None)
+            if llm.spec.provider == "tpu" and engine_handle is None:
                 # multi-replica: THIS replica has no serving engine (a
                 # follower joined for control-plane capacity). Leave the
                 # task for the engine-owning replica instead of burning a
@@ -240,6 +241,21 @@ class TaskReconciler:
                 # caller's finally, so the owner's next attempt wins it.
                 task.status.status_detail = (
                     "waiting for an engine-serving replica (provider: tpu)"
+                )
+                self._update_status(task)
+                return Result.after(self.requeue_delay)
+            fleet_pool = getattr(engine_handle, "pool", None)
+            if (
+                llm.spec.provider == "tpu"
+                and fleet_pool is not None
+                and not fleet_pool.alive()
+            ):
+                # the handle is a fleet router whose every replica is dead
+                # or unregistered: requeue rather than burn a guaranteed
+                # "no live replicas" failure — a replica (re)joining the
+                # pool makes the next attempt succeed
+                task.status.status_detail = (
+                    "waiting for a live fleet replica (provider: tpu)"
                 )
                 self._update_status(task)
                 return Result.after(self.requeue_delay)

@@ -159,6 +159,14 @@ class TPUEngineClient(LLMClient):
                 idx, seen["n"] = seen["n"], seen["n"] + 1
                 loop.call_soon_threadsafe(on_tool_call, idx, tc)
 
+        # fleet routing: when the handle is a FleetRouter, name the
+        # conversation's persona (system-prompt hash) so every turn of
+        # this agent routes to the replica holding its prefix hot
+        extra = {}
+        if getattr(self.engine, "supports_affinity", False):
+            from ..fleet.router import persona_affinity_key
+
+            extra["affinity_key"] = persona_affinity_key(messages)
         # the queue deadline rides INTO the engine: if the request would
         # outwait its queue budget it is failed engine-side without prefill
         future = self.engine.submit(
@@ -171,6 +179,7 @@ class TPUEngineClient(LLMClient):
             # engine phase spans (flight recorder) parent under the
             # caller's LLMRequest span when one is provided
             trace=trace_context,
+            **extra,
         )
         try:
             result = await self._await_result(future)

@@ -106,6 +106,13 @@ class GenerationResult:
     # times this request was preempted (KV pool pressure) and resumed;
     # preemption is invisible in the output — this is the only trace
     preempt_count: int = 0
+    # prefill/decode disaggregation (fleet/): when the request was
+    # submitted with export_kv=True, the prompt's written KV rides out as
+    # a HostKVEntry (rows [0, cut), page-aligned in paged mode, int8 +
+    # scale twins when the cache is quantized) for a decode replica to
+    # restore through inject_host_kv. None when export was skipped
+    # (pool off, truncated prompt, too few rows).
+    kv_handoff: Optional[object] = None
 
 
 @dataclass
@@ -158,6 +165,10 @@ class _Request:
     # prewarm requests skip per-request flight events and phase histograms
     # (hundreds of synthetic requests would drown the real timelines)
     prewarm: bool = False
+    # fleet disaggregation: extract the prompt KV at finish and attach it
+    # to the GenerationResult (see _export_kv_handoff). Mutually exclusive
+    # with park — the handoff entry, not the parked slot, is the reuse unit.
+    export_kv: bool = False
     # completed (True) when the request takes a slot (prefill starts).
     # Clients key their generation timeout off this, so queue wait under
     # saturation doesn't eat the per-request budget (mirrored onto
@@ -782,6 +793,16 @@ class Engine:
             HostKVPool(self.host_kv_bytes) if self.host_kv_bytes else None
         )
         self.prefix_dedup = bool(prefix_dedup)
+        # fleet tier (fleet/router.py): replica identity assigned at pool
+        # registration — read by the fleet.replica_crash fault match in
+        # _run — and the cross-thread handoff inject queue: any thread
+        # enqueues HostKVEntry objects via inject_host_kv; the engine
+        # thread lands them in the host pool at the top of _fill_slots,
+        # BEFORE admission matching, so inject-then-submit ordering
+        # guarantees the entry is visible to the submitted request.
+        self.fleet_replica_id: Optional[str] = None
+        self._kv_inject: "queue.Queue" = queue.Queue()
+        self.kv_injects = 0  # handoff entries landed in the host pool
         self.kv_swap_outs = 0  # KV rows offloaded to the host tier (events)
         self.kv_swap_ins = 0  # host-tier restores (swap-in completions)
         self.prefix_shares = 0  # admissions that refcount-shared prompt pages
@@ -1267,6 +1288,7 @@ class Engine:
         park: bool = False,
         trace=None,
         _prewarm: bool = False,
+        export_kv: bool = False,
     ) -> Future:
         """Thread-safe; returns a Future[GenerationResult]. ``on_tokens``
         (optional) streams newly sampled token ids per decode block from the
@@ -1285,7 +1307,13 @@ class Engine:
         after a normal finish so the conversation's next turn prefills only
         its suffix (see docs/serving-engine.md "Overlapped tool
         execution"). Neither knob changes WHAT is generated — greedy output
-        is byte-identical with them on or off."""
+        is byte-identical with them on or off.
+
+        ``export_kv=True`` (fleet prefill/decode disaggregation) extracts
+        the prompt's written KV at finish and attaches it to the result as
+        ``GenerationResult.kv_handoff`` — a ``HostKVEntry`` a decode
+        replica restores via :meth:`inject_host_kv`. Export supersedes
+        parking (the entry, not the slot, is the reuse unit)."""
         tokens = self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
         s = sampling or SamplingParams()
         prefix_len = len(s.forced_prefix)
@@ -1309,9 +1337,11 @@ class Engine:
             # truncated prompts keep their suffix, not their prefix: the
             # next turn's prompt can never extend them, so parking would
             # pin pages that no adoption can ever use
-            park=bool(park) and self.park_max_s > 0 and not truncated,
+            park=bool(park) and self.park_max_s > 0 and not truncated
+            and not export_kv,
             trace=trace,
             prewarm=bool(_prewarm),
+            export_kv=bool(export_kv) and not _prewarm,
         )
         if on_tool_call is not None:
             from .toolparse import ToolStreamParser
@@ -1793,6 +1823,7 @@ class Engine:
                     "entries": self._host_kv_entries,
                     "swap_outs": self.kv_swap_outs,
                     "swap_ins": self.kv_swap_ins,
+                    "injects": self.kv_injects,
                 },
                 "prefix_dedup": {
                     "enabled": self.prefix_dedup and self.kv_layout == "paged",
@@ -1878,6 +1909,18 @@ class Engine:
                 # which is the recovery path worth testing
                 if self._faults.enabled and self._faults.pop("engine.crash") is not None:
                     raise RuntimeError("fault injection: engine crash")
+                if (
+                    self._faults.enabled
+                    and self.fleet_replica_id is not None
+                    and self._faults.pop(
+                        "fleet.replica_crash", steps=self.decode_steps,
+                        match={"replica": self.fleet_replica_id},
+                    ) is not None
+                ):
+                    # pool failover drill: only the NAMED replica dies (the
+                    # match filter keeps sibling engines in the same process
+                    # alive); after_steps gates it mid-decode
+                    raise RuntimeError("fault injection: fleet replica crash")
                 self._sweep_parked()
                 if not self._has_work():
                     if not admitted:
@@ -2158,6 +2201,7 @@ class Engine:
         """Admit from the waiting deque into free slots (the prefill side
         of _admit, split out so the coordinated multi-host loop can replay
         broadcast admissions without touching the local submit queue)."""
+        self._drain_kv_inject()
         admitted = False
         while self._waiting and (self._free or self._has_parked()):
             group = self._collect_group()
@@ -4823,6 +4867,11 @@ class Engine:
         ):
             self._park(slot, sl, reason)
             return
+        kv_entry = None
+        if req.export_kv and reason in ("stop", "length") and not self._stopping:
+            # disaggregation: extract the prompt KV BEFORE the slot (and in
+            # paged mode its pages) is torn down below
+            kv_entry = self._export_kv_handoff(slot, sl)
         self._slots.pop(slot)
         self._state_dirty = True  # device lane must be re-uploaded inactive
         self._cancelled.discard(req.rid)
@@ -4835,9 +4884,11 @@ class Engine:
         if self.kv_layout == "paged":
             self._allocator.free(self._slot_pages.pop(slot, []))
             self._block_tables[slot, :] = TRASH_PAGE
-        self._resolve_result(sl, reason, slot=slot)
+        self._resolve_result(sl, reason, slot=slot, kv_entry=kv_entry)
 
-    def _resolve_result(self, sl: _Slot, reason: str, slot: int = -1) -> None:
+    def _resolve_result(
+        self, sl: _Slot, reason: str, slot: int = -1, kv_entry=None
+    ) -> None:
         """Resolve a slot's future with its GenerationResult — shared by the
         normal finish and the park transition (a parked slot's caller gets
         its result immediately; only the KV bookkeeping lingers)."""
@@ -4853,6 +4904,7 @@ class Engine:
             ttft_ms=(sl.first_token_at - sl.request.enqueued) * 1e3,
             latency_ms=(now - sl.request.enqueued) * 1e3,
             preempt_count=sl.request.preempt_count,
+            kv_handoff=kv_entry,
         )
         if not sl.request.prewarm:
             # terminal flight event + phase attribution export (histograms
@@ -5113,6 +5165,78 @@ class Engine:
             while pool.used_bytes > pool.max_bytes and len(pool):
                 pool.pop(next(iter(pool._entries)))
         self._publish_memory_state()
+
+    def inject_host_kv(self, entry) -> bool:
+        """Land a :class:`HostKVEntry` in this engine's host-KV tier
+        (thread-safe; the fleet router's prefill→decode handoff path).
+        The entry is enqueued here and committed to the pool by the engine
+        thread at the top of ``_fill_slots`` — BEFORE admission matching —
+        so inject-then-submit ordering guarantees a subsequently submitted
+        request sees it in ``_collect_group``'s host-tier prefix match.
+        Returns False (caller falls back to a full prefill) when the host
+        tier is disabled or the engine isn't running."""
+        if self._host_pool is None or self._thread is None or self._stopping:
+            return False
+        self._kv_inject.put(entry)
+        return True
+
+    def _drain_kv_inject(self) -> None:
+        """Commit injected handoff entries to the host pool (engine
+        thread; called from _fill_slots before admission matching)."""
+        landed = False
+        while True:
+            try:
+                entry = self._kv_inject.get_nowait()
+            except queue.Empty:
+                break
+            pool = self._host_pool
+            if pool is not None and pool.put(entry):
+                landed = True
+                self.kv_injects += 1
+                self.flight.record(
+                    "kv_inject", rid=entry.rid, tokens=entry.cut,
+                    bytes=entry.nbytes,
+                )
+            # a refused entry (pool shrunk below its size) just drops:
+            # the request it fed recomputes its prefill, byte-identically
+        if landed:
+            self._publish_memory_state()
+
+    def _export_kv_handoff(self, slot: int, sl: _Slot):  # acp: kv-seam
+        """Extract a finishing export_kv request's prompt KV into a
+        :class:`HostKVEntry` (the disaggregation handoff unit) — the same
+        page-aligned rows-[0, cut) extraction ``_swap_out`` performs, but
+        attached to the result instead of this engine's own pool. Returns
+        None (caller degrades to no handoff) for truncated prompts, dedup
+        followers, or too few written rows."""
+        req = sl.request
+        if req.truncated or sl.share_of is not None:
+            return None
+        rows = int(self._seq_lens[slot])
+        row = self._full_row(req)
+        cut = min(rows, len(row) - 1)  # strict prefix: decode must model >= 1
+        if self.kv_layout == "paged":
+            cut = (cut // self.page_size) * self.page_size
+        if cut < self._swap_min_rows():
+            return None
+        from ..ops.paged import HostKVEntry
+
+        t0 = time.monotonic()
+        if self.kv_layout == "paged":
+            out = self._extract_pages(self._slot_pages[slot][: cut // self.page_size])
+            out = {name: a[:, :cut] for name, a in out.items()}
+        else:
+            out = self._extract_rows(slot, cut)
+        entry = HostKVEntry(
+            rid=f"handoff-{req.rid}", tokens=tuple(row[:cut]),
+            k=out["k"], v=out["v"],
+            k_scale=out.get("ks"), v_scale=out.get("vs"),
+        )
+        self.flight.record(
+            "handoff_export", rid=req.rid, slot=slot, tokens=cut,
+            bytes=entry.nbytes, stall_s=round(time.monotonic() - t0, 6),
+        )
+        return entry
 
     def _swap_min_rows(self) -> int:
         """Rows below this aren't worth a host round trip. One page (the

@@ -55,6 +55,26 @@ Engine sites (see ``engine/engine.py``):
   decay without perturbing outputs — the accept op always emits the
   verified model token, never the draft.
 
+Fleet sites (see ``fleet/router.py`` and ``engine/engine.py``, pool
+failover + disaggregation stress):
+
+- ``fleet.replica_crash`` — crash the engine loop of ONE named replica in
+  a pool: arm with ``replica="<fleet_replica_id>"`` (and optionally
+  ``after_steps=N`` decode steps so it lands mid-decode). The ``match``
+  filter keeps sibling engines in the same process alive — only the named
+  replica raises; the router fails its in-flight + queued work over to
+  survivors through the normal resubmission path. Armed without
+  ``replica=``, the first fleet-registered engine loop to check fires.
+- ``fleet.handoff_error`` — drop the next ``times=N`` prefill→decode
+  handoff entries between export and inject, as if the wire transfer
+  failed: the decode replica never sees the entry and runs a full local
+  prefill instead. Deterministic and graceful — disaggregation is an
+  optimization, so output stays byte-identical, only TTFT pays.
+- ``fleet.route_stale`` — treat the next ``times=N`` affinity-map hits as
+  stale (the mapped replica evicted the persona / restarted): the router
+  counts a miss, falls back to least-loaded, and re-homes the key —
+  the graceful path a real eviction or replica restart exercises.
+
 Tool-execution sites (see ``controllers/toolcall.py``, overlapped tool
 execution stress):
 
@@ -74,6 +94,7 @@ from __future__ import annotations
 
 import os
 import threading
+from typing import Optional
 
 
 class FaultInjector:
@@ -111,14 +132,25 @@ class FaultInjector:
         with self._lock:
             return site in self._armed
 
-    def pop(self, site: str, steps: int = 0):
+    def pop(self, site: str, steps: int = 0, match: Optional[dict] = None):
         """Consume one firing of ``site`` if armed and due; returns the spec
         dict (or None). Call sites guard with ``FAULTS.enabled`` first so
-        the disabled path costs one attribute read."""
+        the disabled path costs one attribute read.
+
+        ``match`` scopes a fault to a specific call site without consuming
+        the budget elsewhere: for every key present in BOTH ``match`` and
+        the armed spec, the values must be equal or the pop is a no-op
+        (e.g. ``fleet.replica_crash`` armed with ``replica="r1"`` fires
+        only in the engine whose ``fleet_replica_id`` is ``"r1"``; a spec
+        armed without the key fires at any matching site)."""
         with self._lock:
             spec = self._armed.get(site)
             if spec is None or steps < spec["after_steps"]:
                 return None
+            if match:
+                for k, v in match.items():
+                    if k in spec and spec[k] != v:
+                        return None
             spec["times"] -= 1
             if spec["times"] <= 0:
                 del self._armed[site]

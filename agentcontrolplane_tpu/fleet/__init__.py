@@ -1,0 +1,30 @@
+"""Fleet tier: a pool of Engine replicas behind one submit surface.
+
+``FleetRouter`` (router.py) duck-types the Engine's client surface
+(``submit`` / ``cancel`` / ``ensure_running`` / ``stats`` / ``tokenizer``)
+over a :class:`FleetPool` (pool.py) of lease-registered replicas:
+
+- **cache-affinity routing** — persona / system-prompt hash → the replica
+  whose prefix cache or host-KV tier has it hot; cold keys fall back to
+  least-loaded by queue depth + goodput.
+- **pool-wide shed** — a replica that sheds (bounded admission, PR 4) is
+  skipped; when every live replica sheds, the overload propagates with its
+  Retry-After intact.
+- **lease failover** — each replica holds a ``fleet-replica-<id>`` lease
+  (kernel/lease.py); a crashed replica's in-flight + queued work resubmits
+  to survivors exactly-once (stream dedupe makes retried streaming
+  byte-identical), and a survivor adopts the dead lease (fencing epoch).
+- **prefill/decode disaggregation** — a designated prefill replica runs
+  chunked prefill, its prompt KV rides out as a ``HostKVEntry``
+  (``submit(export_kv=True)``), and the decode replica restores it through
+  ``inject_host_kv`` + the existing PREFILLING restore path.
+
+See docs/fleet.md. Fleet code consumes ONLY public engine surfaces —
+acplint's thread-ownership pass flags ``engine._*`` reaches here exactly
+like it does in ``server/``.
+"""
+
+from .pool import FleetPool, FleetReplica
+from .router import FleetRouter, persona_affinity_key
+
+__all__ = ["FleetPool", "FleetReplica", "FleetRouter", "persona_affinity_key"]

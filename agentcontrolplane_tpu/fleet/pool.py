@@ -1,0 +1,179 @@
+"""Replica registry + lease registration for the fleet tier.
+
+Each Engine joins the pool under a ``fleet-replica-<id>`` lease
+(kernel/lease.py) renewed by a shared :class:`~agentcontrolplane_tpu.kernel
+.lease.LeaseHeartbeat`. The lease is the pool's liveness truth: a crashed
+process stops renewing, the lease expires, and a survivor adopts it
+(epoch bump = fencing token) as part of failover — the same
+create-or-adopt-expired semantics the task controller uses for its
+in-flight task locks. In-process pools (tests, single-host serving) get
+the identical coordination trace a multi-process deployment would,
+because the Store is the shared substrate either way.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..kernel.lease import LeaseHeartbeat, holder as lease_holder, try_acquire_epoch
+from ..kernel.store import Store
+from ..observability.metrics import REGISTRY
+
+LEASE_PREFIX = "fleet-replica-"
+
+
+@dataclass
+class FleetReplica:
+    """One pool member: an Engine plus its registration state. ``role``
+    scopes routing — ``"prefill"`` replicas never take decode traffic
+    (they serve the disaggregation handoff's prefill leg); ``"decode"``
+    replicas are skipped as handoff prefill sources; ``"both"`` does
+    either. ``affinity_keys`` is the router-maintained set of persona
+    keys currently homed on this replica (len() is the stats surface)."""
+
+    id: str
+    engine: object
+    role: str = "both"  # "both" | "prefill" | "decode"
+    alive: bool = True
+    lease_name: str = ""
+    epoch: int = 0
+    affinity_keys: set = field(default_factory=set)
+
+    def serves_decode(self) -> bool:
+        return self.role in ("both", "decode")
+
+    def serves_prefill(self) -> bool:
+        return self.role in ("both", "prefill")
+
+
+class FleetPool:
+    """Thread-safe replica registry. Registration acquires the replica's
+    lease and tags the engine with its ``fleet_replica_id`` (the handle
+    the ``fleet.replica_crash`` fault matches on); ``mark_dead`` is the
+    single idempotent death path — it releases the lease immediately so a
+    survivor can adopt without waiting out the TTL."""
+
+    def __init__(
+        self,
+        store: Optional[Store] = None,
+        identity: Optional[str] = None,
+        namespace: str = "default",
+        lease_ttl: float = 30.0,
+        heartbeat_interval: float = 1.0,
+    ) -> None:
+        self.store = store if store is not None else Store()
+        self.identity = identity or f"fleet-{os.getpid()}"
+        self.namespace = namespace
+        self.lease_ttl = float(lease_ttl)
+        self._lock = threading.RLock()
+        self._replicas: dict[str, FleetReplica] = {}
+        self.heartbeat = LeaseHeartbeat(
+            self.store,
+            interval=heartbeat_interval,
+            ttl=self.lease_ttl,
+            namespace=namespace,
+            on_lost=self._on_lease_lost,
+        )
+
+    # -- membership -------------------------------------------------------
+
+    def register(self, replica_id: str, engine, role: str = "both") -> FleetReplica:
+        """Join ``engine`` to the pool under its lease. Raises when the
+        lease is held live by another identity (two pools fighting over
+        one replica id is a deployment error, not a retry)."""
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode, got {role!r}")
+        lease_name = LEASE_PREFIX + replica_id
+        epoch = self.heartbeat.add(lease_name, self.identity)
+        if epoch is None:
+            raise RuntimeError(
+                f"fleet replica lease {lease_name!r} is held by another "
+                "identity — replica ids must be unique per pool"
+            )
+        engine.fleet_replica_id = replica_id
+        replica = FleetReplica(
+            id=replica_id, engine=engine, role=role,
+            lease_name=lease_name, epoch=epoch,
+        )
+        with self._lock:
+            self._replicas[replica_id] = replica
+        self.heartbeat.start()
+        self._publish_gauge()
+        return replica
+
+    def mark_dead(self, replica_id: str) -> Optional[FleetReplica]:
+        """Idempotent death: returns the replica on the FIRST call (the
+        caller owns the one-time failover side effects — lease takeover,
+        affinity re-homing), None when already dead or unknown."""
+        with self._lock:
+            replica = self._replicas.get(replica_id)
+            if replica is None or not replica.alive:
+                return None
+            replica.alive = False
+        # release now (not just stop renewing): a survivor adopts without
+        # waiting out the TTL; the epoch bumps on adoption either way
+        self.heartbeat.remove(replica.lease_name, release_lease=True)
+        self._publish_gauge()
+        return replica
+
+    def adopt_lease(self, dead: FleetReplica, survivor: FleetReplica) -> Optional[int]:
+        """Survivor takes over the dead replica's lease — the fencing
+        trace of failover: the bumped epoch proves any token minted under
+        the dead holder is stale. Returns the new epoch (None when the
+        lease is live under someone else)."""
+        return try_acquire_epoch(
+            self.store, dead.lease_name, self.identity + "/" + survivor.id,
+            self.namespace, self.lease_ttl,
+        )
+
+    def _on_lease_lost(self, lease_name: str) -> None:
+        # deposed while still running (another holder adopted our lease):
+        # fencing says we must stop serving under that identity
+        with self._lock:
+            replica = next(
+                (r for r in self._replicas.values() if r.lease_name == lease_name),
+                None,
+            )
+        if replica is not None:
+            self.mark_dead(replica.id)
+
+    # -- read side --------------------------------------------------------
+
+    def replicas(self) -> list[FleetReplica]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def get(self, replica_id: str) -> Optional[FleetReplica]:
+        with self._lock:
+            return self._replicas.get(replica_id)
+
+    def alive(self) -> list[FleetReplica]:
+        with self._lock:
+            return [r for r in self._replicas.values() if r.alive]
+
+    def lease_holder(self, replica: FleetReplica) -> Optional[str]:
+        return lease_holder(self.store, replica.lease_name, self.namespace)
+
+    def _publish_gauge(self) -> None:
+        REGISTRY.gauge_set(
+            "acp_fleet_replicas", float(len(self.alive())),
+            help="live engine replicas registered in the fleet pool "
+            "(lease-backed membership; a crashed or deposed replica drops "
+            "out on mark_dead)",
+        )
+
+    def stop(self, stop_engines: bool = False) -> None:
+        """Leave the pool cleanly: stop the heartbeat and release every
+        lease (an explicit stop is not a crash — no takeover theater)."""
+        self.heartbeat.stop()
+        for replica in self.replicas():
+            self.heartbeat.remove(replica.lease_name, release_lease=True)
+            if stop_engines:
+                try:
+                    replica.engine.stop()
+                except Exception:
+                    pass
+        self._publish_gauge()

@@ -1,0 +1,690 @@
+"""FleetRouter: one Engine-shaped submit surface over the replica pool.
+
+Routing policy (``policy="affinity"``, the default):
+
+1. **Affinity hit** — the request's persona key (system-prompt hash,
+   :func:`persona_affinity_key`; callers without one get a prompt-prefix
+   hash) maps to a live replica → route there: its prefix cache / host-KV
+   tier has the persona hot, so the prefill is suffix-only.
+2. **Cold key** — fall back to least-loaded: queue depth + occupied slots
+   from each replica's ``stats()``, goodput ratio (the ``/v1/engine/perf``
+   signal) breaking ties toward the replica converting dispatches into
+   tokens. The chosen replica becomes the key's new home.
+3. **Shed** — a replica that sheds (bounded admission) is skipped and the
+   next candidate tried; when every live replica sheds, the overload
+   propagates to the caller with its Retry-After intact (pool-wide
+   backpressure, not silent queueing).
+
+Failover: an attempt that dies with the engine (``engine crashed`` /
+``engine stopped`` / ``engine is not running``) marks the replica dead,
+has a survivor adopt its lease (fencing epoch bump), and resubmits the
+request to a survivor. Greedy decoding makes the retry deterministic, and
+the per-submission stream-dedupe counters suppress already-delivered
+tokens/tool-calls — the caller observes every token exactly once,
+byte-identical to an uncrashed run.
+
+Disaggregation (``handoff_min_tokens > 0`` + a ``role="prefill"``
+replica): long prompts prefill on the designated prefill replica
+(``submit(export_kv=True)``, chunked prefill to a page-aligned cut), the
+extracted ``HostKVEntry`` (int8 + scale twins when quantized) is injected
+into the decode replica's host-KV tier, and the decode submission restores
+it through the existing PREFILLING restore path — bit-exact by
+construction, and every failure (export refused, ``fleet.handoff_error``,
+pool eviction) degrades to a full local prefill with identical output.
+
+All decisions land in the router's own flight recorder (``route``,
+``route_stale``, ``shed_skip``, ``failover``, ``replica_dead``,
+``lease_takeover``, ``handoff_start`` / ``handoff_done`` /
+``handoff_error``) so pool behavior is debuggable from timelines —
+``/v1/fleet`` and ``acp-tpu fleet`` read :meth:`FleetRouter.stats`.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+import uuid
+from concurrent.futures import Future, InvalidStateError
+from dataclasses import replace as _dc_replace
+from typing import Optional
+
+from ..engine.engine import EngineOverloadedError, SamplingParams
+from ..faults import FAULTS
+from ..observability.flight import FlightRecorder
+from ..observability.metrics import REGISTRY
+from .pool import FleetPool, FleetReplica
+
+# engine-failure signatures (the public error taxonomy of Engine.submit
+# futures) that mean THE REPLICA died, not the request
+_REPLICA_DEAD_MARKERS = ("engine crashed", "engine stopped", "engine is not running")
+
+
+def persona_affinity_key(messages) -> str:
+    """Stable affinity key for a conversation: the hash of its system
+    prompt(s) — the agent persona — which is exactly the prefix the
+    replica's prefix cache / host-KV tier can serve hot across turns.
+    Falls back to the first message when no system message exists."""
+    def _field(m, name):
+        if isinstance(m, dict):
+            return m.get(name) or ""
+        return getattr(m, name, None) or ""
+
+    sys_txt = "".join(
+        _field(m, "content") for m in messages if _field(m, "role") == "system"
+    )
+    if not sys_txt and messages:
+        sys_txt = _field(messages[0], "content")
+    return hashlib.sha1(sys_txt.encode("utf-8", "replace")).hexdigest()[:16]
+
+
+class _Submission:
+    """Router-side request state: the caller-facing future plus the
+    dedupe counters that make a failed-over stream exactly-once. One live
+    attempt at a time; attempt callbacks run on that attempt's engine
+    thread, and attempts are strictly sequential (the next starts from
+    the previous future's done-callback), so the counters need no lock."""
+
+    __slots__ = (
+        "rid", "prompt", "sampling", "user_on_tokens", "user_on_tool_call",
+        "park", "trace", "deadline", "affinity_key", "future", "admitted",
+        "attempts", "failovers", "tokens_delivered", "tool_calls_delivered",
+        "replica_id", "engine_future", "tried", "cancelled",
+    )
+
+    def __init__(
+        self, rid, prompt, sampling, on_tokens, on_tool_call, park, trace,
+        timeout_s, affinity_key,
+    ):
+        self.rid = rid
+        self.prompt = prompt
+        self.sampling = sampling
+        self.user_on_tokens = on_tokens
+        self.user_on_tool_call = on_tool_call
+        self.park = park
+        self.trace = trace
+        self.deadline = (time.monotonic() + timeout_s) if timeout_s else None
+        self.affinity_key = affinity_key
+        self.future: Future = Future()
+        self.future.rid = rid  # type: ignore[attr-defined]
+        self.admitted: Future = Future()
+        self.future.admitted = self.admitted  # type: ignore[attr-defined]
+        self.future.early_tool_calls = []  # type: ignore[attr-defined]
+        self.attempts = 0
+        self.failovers = 0
+        self.tokens_delivered = 0
+        self.tool_calls_delivered = 0
+        self.replica_id: Optional[str] = None
+        self.engine_future: Optional[Future] = None
+        self.tried: set[str] = set()
+        self.cancelled = False
+
+    def remaining_timeout(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return max(0.1, self.deadline - time.monotonic())
+
+    def attempt_on_tokens(self):
+        """Per-attempt stream callback: suppress the first
+        ``tokens_delivered`` tokens (a failover retry regenerates the
+        whole output; greedy determinism makes the replayed prefix
+        identical), deliver only what the caller hasn't seen."""
+        if self.user_on_tokens is None:
+            return None
+        sub = self
+        state = {"seen": 0}
+
+        def on_tokens(toks):
+            s = state["seen"]
+            state["seen"] = s + len(toks)
+            skip = max(0, sub.tokens_delivered - s)
+            fresh = toks[skip:]
+            if fresh:
+                sub.tokens_delivered = s + len(toks)
+                sub.user_on_tokens(fresh)
+
+        return on_tokens
+
+    def attempt_on_tool_call(self):
+        """Tool-call indices are dense and deterministic under greedy
+        decoding, so a replayed call is exactly 'index already
+        delivered'."""
+        if self.user_on_tool_call is None:
+            return None
+        sub = self
+
+        def on_tool_call(index, call):
+            if index < sub.tool_calls_delivered:
+                return
+            sub.tool_calls_delivered = index + 1
+            sub.user_on_tool_call(index, call)
+
+        return on_tool_call
+
+
+class FleetRouter:
+    """Engine-duck-typed router over a :class:`FleetPool` — drop it
+    anywhere a single Engine handle goes (``OperatorOptions.engine``,
+    ``TPUEngineClient``, the REST chat path)."""
+
+    # TPUEngineClient / rest.py feature-detect this to pass affinity_key
+    supports_affinity = True
+
+    def __init__(
+        self,
+        pool: Optional[FleetPool] = None,
+        store=None,
+        *,
+        policy: str = "affinity",
+        identity: Optional[str] = None,
+        namespace: str = "default",
+        lease_ttl: float = 30.0,
+        heartbeat_interval: float = 1.0,
+        handoff_min_tokens: int = 0,
+        failover_max: int = 2,
+        flight: Optional[FlightRecorder] = None,
+    ) -> None:
+        if policy not in ("affinity", "round_robin"):
+            raise ValueError(f"policy must be affinity|round_robin, got {policy!r}")
+        self.pool = pool if pool is not None else FleetPool(
+            store=store, identity=identity, namespace=namespace,
+            lease_ttl=lease_ttl, heartbeat_interval=heartbeat_interval,
+        )
+        self.policy = policy
+        # disaggregation threshold: prompts at/over this many tokens (and a
+        # live role="prefill" replica) prefill remotely; 0 disables
+        self.handoff_min_tokens = int(handoff_min_tokens)
+        self.failover_max = int(failover_max)
+        self.flight = flight if flight is not None else FlightRecorder()
+        self._lock = threading.Lock()
+        self._affinity: dict[str, str] = {}  # persona key -> replica id
+        self._inflight: dict[str, _Submission] = {}
+        self._rr = 0  # round-robin cursor (and least-loaded tiebreak)
+        # counters: public ints (racy-but-safe reads), bumped under _lock
+        self.routed = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.failovers = 0
+        self.sheds_skipped = 0
+        self.handoffs = 0
+        self.handoff_errors = 0
+        self.handoff_bytes = 0
+
+    # -- pool management --------------------------------------------------
+
+    def add_replica(self, replica_id: str, engine, role: str = "both") -> FleetReplica:
+        replica = self.pool.register(replica_id, engine, role)
+        self.flight.record(
+            "replica_join", replica=replica_id, role=role, epoch=replica.epoch
+        )
+        return replica
+
+    @property
+    def tokenizer(self):
+        replicas = self.pool.replicas()
+        if not replicas:
+            raise RuntimeError("fleet pool has no replicas")
+        return replicas[0].engine.tokenizer
+
+    def ensure_running(self) -> bool:
+        """True when at least one LIVE replica serves. Dead-marked
+        replicas are NOT revived here — failover routed their work to
+        survivors, and resurrecting a deposed replica behind its bumped
+        lease epoch is an operator decision (re-register it)."""
+        ok = False
+        for replica in self.pool.replicas():
+            if not replica.alive:
+                continue
+            try:
+                ok = bool(replica.engine.ensure_running()) or ok
+            except Exception:
+                pass
+        return ok
+
+    def stop(self, stop_engines: bool = False) -> None:
+        self.pool.stop(stop_engines=stop_engines)
+
+    # -- submit surface ---------------------------------------------------
+
+    def submit(
+        self,
+        prompt,
+        sampling: Optional[SamplingParams] = None,
+        on_tokens=None,
+        timeout_s: Optional[float] = None,
+        on_tool_call=None,
+        park: bool = False,
+        trace=None,
+        affinity_key: Optional[str] = None,
+        _prewarm: bool = False,
+    ) -> Future:
+        """Thread-safe; returns a Future[GenerationResult] with the same
+        ``rid`` / ``admitted`` / ``early_tool_calls`` attributes an
+        Engine future carries. ``affinity_key`` (optional) names the
+        persona for cache-affinity routing; without one a prompt-prefix
+        hash stands in."""
+        tokens = (
+            self.tokenizer.encode(prompt) if isinstance(prompt, str) else list(prompt)
+        )
+        key = affinity_key or hashlib.sha1(
+            repr(tokens[:64]).encode()
+        ).hexdigest()[:16]
+        sub = _Submission(
+            rid=uuid.uuid4().hex[:8], prompt=tokens,
+            sampling=sampling or SamplingParams(), on_tokens=on_tokens,
+            on_tool_call=on_tool_call, park=park, trace=trace,
+            timeout_s=timeout_s, affinity_key=key,
+        )
+        with self._lock:
+            self._inflight[sub.rid] = sub
+
+        def _prune(_f):
+            with self._lock:
+                self._inflight.pop(sub.rid, None)
+
+        sub.future.add_done_callback(_prune)
+        self.flight.record(
+            "submit", rid=sub.rid, prompt_tokens=len(tokens), key=key,
+            timeout_s=timeout_s,
+        )
+        self._dispatch(sub, allow_handoff=True)
+        return sub.future
+
+    def cancel(self, future: Future) -> None:
+        """Abandon a router submission (keyed on ``future.rid``, like
+        Engine.cancel): the live attempt is cancelled on its replica and
+        no failover resubmission will fire for it."""
+        rid = getattr(future, "rid", None)
+        with self._lock:
+            sub = self._inflight.get(rid)
+        if sub is None:
+            return
+        sub.cancelled = True
+        engine_future, replica = sub.engine_future, self.pool.get(sub.replica_id)
+        if engine_future is not None and replica is not None:
+            try:
+                replica.engine.cancel(engine_future)
+            except Exception:
+                pass
+
+    # -- routing ----------------------------------------------------------
+
+    def _route(self, sub: _Submission) -> Optional[FleetReplica]:
+        """Pick the next replica for ``sub`` (None = no candidates left).
+        Affinity map hit → the hot replica, unless ``fleet.route_stale``
+        forces the eviction path; miss → least-loaded (or round-robin
+        under that policy), which re-homes the key."""
+        candidates = [
+            r for r in self.pool.replicas()
+            if r.alive and r.serves_decode() and r.id not in sub.tried
+        ]
+        if not candidates:
+            return None
+        key = sub.affinity_key
+        chosen: Optional[FleetReplica] = None
+        hit = False
+        if self.policy == "affinity" and key:
+            with self._lock:
+                mapped = self._affinity.get(key)
+            cand = next((r for r in candidates if r.id == mapped), None)
+            if cand is not None:
+                if FAULTS.enabled and FAULTS.pop("fleet.route_stale") is not None:
+                    # forced staleness: the mapped replica "evicted" the
+                    # persona — count a miss, re-home below
+                    self.flight.record(
+                        "route_stale", rid=sub.rid, replica=cand.id, key=key
+                    )
+                    with self._lock:
+                        self._affinity.pop(key, None)
+                    cand.affinity_keys.discard(key)
+                else:
+                    chosen, hit = cand, True
+        if chosen is None:
+            if self.policy == "round_robin":
+                with self._lock:
+                    i, self._rr = self._rr, self._rr + 1
+                chosen = candidates[i % len(candidates)]
+            else:
+                chosen = min(candidates, key=self._load_score)
+        with self._lock:
+            self.routed += 1
+            if self.policy == "affinity" and key:
+                self._affinity[key] = chosen.id
+                if hit:
+                    self.affinity_hits += 1
+                else:
+                    self.affinity_misses += 1
+        chosen.affinity_keys.add(key)
+        if self.policy == "affinity" and key:
+            if hit:
+                REGISTRY.counter_add(
+                    "acp_fleet_route_affinity_hits_total", 1.0,
+                    help="requests routed to the replica whose prefix "
+                    "cache / host-KV tier already holds their persona",
+                )
+            else:
+                REGISTRY.counter_add(
+                    "acp_fleet_route_affinity_misses_total", 1.0,
+                    help="requests whose persona had no live home — "
+                    "routed least-loaded and re-homed there",
+                )
+        self.flight.record(
+            "route", rid=sub.rid, replica=chosen.id, affinity_hit=hit,
+            key=key, attempt=sub.attempts + 1,
+        )
+        return chosen
+
+    def _load_score(self, replica: FleetReplica):
+        """Least-loaded signal: queue depth + occupied slots, goodput
+        ratio breaking ties (all public stats surfaces — the same numbers
+        ``/v1/engine/perf`` and ``/v1/engine`` serve)."""
+        try:
+            st = replica.engine.stats()
+        except Exception:
+            return (float("inf"), 0.0, replica.id)
+        load = (
+            2 * int(st.get("waiting", 0))
+            + int(st.get("active_slots", 0))
+            + int(st.get("prefilling_slots", 0))
+        )
+        perf = st.get("perf") or {}
+        goodput = float((perf.get("goodput") or {}).get("ratio", 1.0))
+        return (load, -goodput, replica.id)
+
+    # -- dispatch / failover ----------------------------------------------
+
+    def _dispatch(self, sub: _Submission, allow_handoff: bool, last_exc=None) -> None:
+        if sub.future.done():
+            return
+        replica = self._route(sub)
+        if replica is None:
+            alive = self.pool.alive()
+            if not alive:
+                err = last_exc if last_exc is not None else RuntimeError(
+                    "no live replicas in the fleet pool"
+                )
+            else:
+                # every live replica shed: propagate the overload with the
+                # last Retry-After so callers back off pool-wide
+                retry = getattr(last_exc, "retry_after_s", 5.0) or 5.0
+                err = EngineOverloadedError(
+                    f"all {len(alive)} fleet replicas shed this request; "
+                    "retry later", retry_after_s=retry,
+                )
+            if not sub.future.done():
+                try:
+                    sub.future.set_exception(err)
+                except InvalidStateError:
+                    pass
+            return
+        prefill = self._handoff_source(sub, replica) if allow_handoff else None
+        if prefill is not None:
+            self._dispatch_disaggregated(sub, replica, prefill)
+        else:
+            self._submit_to(sub, replica)
+
+    def _submit_to(self, sub: _Submission, replica: FleetReplica) -> None:
+        sub.attempts += 1
+        sub.replica_id = replica.id
+        engine_future = replica.engine.submit(
+            list(sub.prompt), sub.sampling,
+            on_tokens=sub.attempt_on_tokens(),
+            timeout_s=sub.remaining_timeout(),
+            on_tool_call=sub.attempt_on_tool_call(),
+            park=sub.park, trace=sub.trace,
+        )
+        sub.engine_future = engine_future
+        # the live attempt's early-call list is the caller's view; a
+        # failover retry regenerates the full list (greedy determinism)
+        sub.future.early_tool_calls = getattr(  # type: ignore[attr-defined]
+            engine_future, "early_tool_calls", []
+        )
+        admitted = getattr(engine_future, "admitted", None)
+        if admitted is not None:
+            def _chain_admitted(f):
+                if f.cancelled():
+                    return
+                try:
+                    sub.admitted.set_result(True)
+                except InvalidStateError:
+                    pass
+
+            admitted.add_done_callback(_chain_admitted)
+        engine_future.add_done_callback(
+            lambda f: self._on_attempt_done(sub, replica, f)
+        )
+
+    def _on_attempt_done(self, sub: _Submission, replica: FleetReplica, f: Future) -> None:
+        if sub.future.done():
+            return
+        if f.cancelled():
+            sub.future.cancel()
+            return
+        exc = f.exception()
+        if exc is None:
+            result = f.result()
+            self.flight.record(
+                "finish", rid=sub.rid, replica=replica.id,
+                reason=result.finish_reason, tokens=len(result.tokens),
+                attempts=sub.attempts,
+            )
+            self.flight.discard(sub.rid)
+            if not sub.admitted.done():
+                try:
+                    sub.admitted.set_result(True)
+                except InvalidStateError:
+                    pass
+            try:
+                sub.future.set_result(result)
+            except InvalidStateError:
+                pass
+            return
+        if isinstance(exc, EngineOverloadedError):
+            # this replica shed — skip it and try the rest of the pool
+            with self._lock:
+                self.sheds_skipped += 1
+            self.flight.record(
+                "shed_skip", rid=sub.rid, replica=replica.id,
+                retry_after_s=getattr(exc, "retry_after_s", None),
+            )
+            sub.tried.add(replica.id)
+            self._dispatch(sub, allow_handoff=False, last_exc=exc)
+            return
+        if isinstance(exc, RuntimeError) and any(
+            m in str(exc) for m in _REPLICA_DEAD_MARKERS
+        ):
+            self._failover(sub, replica, exc)
+            return
+        # DeadlineExceeded and everything else: the request's own failure
+        try:
+            sub.future.set_exception(exc)
+        except InvalidStateError:
+            pass
+
+    def _failover(self, sub: _Submission, replica: FleetReplica, exc) -> None:
+        dead = self.pool.mark_dead(replica.id)
+        if dead is not None:
+            # FIRST observer of this death owns the one-time side effects
+            self.flight.record("replica_dead", replica=replica.id, error=str(exc))
+            with self._lock:
+                for k in [k for k, v in self._affinity.items() if v == replica.id]:
+                    del self._affinity[k]
+            survivor = next((r for r in self.pool.replicas() if r.alive), None)
+            if survivor is not None:
+                epoch = self.pool.adopt_lease(dead, survivor)
+                if epoch is not None:
+                    self.flight.record(
+                        "lease_takeover", replica=survivor.id,
+                        lease=dead.lease_name, epoch=epoch,
+                    )
+        sub.tried.add(replica.id)
+        if sub.cancelled or sub.future.done():
+            return
+        if sub.failovers >= self.failover_max:
+            try:
+                sub.future.set_exception(exc)
+            except InvalidStateError:
+                pass
+            return
+        sub.failovers += 1
+        with self._lock:
+            self.failovers += 1
+        REGISTRY.counter_add(
+            "acp_fleet_failovers_total", 1.0,
+            help="requests resubmitted to a surviving replica after their "
+            "replica crashed or stopped (exactly-once via stream dedupe)",
+        )
+        self.flight.record(
+            "failover", rid=sub.rid, from_replica=replica.id,
+            delivered_tokens=sub.tokens_delivered,
+        )
+        self._dispatch(sub, allow_handoff=False, last_exc=exc)
+
+    # -- prefill/decode disaggregation ------------------------------------
+
+    def _handoff_source(self, sub: _Submission, decode: FleetReplica):
+        """The designated prefill replica for this request, or None when
+        disaggregation doesn't apply (disabled, short prompt, parked
+        continuation, no live prefill replica, or the decode target IS
+        the prefill replica)."""
+        if self.handoff_min_tokens <= 0 or sub.park:
+            return None
+        if len(sub.prompt) < self.handoff_min_tokens:
+            return None
+        return next(
+            (
+                r for r in self.pool.replicas()
+                if r.alive and r.role == "prefill" and r.id != decode.id
+                and r.id not in sub.tried
+            ),
+            None,
+        )
+
+    def _dispatch_disaggregated(
+        self, sub: _Submission, decode: FleetReplica, prefill: FleetReplica
+    ) -> None:
+        """Prefill leg on the designated replica (chunked prefill +
+        ``export_kv``), then inject the extracted entry into the decode
+        replica's host tier and run the decode leg there. The decode leg
+        goes through :meth:`_submit_to` unchanged, so failover and shed
+        handling apply to it exactly like a direct submission."""
+        self.flight.record(
+            "handoff_start", rid=sub.rid, prefill=prefill.id,
+            decode=decode.id, prompt_tokens=len(sub.prompt),
+        )
+        prefill_future = prefill.engine.submit(
+            list(sub.prompt),
+            _dc_replace(sub.sampling, max_tokens=1),
+            timeout_s=sub.remaining_timeout(),
+            export_kv=True,
+        )
+
+        def _prefill_done(f: Future) -> None:
+            if sub.future.done():
+                return
+            entry = None
+            error = None
+            if f.cancelled():
+                error = "cancelled"
+            elif f.exception() is not None:
+                error = str(f.exception())
+            else:
+                entry = f.result().kv_handoff
+                if entry is None:
+                    error = "export refused"
+            if entry is not None and FAULTS.enabled and FAULTS.pop(
+                "fleet.handoff_error"
+            ) is not None:
+                entry, error = None, "injected wire failure"
+            if entry is not None and decode.engine.inject_host_kv(entry):
+                with self._lock:
+                    self.handoffs += 1
+                    self.handoff_bytes += entry.nbytes
+                REGISTRY.counter_add(
+                    "acp_fleet_handoffs_total", 1.0,
+                    help="prefill->decode disaggregation handoffs whose KV "
+                    "entry landed in the decode replica's host tier",
+                )
+                REGISTRY.counter_add(
+                    "acp_fleet_handoff_bytes_total", float(entry.nbytes),
+                    help="bytes of KV (int8 + scale twins when quantized) "
+                    "shipped prefill->decode across the pool",
+                )
+                self.flight.record(
+                    "handoff_done", rid=sub.rid, decode=decode.id,
+                    tokens=entry.cut, bytes=entry.nbytes,
+                )
+            else:
+                with self._lock:
+                    self.handoff_errors += 1
+                self.flight.record(
+                    "handoff_error", rid=sub.rid, prefill=prefill.id,
+                    error=error or "inject refused",
+                )
+            # decode leg regardless: the handoff is an optimization — a
+            # missing entry just means a full local prefill, same output
+            self._submit_to(sub, decode)
+
+        prefill_future.add_done_callback(_prefill_done)
+
+    # -- status surface ---------------------------------------------------
+
+    def stats(self) -> dict:  # acp: cross-thread
+        """The /v1/fleet payload (Engine.stats()-shaped: plain dict of
+        ints/strings built from public counters and each replica's own
+        declared cross-thread surfaces)."""
+        replicas = []
+        for r in self.pool.replicas():
+            st = {}
+            if r.alive:
+                try:
+                    st = r.engine.stats()
+                except Exception:
+                    st = {}
+            perf = st.get("perf") or {}
+            replicas.append({
+                "id": r.id,
+                "role": r.role,
+                "alive": r.alive,
+                "lease": {
+                    "name": r.lease_name,
+                    "holder": self.pool.lease_holder(r),
+                    "epoch": r.epoch,
+                },
+                "queue_depth": st.get("waiting", 0),
+                "active_slots": st.get("active_slots", 0),
+                "prefilling_slots": st.get("prefilling_slots", 0),
+                "goodput_ratio": (perf.get("goodput") or {}).get("ratio"),
+                "affinity_keys": len(r.affinity_keys),
+                "host_kv_entries": (
+                    (st.get("memory") or {}).get("host_kv") or {}
+                ).get("entries", 0),
+            })
+        with self._lock:
+            routing = {
+                "policy": self.policy,
+                "routed": self.routed,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+                "affinity_keys": len(self._affinity),
+                "sheds_skipped": self.sheds_skipped,
+                "inflight": len(self._inflight),
+            }
+            failover = {
+                "failovers": self.failovers,
+                "failover_max": self.failover_max,
+                "replicas_dead": sum(1 for r in replicas if not r["alive"]),
+            }
+            handoff = {
+                "enabled": self.handoff_min_tokens > 0,
+                "min_tokens": self.handoff_min_tokens,
+                "handoffs": self.handoffs,
+                "errors": self.handoff_errors,
+                "bytes": self.handoff_bytes,
+            }
+        return {
+            "replicas": replicas,
+            "routing": routing,
+            "failover": failover,
+            "handoff": handoff,
+        }

@@ -70,6 +70,11 @@ class OperatorOptions:
     llm_probe: bool = True
     verify_channel_credentials: bool = True
     engine: object | None = None  # engine.Engine for provider: tpu
+    # fleet.FleetRouter: when set, the chat paths and the LLM client
+    # factory submit through the router (pool of engines) instead of a
+    # single engine; /v1/fleet serves its stats. The router duck-types
+    # the Engine submit surface, so everything downstream is unchanged.
+    fleet: object | None = None
     # Reconcile concurrency for the two hot controllers. A Task worker spends
     # almost all its time awaiting the LLM send, so the worker count bounds how
     # many requests the continuous-batching engine can see at once — 4 workers
@@ -113,12 +118,18 @@ class Operator:
         if isinstance(self.hl_factory, LocalHumanLayerClientFactory):
             self.human_backend = self.hl_factory.backend
         self.engine = self.options.engine
+        self.fleet = self.options.fleet
         if self.engine is not None:
             # flight-recorder OTLP linkage: finished requests' phase
             # windows export as child spans through the operator's tracer
             # (plain attribute replacement; None stays span-less)
             self.engine.flight.tracer = self.tracer  # type: ignore[attr-defined]
-        self.llm_factory = llm_factory or DefaultLLMClientFactory(engine=self.engine)
+        # the fleet router outranks a bare engine as the serving handle:
+        # it duck-types the submit surface, so the factory and the REST
+        # chat paths route pool-wide without knowing the difference
+        self.llm_factory = llm_factory or DefaultLLMClientFactory(
+            engine=self.fleet if self.fleet is not None else self.engine
+        )
 
         self.manager = Manager(
             self.store,
@@ -242,6 +253,8 @@ async def run_operator(options: OperatorOptions) -> None:
         await serve_until_signalled()
     finally:
         await op.stop()
+        if options.fleet is not None:
+            options.fleet.stop(stop_engines=True)  # type: ignore[attr-defined]
         engine = options.engine
         if engine is not None:
             engine.stop()  # type: ignore[attr-defined]

@@ -291,6 +291,7 @@ class RestServer:
         r.add_get("/v1/engine", self.engine_status)
         r.add_get("/v1/engine/perf", self.engine_perf)
         r.add_get("/v1/engine/flight", self.engine_flight)
+        r.add_get("/v1/fleet", self.fleet_status)
         r.add_get("/v1/requests/{rid}/timeline", self.request_timeline)
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
@@ -752,7 +753,9 @@ class RestServer:
         import time as _time
         import uuid as _uuid
 
-        engine = self.operator.engine
+        # the fleet router (when configured) IS the serving engine for the
+        # chat paths — same submit surface, pool-wide routing behind it
+        engine = getattr(self.operator, "fleet", None) or self.operator.engine
         if engine is None:
             return _json_error(503, "no TPU engine configured (run with --tpu-preset/--tpu-checkpoint)")
         from ..engine.engine import SamplingParams
@@ -830,14 +833,22 @@ class RestServer:
         # jit-compiles and allocates HBM). False = deliberately stopped.
         if not await asyncio.to_thread(engine.ensure_running):
             return _json_error(503, "TPU engine is stopped")
+        # fleet routing: name the conversation's persona so every turn of
+        # this agent lands on the replica holding its prefix hot
+        submit_extra = {}
+        if getattr(engine, "supports_affinity", False):
+            from ..fleet.router import persona_affinity_key
+
+            submit_extra["affinity_key"] = persona_affinity_key(messages)
         if stream:
             return await self._stream_chat(
-                request, engine, prompt, sampling, tools, body, timeout_s
+                request, engine, prompt, sampling, tools, body, timeout_s,
+                submit_extra=submit_extra,
             )
 
         from ..engine.engine import DeadlineExceededError, EngineOverloadedError
 
-        fut = engine.submit(prompt, sampling, timeout_s=timeout_s)
+        fut = engine.submit(prompt, sampling, timeout_s=timeout_s, **submit_extra)
         try:
             result = await _asyncio.wait_for(
                 _asyncio.wrap_future(fut), timeout=timeout_s
@@ -895,7 +906,7 @@ class RestServer:
         )
 
     async def _stream_chat(self, request, engine, prompt, sampling, tools, body,
-                           timeout_s: float = 600.0):
+                           timeout_s: float = 600.0, submit_extra=None):
         """SSE streaming (OpenAI chat.completion.chunk wire format): token
         deltas flow from the engine thread per decode block. With tools, the
         engine stream-parses the completion and each call is emitted as a
@@ -927,6 +938,7 @@ class RestServer:
             on_tokens=lambda ids: loop.call_soon_threadsafe(q.put_nowait, list(ids)),
             on_tool_call=_on_tool_call if tools else None,
             timeout_s=timeout_s,
+            **(submit_extra or {}),
         )
         if fut.done() and isinstance(fut.exception(), EngineOverloadedError):
             # shed before the stream opened: a plain 503 the client can
@@ -1180,6 +1192,19 @@ class RestServer:
                 rid=request.query.get("rid") or None,
             ),
         })
+
+    async def fleet_status(self, request: web.Request) -> web.Response:
+        """Pool status: per-replica row (role, liveness, lease holder +
+        fencing epoch, queue depth, goodput, homed affinity keys) plus the
+        router's routing/failover/handoff counters. stats() is the
+        router's declared cross-thread read surface, same contract as
+        Engine.stats()."""
+        fleet = getattr(self.operator, "fleet", None)
+        if fleet is None:
+            return _json_error(
+                503, "no fleet router configured (single-engine deployment)"
+            )
+        return web.json_response({"configured": True, **fleet.stats()})
 
     async def request_timeline(self, request: web.Request) -> web.Response:
         """One request's full lifecycle: every recorded scheduler decision

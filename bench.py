@@ -71,7 +71,14 @@ ACP_BENCH_QUANT=1 / ACP_BENCH_QUANT_PROMPT / ACP_BENCH_QUANT_TASKS /
 ACP_BENCH_QUANT_BASE_TASKS (quantized-serving fixture: effective
 concurrent slots bf16 vs int8 KV at a fixed HBM byte budget, bar >=
 1.5x, plus the byte-identity-relaxed accuracy-gate numbers — emitted as
-the doc's additive ``quant`` block).
+the doc's additive ``quant`` block),
+ACP_BENCH_FLEET=1 / ACP_BENCH_FLEET_PERSONAS / ACP_BENCH_FLEET_TURNS /
+ACP_BENCH_FLEET_PERSONA / ACP_BENCH_FLEET_PROMPT /
+ACP_BENCH_FLEET_MAX_TOKENS (fleet-tier fixture: affinity vs round-robin
+routing on a same-persona burst — pool-wide prefix-cache hit rate and
+TTFT p99 — plus disaggregated prefill->decode handoff TTFT vs a full
+local prefill and the KV bytes moved — emitted as the doc's additive
+``fleet`` block).
 
 ``ACP_INVARIANTS=1`` additionally arms the engine's runtime invariant
 checker (engine/invariants.py) for every bench engine — per-dispatch state
@@ -559,6 +566,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["mem"] = val
             elif key == "quant" and "quant" not in doc:
                 doc["quant"] = val
+            elif key == "fleet" and "fleet" not in doc:
+                doc["fleet"] = val
             elif key == "flight" and "flight" not in doc:
                 doc["flight"] = val
             elif key == "prof" and "prof" not in doc:
@@ -583,6 +592,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT mem", 900))
     if os.environ.get("ACP_BENCH_QUANT", "0") == "1":
         main_schedule.append(("RESULT quant", 900))
+    if os.environ.get("ACP_BENCH_FLEET", "0") == "1":
+        main_schedule.append(("RESULT fleet", 900))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
     if os.environ.get("ACP_BENCH_PROF", "0") == "1":
@@ -1009,6 +1020,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("quant", _bench_quant())
         except Exception as e:  # the fixture must not lose the headline
             _result("quant", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_FLEET", "0") == "1"
+    ):
+        try:
+            _result("fleet", _bench_fleet())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("fleet", {"error": str(e)})
 
     if (
         not args.only_ttft
@@ -1691,6 +1711,217 @@ def _bench_mem() -> dict:
             f"{slots_off} -> {slots_on} concurrent slots "
             f"({ratio}x); byte-identical="
             f"{swap_identical and dedup_identical}"
+        ),
+    }
+
+
+def _bench_fleet() -> dict:
+    """Fleet-tier fixture (ACP_BENCH_FLEET=1) — the two routing claims
+    from docs/fleet.md, measured:
+
+    (a) **affinity vs round-robin** on a same-persona burst: N personas x
+    M turns against a 2-replica pool, each policy on freshly built
+    engines. Affinity homes every persona's turns on one replica, so its
+    prefix cache serves turn 2+ hot; round-robin alternates and halves
+    the hit rate. Reported: pool-wide prefix-cache hit rate + TTFT p99
+    each way.
+
+    (b) **disaggregated handoff vs full recompute**: the same long-prompt
+    request against a prefill+decode pool with the handoff on vs off.
+    Reported: TTFT each way + the KV bytes the handoff moved (the wire
+    cost recompute avoids paying in compute).
+
+    The persona count defaults to an ODD number: with an even count the
+    submit-order interleave makes round-robin assign each persona a fixed
+    replica — accidental affinity, no contrast. Each replica's prefix
+    cache is sized to hold affinity's per-replica share of the personas
+    but not the whole roster round-robin smears onto every replica.
+
+    Knobs: ACP_BENCH_FLEET_PERSONAS (5), ACP_BENCH_FLEET_TURNS (4),
+    ACP_BENCH_FLEET_PERSONA (256 tokens), ACP_BENCH_FLEET_PROMPT (768),
+    ACP_BENCH_FLEET_MAX_TOKENS (8)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.fleet import FleetRouter
+    from agentcontrolplane_tpu.kernel import Store
+    from agentcontrolplane_tpu.models.llama import PRESETS
+
+    n_personas = int(os.environ.get("ACP_BENCH_FLEET_PERSONAS", "5"))
+    n_turns = int(os.environ.get("ACP_BENCH_FLEET_TURNS", "4"))
+    persona_len = int(os.environ.get("ACP_BENCH_FLEET_PERSONA", "256"))
+    plen = int(os.environ.get("ACP_BENCH_FLEET_PROMPT", "768"))
+    max_tokens = int(os.environ.get("ACP_BENCH_FLEET_MAX_TOKENS", "8"))
+    page = 16
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+
+    def build(max_ctx, **kw):
+        cfg = dataclasses.replace(
+            PRESETS["tiny"], max_seq_len=max_ctx, vocab_size=512
+        )
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            max_ctx=max_ctx,
+            prefill_buckets=(64, 256, 512),
+            decode_block_size=4,
+            kv_layout="paged",
+            page_size=page,
+            check_invariants=armed,
+            **kw,
+        )
+        eng.start()
+        return eng
+
+    def percentile(vals, q):
+        vals = sorted(vals)
+        return vals[min(len(vals) - 1, int(q * len(vals)))] if vals else 0.0
+
+    # -- (a) affinity vs round-robin on a same-persona burst ----------------
+    personas = [
+        [3 + p + (i % 200) for i in range(persona_len)]
+        for p in range(n_personas)
+    ]
+    sp = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+
+    def routing_leg(policy: str) -> dict:
+        router = FleetRouter(store=Store(), policy=policy,
+                             heartbeat_interval=60.0)
+        # cache sized for TWO generations (each turn's completion inserts
+        # a new longer entry beside last turn's) of affinity's per-replica
+        # SHARE of the personas — round-robin smears the whole roster
+        # onto both replicas, needs ~2x this, and churns its caches
+        cap = n_personas + 1
+        engines = [build(1024, max_slots=4, prefix_cache_entries=cap)
+                   for _ in range(2)]
+        for i, eng in enumerate(engines):
+            router.add_replica(f"r{i}", eng)
+        try:
+            # warm every shape on both replicas so the measured turns
+            # compare routing, not compilation — a neutral prompt that
+            # shares no prefix with any persona, run twice to also warm
+            # the prefix-HIT prefill program (short remainder bucket)
+            for eng in engines:
+                eng.generate([2] * (persona_len + 8), sp)
+                eng.generate([2] * (persona_len + 8), sp)
+            base: list[dict] = []
+            ttfts: list[float] = []
+            # turn 0 is a throwaway warm burst: it compiles the
+            # concurrent-batch shapes, homes the cold personas, and is
+            # excluded from both the TTFT and hit-rate ledgers — the
+            # measured turns compare STEADY-STATE routing
+            for turn in range(n_turns + 1):
+                # each turn is a concurrent burst: queue depth is what
+                # spreads cold personas across replicas (sequential
+                # submits would all tiebreak onto the same idle replica)
+                pending = []
+                for p, persona in enumerate(personas):
+                    tail = [210 + turn, 220 + p, 230, 240] * 4
+                    t0 = time.monotonic()
+                    first = []
+
+                    def on_tokens(_t, first=first, t0=t0):
+                        if not first:
+                            first.append((time.monotonic() - t0) * 1e3)
+
+                    fut = router.submit(
+                        persona + tail, sp, on_tokens=on_tokens,
+                        affinity_key=f"persona-{p}",
+                    )
+                    pending.append((fut, first))
+                for fut, first in pending:
+                    fut.result(timeout=1800)
+                    if turn > 0:
+                        ttfts.append(first[0] if first else 0.0)
+                if turn == 0:
+                    base = [dict(eng.stats().get("prefix_cache") or {})
+                            for eng in engines]
+            hits = misses = 0
+            for eng, b in zip(engines, base):
+                pc = eng.stats().get("prefix_cache") or {}
+                hits += pc.get("hits", 0) - b.get("hits", 0)
+                misses += pc.get("misses", 0) - b.get("misses", 0)
+            return {
+                "prefix_hit_rate": round(hits / (hits + misses), 3)
+                if hits + misses else 0.0,
+                "ttft_p50_ms": round(percentile(ttfts, 0.50), 1),
+                "ttft_p99_ms": round(percentile(ttfts, 0.99), 1),
+                "affinity_hits": router.affinity_hits,
+            }
+        finally:
+            router.stop()
+            for eng in engines:
+                eng.stop()
+
+    rr = routing_leg("round_robin")
+    aff = routing_leg("affinity")
+    routing_part = {
+        "personas": n_personas,
+        "turns": n_turns,
+        "persona_tokens": persona_len,
+        "round_robin": rr,
+        "affinity": aff,
+    }
+
+    # -- (b) disaggregated handoff vs full recompute ------------------------
+    prompt = [1 + (i % 250) for i in range(plen)]
+    max_ctx = plen + 256
+
+    def handoff_leg(enabled: bool) -> tuple[float, int]:
+        router = FleetRouter(
+            store=Store(), heartbeat_interval=60.0,
+            handoff_min_tokens=page if enabled else 0,
+        )
+        # prefix cache off: the local arm must pay the full prefill the
+        # handoff arm imports over the wire
+        prefill = build(max_ctx, max_slots=2, host_kv_bytes=256 << 20,
+                        prefix_cache_entries=0)
+        decode = build(max_ctx, max_slots=2, host_kv_bytes=256 << 20,
+                       prefix_cache_entries=0)
+        router.add_replica("pf", prefill, role="prefill")
+        router.add_replica("dc", decode, role="decode")
+        try:
+            # warm both legs' shapes (prefill program + restore scatter)
+            router.submit(list(prompt), sp).result(timeout=1800)
+            warm_bytes = router.handoff_bytes
+            t0 = time.monotonic()
+            first = []
+
+            def on_tokens(_t):
+                if not first:
+                    first.append((time.monotonic() - t0) * 1e3)
+
+            # vary the tail so the warmed prefix cache can't serve it whole
+            router.submit(prompt[:-4] + [251, 252, 253, 254], sp,
+                          on_tokens=on_tokens).result(timeout=1800)
+            return (first[0] if first else 0.0), \
+                router.handoff_bytes - warm_bytes
+        finally:
+            router.stop()
+            prefill.stop()
+            decode.stop()
+
+    ttft_local, _ = handoff_leg(False)
+    ttft_handoff, wire_bytes = handoff_leg(True)
+    handoff_part = {
+        "prompt_tokens": plen,
+        "ttft_handoff_ms": round(ttft_handoff, 1),
+        "ttft_local_ms": round(ttft_local, 1),
+        "handoff_bytes": wire_bytes,
+    }
+
+    return {
+        "routing": routing_part,
+        "handoff": handoff_part,
+        "note": (
+            f"{n_personas} personas x {n_turns} turns on 2 replicas: "
+            f"prefix hit rate {rr['prefix_hit_rate']:.0%} (round-robin) -> "
+            f"{aff['prefix_hit_rate']:.0%} (affinity), TTFT p99 "
+            f"{rr['ttft_p99_ms']:.0f}ms -> {aff['ttft_p99_ms']:.0f}ms; "
+            f"{plen}-token disaggregated prefill TTFT "
+            f"{ttft_handoff:.0f}ms vs {ttft_local:.0f}ms local "
+            f"({wire_bytes} KV bytes over the wire)"
         ),
     }
 

@@ -168,6 +168,29 @@ def test_thread_ownership_fires_on_server_scope_engine_reach(tmp_path):
     assert "scrape surface is stats()" in violations[0].message
 
 
+def test_thread_ownership_fires_on_fleet_scope_engine_reach(tmp_path):
+    """The fleet extension of the server-scope rule: router code drives
+    many engines from router/caller threads, so an ``engine._*`` reach in
+    ``fleet/`` is the same cross-thread ownership break as in ``server/``
+    — the pool consumes submit()/stats() and the purpose-built public
+    seams only."""
+    root = _write(
+        tmp_path,
+        "fleet/router.py",
+        """
+        def route(engine):
+            depth = len(engine._waiting)      # private reach: flagged
+            ok = engine.stats()["waiting"]    # public surface: fine
+            ok2 = engine.inject_host_kv(None) # public seam: fine
+            return depth, ok, ok2
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["thread-ownership"]
+    assert "fleet code reaches" in violations[0].message
+    assert "_waiting" in violations[0].message
+
+
 def test_thread_ownership_fires_on_chained_server_scope_reach(tmp_path):
     """The flight recorder extension: reaching a PRIVATE through a public
     handle rooted at ``engine`` (engine.flight._events) is the same

@@ -1,0 +1,163 @@
+"""Acceptance: a 2-replica pool under ``fleet.replica_crash`` completes
+every in-flight AND queued request exactly-once with output byte-identical
+to an uncrashed single-engine baseline — with the invariant checker armed
+on all replicas."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import PRESETS, Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.fleet import FleetRouter
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256,
+                          n_kv_heads=2)
+
+
+def make_engine(**kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=64,
+        prefill_buckets=(32, 64), decode_block_size=4, kv_layout="paged",
+        page_size=8, **kw,
+    )
+    eng.start()
+    return eng
+
+
+def make_pool(n=2, **router_kw):
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0, **router_kw)
+    engines = [make_engine() for _ in range(n)]
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng)
+    return router, engines
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def teardown_pool(router, engines, extra=()):
+    router.stop()
+    for eng in list(engines) + list(extra):
+        try:
+            eng.stop()
+        except Exception:
+            pass
+
+
+def test_crash_mid_decode_fails_over_byte_identical():
+    """The tentpole guarantee: crash the affinity-homed replica MID-DECODE
+    (deterministic per-replica fault), and the caller still receives one
+    contiguous stream, byte-identical to an uncrashed single engine."""
+    router, engines = make_pool(2)
+    baseline = make_engine()
+    try:
+        prompt = "tell me about the fleet tier"
+        sp = SamplingParams(temperature=0.0, max_tokens=24)
+        # home the persona, then kill its replica two decode steps in
+        router.submit("warm " + prompt, SamplingParams(temperature=0.0,
+                      max_tokens=2), affinity_key="p").result(timeout=120)
+        target = router._affinity["p"]
+        FAULTS.arm("fleet.replica_crash", times=1, after_steps=1,
+                   replica=target)
+        streamed = []
+        fut = router.submit(prompt, sp, affinity_key="p",
+                            on_tokens=streamed.extend)
+        result = fut.result(timeout=180)
+        expected = baseline.submit(prompt, sp).result(timeout=120)
+        assert result.text == expected.text
+        assert result.tokens == expected.tokens
+        # exactly-once: the stream is the result, no replayed prefix
+        assert streamed == list(result.tokens)
+        assert router.failovers == 1
+        dead = router.pool.get(target)
+        assert not dead.alive
+        survivor = router.pool.alive()[0]
+        # fencing trace: the survivor adopted the dead lease (epoch > 1)
+        assert router.pool.lease_holder(dead).endswith("/" + survivor.id)
+    finally:
+        teardown_pool(router, engines, extra=[baseline])
+
+
+def test_crash_completes_inflight_and_queued_exactly_once():
+    """All work on the dying replica — the in-flight request AND the ones
+    still queued behind it — fails over and completes byte-identical to
+    baselines; the survivor's invariant checker stays green throughout."""
+    router, engines = make_pool(2)
+    baseline = make_engine()
+    try:
+        prompts = [f"queued request number {i} says hello" for i in range(5)]
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        router.submit("warm the persona", SamplingParams(temperature=0.0,
+                      max_tokens=2), affinity_key="p").result(timeout=120)
+        target = router._affinity["p"]
+        FAULTS.arm("fleet.replica_crash", times=1, after_steps=2,
+                   replica=target)
+        futures = [router.submit(p, sp, affinity_key="p") for p in prompts]
+        results = [f.result(timeout=180) for f in futures]
+        for prompt, result in zip(prompts, results):
+            expected = baseline.submit(prompt, sp).result(timeout=120)
+            assert result.text == expected.text, prompt
+        assert router.failovers >= 1
+        assert len(router.pool.alive()) == 1
+        # the survivor served everything with its invariant checker armed
+        # (a bookkeeping break would have crashed its loop); it still serves
+        follow = router.submit("one more after the storm", sp,
+                               affinity_key="p").result(timeout=120)
+        assert follow.finish_reason in ("stop", "length")
+    finally:
+        teardown_pool(router, engines, extra=[baseline])
+
+
+def test_crash_failover_under_page_pressure_stress():
+    """Satellite stress: replica crash mid-decode while the survivor runs
+    under page pressure (halved KV pool -> preempt/swap churn), armed
+    invariants on every replica. Failed-over outputs must STILL match the
+    uncontended baseline byte-for-byte."""
+    router, engines = make_pool(2)
+    baseline = make_engine()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        router.submit("warm the persona", SamplingParams(temperature=0.0,
+                      max_tokens=2), affinity_key="p").result(timeout=120)
+        target = router._affinity["p"]
+        FAULTS.arm("fleet.replica_crash", times=1, after_steps=1,
+                   replica=target)
+        FAULTS.arm("engine.page_pressure", pages=8)  # squeeze every pool
+        prompts = [f"stress request {i} under pressure" for i in range(4)]
+        futures = [router.submit(p, sp, affinity_key="p") for p in prompts]
+        results = [f.result(timeout=240) for f in futures]
+        FAULTS.disarm("engine.page_pressure")
+        for prompt, result in zip(prompts, results):
+            expected = baseline.submit(prompt, sp).result(timeout=120)
+            assert result.text == expected.text, prompt
+        assert router.failovers >= 1 and len(router.pool.alive()) == 1
+    finally:
+        teardown_pool(router, engines, extra=[baseline])
+
+
+def test_router_ensure_running_never_revives_dead_replica():
+    router, engines = make_pool(2)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        router.submit("hi", sp, affinity_key="p").result(timeout=120)
+        target = router._affinity["p"]
+        FAULTS.arm("fleet.replica_crash", times=1, replica=target)
+        router.submit("hello there", sp, affinity_key="p").result(timeout=180)
+        assert not router.pool.get(target).alive
+        assert router.ensure_running() is True  # the survivor serves
+        assert not router.pool.get(target).alive  # and the dead stay dead
+    finally:
+        teardown_pool(router, engines)

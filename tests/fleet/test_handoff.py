@@ -1,0 +1,135 @@
+"""Acceptance: the disaggregated prefill->decode handoff restores KV
+bit-exactly — greedy output byte-identical to a full local prefill — in
+both KV layouts, quantized on and off, with the invariant checker armed;
+and every failure path (``fleet.handoff_error``, prompt below the cut
+floor) degrades to a full local prefill with identical output."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import PRESETS, Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.fleet import FleetRouter
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256,
+                          n_kv_heads=2)
+PROMPT = "a prompt long enough to cross several pages of kv!"
+SP = SamplingParams(temperature=0.0, max_tokens=12)
+
+
+def make_engine(kv_layout="paged", quantize_kv=False, **kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    kw.setdefault("host_kv_bytes", 1 << 20)
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=64,
+        prefill_buckets=(32, 64), decode_block_size=4, kv_layout=kv_layout,
+        page_size=8, quantize_kv=quantize_kv, **kw,
+    )
+    eng.start()
+    return eng
+
+
+def make_disagg_pool(kv_layout="paged", quantize_kv=False):
+    router = FleetRouter(store=Store(), handoff_min_tokens=8,
+                         heartbeat_interval=60.0)
+    prefill = make_engine(kv_layout, quantize_kv)
+    decode = make_engine(kv_layout, quantize_kv)
+    router.add_replica("pf", prefill, role="prefill")
+    router.add_replica("dc", decode, role="decode")
+    return router, prefill, decode
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def teardown(router, *engines):
+    router.stop()
+    for eng in engines:
+        try:
+            eng.stop()
+        except Exception:
+            pass
+
+
+@pytest.mark.parametrize(
+    "kv_layout,quantize_kv",
+    [("paged", False), ("paged", True), ("slot", False), ("slot", True)],
+)
+def test_disaggregated_handoff_bit_exact(kv_layout, quantize_kv):
+    """The KV that crossed the wire must be the KV local prefill would
+    have written: the decode replica (restored KV) and the prefill
+    replica (its own locally-written KV, decoded directly) must emit
+    byte-identical greedy tokens. A corrupt transfer — wrong scales,
+    misaligned cut, layout mismatch — diverges immediately."""
+    router, prefill, decode = make_disagg_pool(kv_layout, quantize_kv)
+    try:
+        result = router.submit(PROMPT, SP, affinity_key="p").result(timeout=180)
+        assert router.handoffs == 1 and router.handoff_errors == 0
+        assert router.handoff_bytes > 0
+        assert decode.kv_injects == 1
+        # local decode over the prefill replica's OWN slot KV (prefix-
+        # cache hit on the leg it just ran) — the bit-exactness oracle
+        expected = prefill.submit(PROMPT, SP).result(timeout=120)
+        assert result.text == expected.text
+        assert result.tokens == expected.tokens
+    finally:
+        teardown(router, prefill, decode)
+
+
+def test_handoff_wire_failure_falls_back_byte_identical():
+    """``fleet.handoff_error`` drops the entry between export and inject:
+    the decode replica runs a full local prefill instead, output
+    unchanged — the handoff is an optimization, never a dependency."""
+    router, prefill, decode = make_disagg_pool()
+    baseline = make_engine()
+    try:
+        FAULTS.arm("fleet.handoff_error", times=1)
+        result = router.submit(PROMPT, SP, affinity_key="p").result(timeout=180)
+        assert router.handoffs == 0 and router.handoff_errors == 1
+        assert decode.kv_injects == 0
+        expected = baseline.submit(PROMPT, SP).result(timeout=120)
+        assert result.text == expected.text
+    finally:
+        teardown(router, prefill, decode, baseline)
+
+
+def test_short_prompt_skips_handoff():
+    """Below ``handoff_min_tokens`` the router doesn't bother with the
+    prefill leg at all — straight local dispatch on the decode replica."""
+    router, prefill, decode = make_disagg_pool()
+    try:
+        result = router.submit("hi", SP, affinity_key="p").result(timeout=120)
+        assert router.handoffs == 0 and router.handoff_errors == 0
+        # the prefill replica never saw the request: no tokens generated
+        assert prefill.stats()["tokens_generated"] == 0
+        assert result.finish_reason in ("stop", "length")
+    finally:
+        teardown(router, prefill, decode)
+
+
+def test_handoff_disabled_by_default():
+    """``handoff_min_tokens=0`` (the default) never routes a prefill leg
+    even with a prefill replica registered."""
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0)
+    prefill = make_engine()
+    decode = make_engine()
+    router.add_replica("pf", prefill, role="prefill")
+    router.add_replica("dc", decode, role="decode")
+    try:
+        router.submit(PROMPT, SP, affinity_key="p").result(timeout=120)
+        assert router.handoffs == 0
+        assert router.stats()["handoff"]["enabled"] is False
+    finally:
+        teardown(router, prefill, decode)

@@ -1,0 +1,82 @@
+"""kernel/lease.py fleet seams: the read-only ``holder()`` view and the
+``LeaseHeartbeat`` renewer (add/beat/deposition/on_lost/release)."""
+
+from __future__ import annotations
+
+import time
+
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.kernel.lease import (
+    LeaseHeartbeat,
+    holder,
+    release,
+    try_acquire_epoch,
+)
+
+
+def test_holder_absent_live_expired_released():
+    store = Store()
+    assert holder(store, "l") is None
+    now = time.time()
+    assert try_acquire_epoch(store, "l", "me", ttl=10.0, now=now) == 1
+    assert holder(store, "l", now=now) == "me"
+    # expired: the holder is stale, not live
+    assert holder(store, "l", now=now + 11.0) is None
+    # released: the Lease object survives (epoch continuity) but reads empty
+    release(store, "l", "me")
+    assert holder(store, "l") is None
+    # adoption after release bumps the epoch — fencing is monotonic
+    assert try_acquire_epoch(store, "l", "other", ttl=10.0) == 2
+
+
+def test_heartbeat_add_renews_and_tracks_epochs():
+    store = Store()
+    hb = LeaseHeartbeat(store, interval=60.0, ttl=10.0)
+    assert hb.add("fleet-replica-r0", "pool-a") == 1
+    assert hb.epochs["fleet-replica-r0"] == 1
+    assert holder(store, "fleet-replica-r0") == "pool-a"
+    hb.beat()  # renewal keeps the epoch stable (no takeover)
+    assert hb.epochs["fleet-replica-r0"] == 1
+    # a second pool cannot steal a live lease, and is not tracked
+    hb2 = LeaseHeartbeat(store, interval=60.0, ttl=10.0)
+    assert hb2.add("fleet-replica-r0", "pool-b") is None
+    assert "fleet-replica-r0" not in hb2.epochs
+
+
+def test_heartbeat_deposed_lease_reports_on_lost():
+    store = Store()
+    lost = []
+    hb = LeaseHeartbeat(store, interval=60.0, ttl=0.05, on_lost=lost.append)
+    assert hb.add("fleet-replica-r0", "pool-a") == 1
+    time.sleep(0.1)  # let the lease expire un-renewed
+    # another identity adopts the expired lease (epoch bump)...
+    assert try_acquire_epoch(store, "fleet-replica-r0", "pool-b", ttl=30.0) == 2
+    # ...so the original owner's next beat discovers the deposition
+    hb.beat()
+    assert lost == ["fleet-replica-r0"]
+    assert "fleet-replica-r0" not in hb.epochs
+    # deposition is terminal for this tracking entry: no further churn
+    hb.beat()
+    assert lost == ["fleet-replica-r0"]
+
+
+def test_heartbeat_remove_releases_for_instant_adoption():
+    store = Store()
+    hb = LeaseHeartbeat(store, interval=60.0, ttl=30.0)
+    hb.add("fleet-replica-r0", "pool-a")
+    hb.remove("fleet-replica-r0", release_lease=True)
+    # no TTL wait: a survivor adopts immediately, fencing epoch bumped
+    assert try_acquire_epoch(store, "fleet-replica-r0", "pool-a/r1",
+                             ttl=30.0) == 2
+
+
+def test_heartbeat_thread_keeps_lease_live():
+    store = Store()
+    hb = LeaseHeartbeat(store, interval=0.05, ttl=0.3)
+    hb.add("fleet-replica-r0", "pool-a")
+    hb.start()
+    try:
+        time.sleep(0.6)  # > 2x TTL: only renewals keep it live
+        assert holder(store, "fleet-replica-r0") == "pool-a"
+    finally:
+        hb.stop()

@@ -1,0 +1,98 @@
+"""``GET /v1/fleet`` — the router's stats payload through the REST front
+door, and the 503 posture for single-engine deployments."""
+
+from __future__ import annotations
+
+import asyncio
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import aiohttp
+
+from agentcontrolplane_tpu.fleet import FleetRouter
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.llmclient import MockLLMClient, MockLLMClientFactory
+from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+
+class _StubEngine:
+    def __init__(self):
+        self.tokenizer = SimpleNamespace(
+            encode=lambda s: list(s.encode()),
+            decode=lambda toks: bytes(toks).decode(errors="replace"),
+        )
+
+    def ensure_running(self):
+        return True
+
+    def cancel(self, future):
+        future.cancel()
+
+    def submit(self, prompt, sampling=None, on_tokens=None, timeout_s=None,
+               on_tool_call=None, park=False, trace=None, export_kv=False):
+        fut = Future()
+        fut.rid = "stub"
+        fut.admitted = Future()
+        fut.admitted.set_result(True)
+        fut.early_tool_calls = []
+        fut.set_result(SimpleNamespace(text="ok", tokens=[1],
+                                       finish_reason="stop", kv_handoff=None))
+        return fut
+
+    def stats(self):
+        return {"waiting": 1, "active_slots": 2, "prefilling_slots": 0,
+                "perf": {"goodput": {"ratio": 0.75}}}
+
+
+class FleetHarness:
+    def __init__(self, fleet=None):
+        self.operator = Operator(
+            options=OperatorOptions(
+                enable_rest=True, api_port=0, llm_probe=False,
+                verify_channel_credentials=False, fleet=fleet,
+            ),
+            llm_factory=MockLLMClientFactory(MockLLMClient()),
+        )
+
+    async def __aenter__(self):
+        await self.operator.start()
+        for _ in range(100):
+            if self.operator.rest_server.bound_port:
+                break
+            await asyncio.sleep(0.02)
+        self.base = f"http://127.0.0.1:{self.operator.rest_server.bound_port}"
+        self.http = aiohttp.ClientSession()
+        return self
+
+    async def __aexit__(self, *exc):
+        await self.http.close()
+        await self.operator.stop()
+
+
+async def test_fleet_endpoint_serves_router_stats():
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0)
+    router.add_replica("r0", _StubEngine())
+    router.add_replica("r1", _StubEngine())
+    try:
+        async with FleetHarness(fleet=router) as h:
+            resp = await h.http.get(f"{h.base}/v1/fleet")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["configured"] is True
+            assert {r["id"] for r in doc["replicas"]} == {"r0", "r1"}
+            row = doc["replicas"][0]
+            assert row["alive"] is True
+            assert row["lease"]["holder"] == router.pool.identity
+            assert row["queue_depth"] == 1 and row["goodput_ratio"] == 0.75
+            for block in ("routing", "failover", "handoff"):
+                assert block in doc
+    finally:
+        router.stop()
+
+
+async def test_fleet_endpoint_503_without_router():
+    async with FleetHarness() as h:
+        resp = await h.http.get(f"{h.base}/v1/fleet")
+        assert resp.status == 503
+        doc = await resp.json()
+        assert "no fleet router" in doc["error"]

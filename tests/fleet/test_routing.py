@@ -1,0 +1,310 @@
+"""Routing-policy edge tests against fake engines (no device work):
+affinity hit routes hot, cold persona routes least-loaded, shed replicas
+are skipped, round-robin cycles, dead-marker errors fail over, and the
+exactly-once stream dedup counters."""
+
+from __future__ import annotations
+
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import (
+    EngineOverloadedError,
+    SamplingParams,
+)
+from agentcontrolplane_tpu.fleet import FleetRouter, persona_affinity_key
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.testing import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+class FakeTokenizer:
+    def encode(self, text):
+        return list(text.encode())
+
+    def decode(self, tokens):
+        return bytes(tokens).decode(errors="replace")
+
+
+class FakeEngine:
+    """Engine-shaped stub: submit() resolves per the scripted behavior —
+    "ok" (greedy-deterministic fake tokens), "shed", "crash", or "hold"
+    (leave the future pending for queued-work tests)."""
+
+    def __init__(self, behavior="ok", waiting=0, active=0, goodput=1.0):
+        self.behavior = behavior
+        self.waiting = waiting
+        self.active = active
+        self.goodput = goodput
+        self.tokenizer = FakeTokenizer()
+        self.submissions = []
+        self.held = []
+
+    def ensure_running(self):
+        return True
+
+    def cancel(self, future):
+        future.cancel()
+
+    def submit(self, prompt, sampling=None, on_tokens=None, timeout_s=None,
+               on_tool_call=None, park=False, trace=None, export_kv=False):
+        self.submissions.append(list(prompt))
+        fut = Future()
+        fut.rid = f"fake-{len(self.submissions)}"
+        fut.admitted = Future()
+        fut.early_tool_calls = []
+        if self.behavior == "shed":
+            fut.set_exception(
+                EngineOverloadedError("fake shed", retry_after_s=7.0)
+            )
+        elif self.behavior == "crash":
+            fut.set_exception(RuntimeError("engine crashed: fake"))
+        elif self.behavior == "hold":
+            self.held.append((fut, list(prompt), on_tokens))
+        else:
+            fut.admitted.set_result(True)
+            tokens = [t ^ 1 for t in prompt][:8]
+            if on_tokens is not None:
+                on_tokens(tokens)
+            fut.set_result(SimpleNamespace(
+                text=self.tokenizer.decode(tokens), tokens=tokens,
+                finish_reason="stop", kv_handoff=None,
+            ))
+        return fut
+
+    def stats(self):
+        return {
+            "waiting": self.waiting,
+            "active_slots": self.active,
+            "prefilling_slots": 0,
+            "perf": {"goodput": {"ratio": self.goodput}},
+        }
+
+
+def make_router(*engines, policy="affinity", **kw):
+    router = FleetRouter(store=Store(), policy=policy,
+                         heartbeat_interval=60.0, **kw)
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng)
+    return router
+
+
+def test_persona_affinity_key_hashes_system_prompt():
+    objs = [SimpleNamespace(role="system", content="be terse"),
+            SimpleNamespace(role="user", content="hi")]
+    dicts = [{"role": "system", "content": "be terse"},
+             {"role": "user", "content": "hi"}]
+    assert persona_affinity_key(objs) == persona_affinity_key(dicts)
+    # different persona, different home
+    assert persona_affinity_key(objs) != persona_affinity_key(
+        [{"role": "system", "content": "be verbose"}]
+    )
+    # no system message: first message stands in
+    assert persona_affinity_key([{"role": "user", "content": "hi"}]) == \
+        persona_affinity_key([{"role": "user", "content": "hi"}])
+
+
+def test_affinity_hit_routes_to_hot_replica():
+    e0, e1 = FakeEngine(), FakeEngine()
+    router = make_router(e0, e1)
+    try:
+        router.submit("hello", SamplingParams(), affinity_key="persona-a"
+                      ).result(timeout=5)
+        first = e0 if e0.submissions else e1
+        for _ in range(3):
+            router.submit("hello again", SamplingParams(),
+                          affinity_key="persona-a").result(timeout=5)
+        # every same-persona turn landed on the first home
+        other = e1 if first is e0 else e0
+        assert len(first.submissions) == 4 and not other.submissions
+        assert router.affinity_hits == 3 and router.affinity_misses == 1
+    finally:
+        router.stop()
+
+
+def test_cold_persona_routes_least_loaded():
+    loaded = FakeEngine(waiting=5, active=3)
+    idle = FakeEngine(waiting=0, active=0)
+    router = make_router(loaded, idle)
+    try:
+        router.submit("x", SamplingParams(), affinity_key="cold").result(timeout=5)
+        assert idle.submissions and not loaded.submissions
+        assert router.affinity_misses == 1
+        # the miss re-homed the key: next turn is a hit on the same replica
+        router.submit("y", SamplingParams(), affinity_key="cold").result(timeout=5)
+        assert len(idle.submissions) == 2 and router.affinity_hits == 1
+    finally:
+        router.stop()
+
+
+def test_goodput_breaks_load_ties():
+    slow = FakeEngine(goodput=0.4)
+    fast = FakeEngine(goodput=0.9)
+    router = make_router(slow, fast)
+    try:
+        router.submit("x", SamplingParams(), affinity_key="k").result(timeout=5)
+        assert fast.submissions and not slow.submissions
+    finally:
+        router.stop()
+
+
+def test_shed_replica_skipped_pool_absorbs():
+    shedder = FakeEngine(behavior="shed")
+    ok = FakeEngine(waiting=9, active=9)  # worse-loaded, but serving
+    router = make_router(shedder, ok)
+    try:
+        # home the persona on the shedder, then watch the skip
+        router._affinity["p"] = "r0"
+        result = router.submit("hello", SamplingParams(),
+                               affinity_key="p").result(timeout=5)
+        assert result.finish_reason == "stop"
+        assert ok.submissions and router.sheds_skipped == 1
+    finally:
+        router.stop()
+
+
+def test_pool_wide_shed_propagates_retry_after():
+    router = make_router(FakeEngine(behavior="shed"), FakeEngine(behavior="shed"))
+    try:
+        fut = router.submit("hello", SamplingParams(), affinity_key="p")
+        with pytest.raises(EngineOverloadedError) as ei:
+            fut.result(timeout=5)
+        assert "fleet replicas shed" in str(ei.value)
+        assert ei.value.retry_after_s == 7.0  # the replicas' own backoff
+    finally:
+        router.stop()
+
+
+def test_round_robin_cycles_replicas():
+    e0, e1 = FakeEngine(), FakeEngine()
+    router = make_router(e0, e1, policy="round_robin")
+    try:
+        for _ in range(4):
+            router.submit("x", SamplingParams()).result(timeout=5)
+        assert len(e0.submissions) == 2 and len(e1.submissions) == 2
+        assert router.affinity_hits == 0  # policy never consults the map
+    finally:
+        router.stop()
+
+
+def test_dead_marker_fails_over_and_adopts_lease():
+    dying = FakeEngine(behavior="crash")
+    survivor = FakeEngine()
+    router = make_router(dying, survivor)
+    try:
+        router._affinity["p"] = "r0"
+        result = router.submit("hello", SamplingParams(),
+                               affinity_key="p").result(timeout=5)
+        assert result.finish_reason == "stop" and survivor.submissions
+        assert router.failovers == 1
+        r0 = router.pool.get("r0")
+        assert not r0.alive
+        # the survivor adopted the dead lease under a bumped epoch
+        assert router.pool.lease_holder(r0).endswith("/r1")
+        # the dead replica's affinity homes were evicted, then re-homed
+        assert router._affinity["p"] == "r1"
+    finally:
+        router.stop()
+
+
+def test_failover_budget_exhaustion_propagates():
+    router = make_router(FakeEngine(behavior="crash"),
+                         FakeEngine(behavior="crash"), failover_max=2)
+    try:
+        fut = router.submit("hello", SamplingParams(), affinity_key="p")
+        with pytest.raises(RuntimeError, match="engine crashed|no live replicas"):
+            fut.result(timeout=5)
+        assert not router.pool.alive()
+    finally:
+        router.stop()
+
+
+def test_route_stale_fault_evicts_and_rehomes():
+    e0, e1 = FakeEngine(), FakeEngine()
+    router = make_router(e0, e1)
+    try:
+        router.submit("x", SamplingParams(), affinity_key="p").result(timeout=5)
+        FAULTS.arm("fleet.route_stale", times=1)
+        router.submit("y", SamplingParams(), affinity_key="p").result(timeout=5)
+        # the forced-stale turn counted as a miss, not a hit
+        assert router.affinity_hits == 0 and router.affinity_misses == 2
+        # ...and the next turn is a clean hit again
+        router.submit("z", SamplingParams(), affinity_key="p").result(timeout=5)
+        assert router.affinity_hits == 1
+    finally:
+        router.stop()
+
+
+def test_exactly_once_stream_dedup_across_failover():
+    """A failed-over stream must deliver each token exactly once: the
+    retry regenerates the full (deterministic) output and the router
+    suppresses the prefix the caller already saw."""
+    class CrashMidStream(FakeEngine):
+        def submit(self, prompt, sampling=None, on_tokens=None, **kw):
+            self.submissions.append(list(prompt))
+            fut = Future()
+            fut.rid = "crash-mid"
+            fut.admitted = Future()
+            fut.early_tool_calls = []
+            fut.admitted.set_result(True)
+            if on_tokens is not None:
+                on_tokens([10, 11, 12])  # streamed, then the replica dies
+            fut.set_exception(RuntimeError("engine crashed: fake"))
+            return fut
+
+    class Survivor(FakeEngine):
+        def submit(self, prompt, sampling=None, on_tokens=None, **kw):
+            self.submissions.append(list(prompt))
+            fut = Future()
+            fut.rid = "retry"
+            fut.admitted = Future()
+            fut.early_tool_calls = []
+            fut.admitted.set_result(True)
+            full = [10, 11, 12, 13, 14]  # greedy replay: same prefix
+            if on_tokens is not None:
+                on_tokens(full[:2])
+                on_tokens(full[2:])
+            fut.set_result(SimpleNamespace(
+                text="", tokens=full, finish_reason="stop", kv_handoff=None))
+            return fut
+
+    streamed = []
+    router = make_router(CrashMidStream(), Survivor())
+    try:
+        router._affinity["p"] = "r0"
+        fut = router.submit("hello", SamplingParams(), affinity_key="p",
+                            on_tokens=streamed.extend)
+        result = fut.result(timeout=5)
+        assert result.tokens == [10, 11, 12, 13, 14]
+        assert streamed == [10, 11, 12, 13, 14]  # no replayed duplicates
+        assert router.failovers == 1
+    finally:
+        router.stop()
+
+
+def test_stats_shape_and_fleet_gauge():
+    from agentcontrolplane_tpu.observability.metrics import REGISTRY
+
+    router = make_router(FakeEngine(waiting=2, active=1, goodput=0.5),
+                         FakeEngine())
+    try:
+        router.submit("x", SamplingParams(), affinity_key="p").result(timeout=5)
+        doc = router.stats()
+        assert {r["id"] for r in doc["replicas"]} == {"r0", "r1"}
+        row = next(r for r in doc["replicas"] if r["id"] == "r0")
+        assert row["queue_depth"] == 2 and row["active_slots"] == 1
+        assert row["lease"]["holder"] == router.pool.identity
+        assert doc["routing"]["routed"] == 1
+        assert doc["failover"]["failover_max"] == router.failover_max
+        assert doc["handoff"]["enabled"] is False
+        gauge = REGISTRY._metrics.get("acp_fleet_replicas")
+        assert gauge is not None and gauge.values.get(()) == 2.0
+    finally:
+        router.stop()
