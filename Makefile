@@ -72,5 +72,6 @@ lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness
 		--json acplint-findings.json \
 		agentcontrolplane_tpu tests bench.py
 	-$(PY) -m agentcontrolplane_tpu.analysis --bench-trend .  # advisory: perf-trajectory sentinel
+	-$(PY) -m agentcontrolplane_tpu.analysis --slo-envelopes .  # advisory: scenario SLO envelopes
 
 ci: lint lint-acp test dryrun

@@ -140,6 +140,42 @@ METRICS: tuple[MetricSpec, ...] = (
     ),
 )
 
+# scenario SLO percentiles (PR 17): every library scenario the bench runs
+# (ACP_BENCH_SCENARIOS=1; scenarios/library.py) lands its ReplayReport
+# summary under scenarios.<name>.<single|fleet>. Latency percentiles get
+# very wide tolerances (CPU-fixture wall-clock; analysis/slo_gate.py owns
+# the hard structural envelope, this table just keeps the trajectory
+# visible), goodput a moderate floor-band.
+_SCENARIO_NAMES = (
+    "persona_storm", "long_tail", "tool_swarm", "cancel_churn",
+    "fault_cocktail",
+)
+_SCENARIO_ARMS = ("single", "fleet")
+METRICS = METRICS + tuple(
+    spec
+    for name in _SCENARIO_NAMES
+    for arm in _SCENARIO_ARMS
+    for spec in (
+        MetricSpec(
+            f"sc_{name}_{arm}_ttft_p50",
+            ("scenarios", name, arm, "ttft_p50_ms"), "lower", rel_tol=3.0,
+        ),
+        MetricSpec(
+            f"sc_{name}_{arm}_ttft_p99",
+            ("scenarios", name, arm, "ttft_p99_ms"), "lower", rel_tol=3.0,
+        ),
+        MetricSpec(
+            f"sc_{name}_{arm}_stall_p99",
+            ("scenarios", name, arm, "decode_stall_p99_ms"),
+            "lower", rel_tol=3.0,
+        ),
+        MetricSpec(
+            f"sc_{name}_{arm}_goodput",
+            ("scenarios", name, arm, "goodput_ratio"), "higher", rel_tol=0.5,
+        ),
+    )
+)
+
 
 def _get(doc: dict, path: tuple[str, ...]) -> Optional[float]:
     node: Any = doc

@@ -15,6 +15,8 @@ operation replaces that with one binary:
   acp-tpu task create <agent> <message> [--follow]
   acp-tpu timeline [request-id]   (engine flight recorder)
   acp-tpu perf                    (compute efficiency observatory)
+  acp-tpu trace export [--fleet] [-o trace.json]
+  acp-tpu replay trace.json | --scenario NAME [--speed 10] [--gate]
 """
 
 from __future__ import annotations
@@ -897,6 +899,157 @@ def cmd_timeline(args) -> int:
         return 0
 
 
+def cmd_trace_export(args) -> int:
+    """Pull the anonymized replayable workload trace off a running server:
+    ``/v1/engine/trace`` for a single engine, ``/v1/fleet/trace`` for the
+    stitched cross-replica view. The doc is validated before it is written
+    — an export this command exits 0 on is guaranteed replayable."""
+    from .observability.trace_export import validate_trace
+
+    path = "/v1/fleet/trace" if args.fleet else "/v1/engine/trace"
+    with _client(args) as http:
+        resp = http.get(path)
+    if resp.status_code != 200:
+        print(
+            f"error: GET {path} -> {resp.status_code}: {resp.text[:200]}",
+            file=sys.stderr,
+        )
+        return 1
+    doc = resp.json()
+    problems = validate_trace(doc)
+    if problems:
+        print("error: server returned an unreplayable trace:", file=sys.stderr)
+        for problem in problems[:10]:
+            print(f"  {problem}", file=sys.stderr)
+        return 1
+    payload = json.dumps(doc, indent=2, sort_keys=True)
+    if args.output:
+        with open(args.output, "w") as fh:
+            fh.write(payload + "\n")
+        summary = (
+            f"wrote {args.output}: {len(doc['requests'])} request(s) over "
+            f"{doc.get('span_s', 0.0):.3f}s from {doc.get('source')}"
+        )
+        if not doc.get("complete", True):
+            summary += "  [INCOMPLETE: recorder evicted timelines mid-window]"
+        print(summary)
+    else:
+        print(payload)
+    return 0
+
+
+def _scenario_overrides(pairs: list[str]) -> dict:
+    """``--set k=v`` pairs with int/float coercion (generator kwargs are
+    numeric except ``crash_replica``)."""
+    out: dict = {}
+    for pair in pairs:
+        key, sep, raw = pair.partition("=")
+        if not sep or not key:
+            raise ValueError(f"--set expects K=V, got {pair!r}")
+        value: object = raw
+        for cast in (int, float):
+            try:
+                value = cast(raw)
+                break
+            except ValueError:
+                continue
+        out[key] = value
+    return out
+
+
+def cmd_replay(args) -> int:
+    """Deterministic local replay: load a trace file (or build a library
+    scenario), validate it, play it against a freshly built in-process
+    engine, and print the SLO summary. ``--gate`` judges the run against
+    its scenario's envelope.
+
+    Exit codes: 0 clean; 1 operational failure (unreadable/unreplayable
+    trace, engine construction, or request errors during the run); 2 the
+    run finished but tripped its SLO envelope (``--gate``)."""
+    from .observability.trace_export import validate_trace
+    from .scenarios import build, replay
+
+    if args.trace and args.scenario:
+        print("error: pass a trace file OR --scenario, not both", file=sys.stderr)
+        return 1
+    if args.trace:
+        try:
+            with open(args.trace) as fh:
+                doc = json.load(fh)
+        except (OSError, ValueError) as exc:
+            print(f"error: cannot load {args.trace}: {exc}", file=sys.stderr)
+            return 1
+    elif args.scenario:
+        try:
+            doc = build(args.scenario, **_scenario_overrides(args.overrides))
+        except (KeyError, TypeError, ValueError) as exc:
+            print(f"error: {exc}", file=sys.stderr)
+            return 1
+    else:
+        print("error: pass a trace file or --scenario NAME", file=sys.stderr)
+        return 1
+    problems = validate_trace(doc)
+    if problems:
+        print("error: unreplayable trace:", file=sys.stderr)
+        for problem in problems[:10]:
+            print(f"  {problem}", file=sys.stderr)
+        return 1
+    source = str(doc.get("source") or "replay")
+    scenario = args.scenario or source.removeprefix("scenario:")
+    if args.check:
+        print(
+            f"trace ok: {len(doc['requests'])} request(s) over "
+            f"{doc.get('span_s', 0.0):.3f}s from {source}"
+        )
+        return 0
+    engine = _build_engine(args)
+    engine.start()
+    try:
+        if args.prewarm:
+            engine.prewarm(constrained=True)
+        report = replay(
+            doc, engine, speed=args.speed, seed=args.seed, scenario=scenario,
+        )
+    finally:
+        engine.stop()
+    slo = report.slo_doc()
+    if args.json:
+        print(json.dumps(slo, indent=2, sort_keys=True))
+    else:
+        print(
+            f"replayed {slo['requests']} request(s) at {args.speed:g}x "
+            f"(seed {args.seed}) in {slo['wall_s']:.2f}s wall"
+        )
+        print(
+            f"  outcomes: {slo['completed']} completed, {slo['shed']} shed, "
+            f"{slo['cancelled']} cancelled, {slo['expired']} expired, "
+            f"{slo['errors']} error(s); {slo['tool_calls']} tool call(s)"
+        )
+        print(
+            f"  ttft p50/p99 {slo['ttft_p50_ms']:.1f}/{slo['ttft_p99_ms']:.1f}ms  "
+            f"e2e p50/p99 {slo['e2e_p50_ms']:.1f}/{slo['e2e_p99_ms']:.1f}ms  "
+            f"decode-stall p99 {slo['decode_stall_p99_ms']:.1f}ms"
+        )
+        if slo.get("goodput_ratio") is not None:
+            print(f"  goodput ratio {slo['goodput_ratio']:.3f}")
+    if args.gate:
+        from .analysis.slo_gate import check_block
+
+        violations = check_block(scenario, "single", slo)
+        if violations:
+            print(f"slo-gate: {len(violations)} envelope violation(s):")
+            for violation in violations:
+                print(f"  {violation}")
+            return 2
+        print(f"slo-gate: {scenario} inside its envelope")
+    if slo["errors"]:
+        for row in report.rows:
+            if row.outcome == "error":
+                print(f"error: request {row.index}: {row.error}", file=sys.stderr)
+        return 1
+    return 0
+
+
 def _print_flight_event(e: dict, rel_key: str | None = None) -> None:
     stamp = (
         f"+{e[rel_key] * 1e3:9.1f}ms" if rel_key and rel_key in e
@@ -1061,6 +1214,69 @@ def build_parser() -> argparse.ArgumentParser:
         help="window events to show when no request id is given",
     )
     tl.set_defaults(fn=cmd_timeline)
+
+    trc = sub.add_parser(
+        "trace",
+        help="anonymized replayable workload traces (flight recorder export)",
+    )
+    trsub = trc.add_subparsers(dest="trace_command", required=True)
+    te = trsub.add_parser(
+        "export",
+        help="export the engine's (or, with --fleet, the stitched "
+        "cross-replica) workload trace as validated JSON",
+    )
+    te.add_argument(
+        "--fleet", action="store_true",
+        help="stitch prefill/decode/failover legs across the replica pool",
+    )
+    te.add_argument(
+        "-o", "--output", default=None,
+        help="write the trace here (default: stdout)",
+    )
+    te.set_defaults(fn=cmd_trace_export)
+
+    rp = sub.add_parser(
+        "replay",
+        help="deterministic local replay of a trace file or a library "
+        "scenario against a freshly built engine (see docs/scenarios.md)",
+    )
+    rp.add_argument(
+        "trace", nargs="?",
+        help="trace JSON from `acp-tpu trace export` (omit with --scenario)",
+    )
+    rp.add_argument(
+        "--scenario", default=None,
+        help="build a scenario from the library instead of loading a file "
+        "(persona_storm, long_tail, tool_swarm, cancel_churn, fault_cocktail)",
+    )
+    rp.add_argument(
+        "--set", action="append", default=[], metavar="K=V", dest="overrides",
+        help="scenario generator kwarg override, repeatable (e.g. --set n=24)",
+    )
+    rp.add_argument("--speed", type=float, default=1.0,
+                    help="time compression: 10 replays a 30s trace in 3s")
+    rp.add_argument("--seed", type=int, default=0,
+                    help="synthetic-content seed (same seed = same workload)")
+    rp.add_argument(
+        "--check", action="store_true",
+        help="validate the trace and exit without building an engine",
+    )
+    rp.add_argument(
+        "--gate", action="store_true",
+        help="judge the run against its scenario's SLO envelope "
+        "(exit 2 on violation)",
+    )
+    rp.add_argument("--json", action="store_true",
+                    help="print the SLO summary as JSON")
+    rp.add_argument(
+        "--prewarm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="compile serving programs before replaying (byte-identity "
+        "across repeated replays assumes a warmed engine)",
+    )
+    _add_tpu_flags(rp)
+    rp.set_defaults(fn=cmd_replay)
 
     tr = sub.add_parser("train", help="LoRA fine-tune a checkpoint on a JSONL dataset")
     tr.add_argument("--checkpoint", required=True, help="HF checkpoint dir")

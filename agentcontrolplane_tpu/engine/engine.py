@@ -28,6 +28,7 @@ lease layer never serializes the engine.
 from __future__ import annotations
 
 import contextlib
+import hashlib
 import heapq
 import logging
 import os
@@ -1363,9 +1364,16 @@ class Engine:
             req.future.set_exception(RuntimeError("engine is not running"))
             return req.future
         if not _prewarm:
+            # persona fingerprint: the same first-64-token hash the fleet
+            # router keys affinity on, so single-engine trace export
+            # (observability/trace_export.py) captures the prefix-sharing
+            # mix without retaining any prompt content
+            persona = hashlib.sha1(
+                repr(tokens[:64]).encode()
+            ).hexdigest()[:16] if self.flight.enabled else ""
             self.flight.record(
                 "submit", rid=req.rid, prompt_tokens=len(tokens),
-                timeout_s=timeout_s, park=req.park,
+                timeout_s=timeout_s, park=req.park, key=persona,
             )
         # bounded admission: shed instead of queueing unboundedly. Depth is
         # a racy-but-safe over/under-count by at most the in-flight burst;
@@ -1921,6 +1929,15 @@ class Engine:
                     # match filter keeps sibling engines in the same process
                     # alive); after_steps gates it mid-decode
                     raise RuntimeError("fault injection: fleet replica crash")
+                if self._faults.enabled:
+                    # throttle drill: stretch scheduler cycles so wall-clock
+                    # races (deadlines, mid-flight cancels) land while
+                    # requests are genuinely queued/decoding — a tiny model
+                    # on fast hardware otherwise outruns any realistic
+                    # timer. Timing-only: sampled tokens are untouched.
+                    slow = self._faults.pop("engine.slow_cycle")
+                    if slow is not None:
+                        time.sleep(float(slow.get("delay_s", 0.01)))
                 self._sweep_parked()
                 if not self._has_work():
                     if not admitted:

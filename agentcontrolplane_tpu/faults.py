@@ -22,6 +22,13 @@ Engine sites (see ``engine/engine.py``):
   the request re-enters the chunk loop on re-admission (byte-identical;
   nothing was sampled). Arm with ``after_steps=N`` to let N chunks land
   first. Fires only while some slot is mid-prefill.
+- ``engine.slow_cycle`` — stretch the next ``times=N`` scheduler cycles
+  by ``delay_s`` each (a ``time.sleep`` in the engine loop): a throttle
+  drill so wall-clock races — tight deadlines, mid-flight cancels — land
+  while requests are genuinely queued or decoding, which a tiny model on
+  fast hardware otherwise outruns. Timing-only: sampled tokens are
+  untouched. The ``cancel_churn`` scenario trace arms this site
+  (``scenarios/library.py``, docs/scenarios.md).
 - ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
   (released when disarmed/reset), shrinking the pool mid-serve.
 - ``engine.invariant_break`` — corrupt a mirror counter (``_parked_count``)

@@ -434,6 +434,12 @@ class FleetRouter:
             park=sub.park, trace=sub.trace,
         )
         sub.engine_future = engine_future
+        # linkage for /v1/fleet/trace: the replica-local rid lets the
+        # stitcher fetch this leg's timeline from the replica's recorder
+        self.flight.record(
+            "attempt", rid=sub.rid, replica=replica.id,
+            engine_rid=getattr(engine_future, "rid", None), n=sub.attempts,
+        )
         # the live attempt's early-call list is the caller's view; a
         # failover retry regenerates the full list (greedy determinism)
         sub.future.early_tool_calls = getattr(  # type: ignore[attr-defined]
@@ -568,15 +574,16 @@ class FleetRouter:
         replica's host tier and run the decode leg there. The decode leg
         goes through :meth:`_submit_to` unchanged, so failover and shed
         handling apply to it exactly like a direct submission."""
-        self.flight.record(
-            "handoff_start", rid=sub.rid, prefill=prefill.id,
-            decode=decode.id, prompt_tokens=len(sub.prompt),
-        )
         prefill_future = prefill.engine.submit(
             list(sub.prompt),
             _dc_replace(sub.sampling, max_tokens=1),
             timeout_s=sub.remaining_timeout(),
             export_kv=True,
+        )
+        self.flight.record(
+            "handoff_start", rid=sub.rid, prefill=prefill.id,
+            decode=decode.id, prompt_tokens=len(sub.prompt),
+            engine_rid=getattr(prefill_future, "rid", None),
         )
 
         def _prefill_done(f: Future) -> None:

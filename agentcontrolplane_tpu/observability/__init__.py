@@ -2,10 +2,19 @@ from .flight import FlightRecorder, attribute_phases, phase_summaries
 from .metrics import REGISTRY, Registry
 from .otel_metrics import MetricsExporter
 from .profiler import DispatchProfiler, WASTE_CAUSES
+from .trace_export import (
+    TRACE_VERSION,
+    export_fleet_trace,
+    export_trace,
+    stitch_timelines,
+    validate_trace,
+)
 from .tracing import NOOP_TRACER, Span, Tracer, new_span_id, new_trace_id
 
 __all__ = [
     "REGISTRY", "Registry", "MetricsExporter", "NOOP_TRACER", "Span", "Tracer",
     "new_span_id", "new_trace_id", "FlightRecorder", "attribute_phases",
     "phase_summaries", "DispatchProfiler", "WASTE_CAUSES",
+    "TRACE_VERSION", "export_trace", "export_fleet_trace",
+    "stitch_timelines", "validate_trace",
 ]

@@ -58,6 +58,10 @@ log = logging.getLogger("acp_tpu.flight")
 DEFAULT_CAPACITY = 4096
 PER_REQUEST_CAP = 512  # events indexed per request (timeline bound)
 FINISHED_TIMELINES = 64  # finished request timelines kept for /timeline
+# trace export (observability/trace_export.py) replays finished timelines;
+# replay-scale runs can finish more requests than the default LRU holds, so
+# the cap is env-tunable and evictions are COUNTED (stats()/trace docs flag
+# an incomplete export instead of silently truncating it)
 
 # the phase vocabulary exported as acp_engine_phase_seconds{phase=...}
 PHASES = (
@@ -196,10 +200,14 @@ class FlightRecorder:
         capacity: Optional[int] = None,
         enabled: Optional[bool] = None,
         per_request_cap: int = PER_REQUEST_CAP,
-        finished_timelines: int = FINISHED_TIMELINES,
+        finished_timelines: Optional[int] = None,
     ):
         if capacity is None:
             capacity = int(os.environ.get("ACP_FLIGHT_EVENTS", str(DEFAULT_CAPACITY)))
+        if finished_timelines is None:
+            finished_timelines = int(
+                os.environ.get("ACP_FLIGHT_TIMELINES", str(FINISHED_TIMELINES))
+            )
         if enabled is None:
             enabled = os.environ.get("ACP_FLIGHT", "1") not in ("", "0")
         self.enabled = bool(enabled)
@@ -218,6 +226,7 @@ class FlightRecorder:
         self._truncated_rids: set[str] = set()  # per-request cap hit
         self._done: "collections.OrderedDict[str, list]" = collections.OrderedDict()
         self._done_cap = max(1, int(finished_timelines))
+        self._evicted_timelines = 0  # finished timelines aged out of the LRU
         # monotonic -> wall clock, for span export and dump timestamps
         self._mono_to_wall = time.time() - time.monotonic()
 
@@ -292,6 +301,7 @@ class FlightRecorder:
         self._done[rid] = (prior + events) if prior else events
         while len(self._done) > self._done_cap:
             self._done.popitem(last=False)
+            self._evicted_timelines += 1
 
     def discard(self, rid: str) -> None:
         """Retire a timeline without phase export (shed before admission,
@@ -403,6 +413,23 @@ class FlightRecorder:
             doc["rate_plan"] = plan
         return doc
 
+    def timelines(self) -> dict[str, list[dict[str, Any]]]:  # acp: cross-thread
+        """Every queryable per-request timeline (finished LRU first, then
+        live), rendered — the trace-export read surface. Timelines survive
+        the global event window rolling (``_by_rid``/``_done`` are indexed
+        separately from the deque); what bounds them is the finished LRU,
+        whose evictions ``stats()['evicted_timelines']`` counts."""
+        with self._lock:
+            snap = [(rid, list(evs)) for rid, evs in self._done.items()]
+            snap += [(rid, list(evs)) for rid, evs in self._by_rid.items()]
+        return {rid: [self._render(e) for e in evs] for rid, evs in snap}
+
+    def truncated_rids(self) -> set[str]:  # acp: cross-thread
+        """Live rids whose timelines hit ``per_request_cap`` (trace export
+        marks these rows rather than exporting a silently short timeline)."""
+        with self._lock:
+            return set(self._truncated_rids)
+
     def request_ids(self, last: int = 32) -> list[str]:  # acp: cross-thread
         """Recently finished + live request ids with queryable timelines
         (newest finished last) — the CLI's discovery surface."""
@@ -420,6 +447,8 @@ class FlightRecorder:
                 "recorded_total": self._recorded,
                 "live_requests": len(self._by_rid),
                 "finished_timelines": len(self._done),
+                "finished_timeline_cap": self._done_cap,
+                "evicted_timelines": self._evicted_timelines,
             }
 
     # -- crash dumps ------------------------------------------------------

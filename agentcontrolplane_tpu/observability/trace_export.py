@@ -1,0 +1,312 @@
+"""Trace export: anonymized, replayable workload traces from the flight
+recorder.
+
+The flight recorder (observability/flight.py) keeps per-request decision
+timelines; this module derives the WORKLOAD MODEL from them — when requests
+arrived, how long their prompts and outputs were, which persona (prefix-
+sharing key) they belonged to, where tool calls landed, which deadlines and
+cancels were in play — and serializes it as a versioned JSON trace document
+that ``scenarios/replay.py`` can play back deterministically at 1x/10x/100x.
+
+Anonymization is structural, not best-effort: the trace carries NO prompt
+or output content, only lengths, monotonic offsets, and 16-hex persona
+fingerprints (the same first-64-token hash the fleet router keys affinity
+on). The replayer regenerates synthetic prompts from the lengths + persona
+keys, so a trace exported from production traffic is safe to commit next to
+the scenario library.
+
+Fleet traces (``export_fleet_trace``) stitch each request's legs — the
+router's own timeline plus every replica-local timeline it linked via
+``engine_rid`` on ``attempt``/``handoff_start`` events — into ONE timeline
+per request, with non-final lifecycle edges kind-rewritten to ``handoff_*``
+so :func:`~agentcontrolplane_tpu.observability.flight.attribute_phases`
+counts ``queue_wait`` exactly once (arrival -> first admission anywhere in
+the pool) and the phases sum to ~end-to-end like single-engine timelines.
+
+Export never silently truncates: recorders count finished-timeline LRU
+evictions (``ACP_FLIGHT_TIMELINES`` raises the cap) and per-request event-
+cap hits, and the trace doc surfaces both under ``flight`` with a
+``complete`` verdict.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+TRACE_VERSION = 1
+
+_TERMINAL_KINDS = ("finish", "expire", "cancel", "shed")
+
+
+def _request_row(events: list[dict]) -> Optional[tuple[float, dict[str, Any]]]:
+    """One trace row from one request's rendered timeline: ``(t_submit,
+    row)``, or None when the timeline has no submit edge (prewarm legs,
+    partial histories that start mid-window)."""
+    sub = next((e for e in events if e["kind"] == "submit"), None)
+    if sub is None:
+        return None
+    t_submit = float(sub["t"])
+    d = sub.get("detail") or {}
+    terminal: Optional[dict] = None
+    tool_offsets: list[float] = []
+    cancel_at: Optional[float] = None
+    for e in events:
+        kind = e["kind"]
+        if kind in _TERMINAL_KINDS:
+            terminal = e
+            if kind == "cancel" and cancel_at is None:
+                cancel_at = float(e["t"]) - t_submit
+        elif kind == "tool_call":
+            tool_offsets.append(float(e["t"]) - t_submit)
+    td = (terminal.get("detail") or {}) if terminal else {}
+    finish = "unknown"
+    if terminal is not None:
+        finish = str(td.get("reason") or terminal["kind"])
+    row: dict[str, Any] = {
+        "prompt_tokens": int(d.get("prompt_tokens") or 0),
+        "output_tokens": int(td.get("tokens") or 0),
+        "persona": str(d.get("key") or ""),
+        "finish": finish,
+    }
+    if d.get("timeout_s") is not None:
+        row["deadline_s"] = round(float(d["timeout_s"]), 6)
+    if cancel_at is not None:
+        row["cancel_after_s"] = round(max(0.0, cancel_at), 6)
+    if tool_offsets:
+        row["tool_calls"] = [
+            {"offset_s": round(max(0.0, o), 6)} for o in tool_offsets
+        ]
+    return t_submit, row
+
+
+def _personas(rows: list[dict]) -> dict[str, dict[str, Any]]:
+    """Persona mix summary. ``prefix_tokens`` is the replayable shared-
+    prefix length: requests sharing a persona key share (at least) their
+    first min(64, shortest prompt) tokens — that is what the fingerprint
+    hashes — so singleton personas get 0 and shared ones get that floor."""
+    by_key: dict[str, list[int]] = {}
+    for r in rows:
+        key = r.get("persona") or ""
+        if key:
+            by_key.setdefault(key, []).append(int(r["prompt_tokens"]))
+    out: dict[str, dict[str, Any]] = {}
+    for key, lens in sorted(by_key.items()):
+        shared = min(64, min(lens)) if len(lens) > 1 else 0
+        out[key] = {"requests": len(lens), "prefix_tokens": shared}
+    return out
+
+
+def _build_doc(
+    timelines: dict[str, list[dict]],
+    source: str,
+    flight_meta: dict[str, Any],
+) -> dict[str, Any]:
+    stamped = []
+    for rid, events in timelines.items():
+        got = _request_row(events)
+        if got is not None:
+            stamped.append(got)
+    stamped.sort(key=lambda p: p[0])
+    t0 = stamped[0][0] if stamped else 0.0
+    rows = []
+    for i, (t_submit, row) in enumerate(stamped):
+        rows.append({
+            "i": i,
+            "offset_s": round(max(0.0, t_submit - t0), 6),
+            **row,
+        })
+    complete = (
+        int(flight_meta.get("evicted_timelines") or 0) == 0
+        and int(flight_meta.get("truncated_rids") or 0) == 0
+        and int(flight_meta.get("missing_legs") or 0) == 0
+    )
+    return {
+        "version": TRACE_VERSION,
+        "source": source,
+        "anonymized": True,
+        "complete": complete,
+        "span_s": rows[-1]["offset_s"] if rows else 0.0,
+        "requests": rows,
+        "personas": _personas(rows),
+        "faults": [],
+        "flight": flight_meta,
+    }
+
+
+def export_trace(recorder) -> dict[str, Any]:
+    """The single-engine trace document: every queryable timeline in
+    ``recorder`` (finished LRU + live) becomes one anonymized request row."""
+    timelines = recorder.timelines()
+    stats = recorder.stats()
+    meta = {
+        "evicted_timelines": int(stats.get("evicted_timelines") or 0),
+        "truncated_rids": len(recorder.truncated_rids()),
+        "missing_legs": 0,
+    }
+    return _build_doc(timelines, "engine", meta)
+
+
+# -- fleet stitching ---------------------------------------------------------
+
+
+def stitch_timelines(
+    legs: list[tuple[str, list[dict]]],
+) -> list[dict[str, Any]]:
+    """Merge one request's legs into a single attribution-safe timeline.
+
+    ``legs`` is ``[(role, rendered_events)]`` with roles ``origin`` (the
+    router's own timeline), ``attempt`` (a decode / failover leg), and
+    ``prefill`` (a disaggregation prefill probe). Events merge in monotonic
+    order (all recorders share one in-process clock), then lifecycle edges
+    are kind-rewritten so ``attribute_phases`` sees exactly one request:
+
+    - the globally earliest ``submit`` / ``admit`` survive; later ones
+      become ``handoff_submit`` / ``handoff_admit`` (a decode replica's own
+      queue wait after a handoff is transfer latency inside ``prefill``,
+      not a second ``queue_wait`` — the double-count this rewrite fixes)
+    - ``prefill_done`` on a ``prefill`` leg becomes
+      ``handoff_prefill_done``: the probe's sampled token is not the
+      caller-visible first token, the decode leg's is
+    - only the globally LAST terminal (``finish``/``expire``/``cancel``/
+      ``shed``) survives; earlier ones (the prefill probe's ``finish``, a
+      crashed attempt's terminal) become ``handoff_<kind>``
+
+    Unknown kinds pass through untouched and ``attribute_phases`` ignores
+    them, so the stitched timeline stays a superset of every leg."""
+    merged: list[tuple[str, dict[str, Any]]] = []
+    for role, events in legs:
+        for ev in events or []:
+            merged.append((role, dict(ev)))
+    merged.sort(key=lambda p: (float(p[1].get("t", 0.0)), int(p[1].get("seq", 0))))
+    first_submit = first_admit = last_terminal = None
+    for idx, (_, ev) in enumerate(merged):
+        kind = ev["kind"]
+        if kind == "submit" and first_submit is None:
+            first_submit = idx
+        elif kind == "admit" and first_admit is None:
+            first_admit = idx
+        elif kind in _TERMINAL_KINDS:
+            last_terminal = idx
+    out: list[dict[str, Any]] = []
+    for idx, (role, ev) in enumerate(merged):
+        kind = ev["kind"]
+        if kind == "submit" and idx != first_submit:
+            ev["kind"] = "handoff_submit"
+        elif kind == "admit" and idx != first_admit:
+            ev["kind"] = "handoff_admit"
+        elif kind == "prefill_done" and role == "prefill":
+            ev["kind"] = "handoff_prefill_done"
+        elif kind in _TERMINAL_KINDS and idx != last_terminal:
+            ev["kind"] = f"handoff_{kind}"
+        ev["seq"] = idx + 1
+        out.append(ev)
+    return out
+
+
+def fleet_request_legs(
+    router, rid: str, events: list[dict]
+) -> tuple[list[tuple[str, list[dict]]], int]:
+    """``(legs, missing)`` for one router-level request: the router's own
+    timeline plus each replica-local leg it linked (``engine_rid`` on
+    ``attempt`` / ``handoff_start`` events). ``missing`` counts linked legs
+    whose replica timeline already aged out of that recorder's LRU."""
+    legs: list[tuple[str, list[dict]]] = [("origin", events)]
+    missing = 0
+    recorders = {
+        r.id: getattr(r.engine, "flight", None) for r in router.pool.replicas()
+    }
+    for ev in events:
+        d = ev.get("detail") or {}
+        engine_rid = d.get("engine_rid")
+        if not engine_rid:
+            continue
+        if ev["kind"] == "attempt":
+            role, replica_id = "attempt", d.get("replica")
+        elif ev["kind"] == "handoff_start":
+            role, replica_id = "prefill", d.get("prefill")
+        else:
+            continue
+        rec = recorders.get(replica_id)
+        leg = rec.timeline(engine_rid) if rec is not None else None
+        if leg:
+            legs.append((role, leg))
+        else:
+            missing += 1
+    return legs, missing
+
+
+def stitched_fleet_timelines(router) -> tuple[dict[str, list[dict]], int]:
+    """``({rid: stitched_events}, missing_legs)`` across every request the
+    router's recorder still holds."""
+    out: dict[str, list[dict]] = {}
+    missing_total = 0
+    for rid, events in router.flight.timelines().items():
+        legs, missing = fleet_request_legs(router, rid, events)
+        missing_total += missing
+        out[rid] = stitch_timelines(legs)
+    return out, missing_total
+
+
+def export_fleet_trace(router) -> dict[str, Any]:
+    """The fleet trace document: one row per ROUTER request, derived from
+    the stitched cross-replica timeline, so a request that crossed a
+    prefill handoff or a failover appears once with end-to-end phases."""
+    timelines, missing = stitched_fleet_timelines(router)
+    evicted = int(router.flight.stats().get("evicted_timelines") or 0)
+    truncated = len(router.flight.truncated_rids())
+    for r in router.pool.replicas():
+        rec = getattr(r.engine, "flight", None)
+        if rec is None:
+            continue
+        evicted += int(rec.stats().get("evicted_timelines") or 0)
+        truncated += len(rec.truncated_rids())
+    meta = {
+        "evicted_timelines": evicted,
+        "truncated_rids": truncated,
+        "missing_legs": missing,
+    }
+    return _build_doc(timelines, "fleet", meta)
+
+
+def validate_trace(doc: Any) -> list[str]:
+    """Structural problems with a trace document (empty list = loadable by
+    the replayer). Checked by ``acp-tpu replay`` and the scenario tests —
+    a trace is an interchange format, so failures name fields, not code."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["trace is not a JSON object"]
+    if doc.get("version") != TRACE_VERSION:
+        problems.append(
+            f"version {doc.get('version')!r} != supported {TRACE_VERSION}"
+        )
+    reqs = doc.get("requests")
+    if not isinstance(reqs, list):
+        return problems + ["requests is not a list"]
+    last_off = -1.0
+    for i, row in enumerate(reqs):
+        if not isinstance(row, dict):
+            problems.append(f"requests[{i}] is not an object")
+            continue
+        off = row.get("offset_s")
+        if not isinstance(off, (int, float)) or off < 0:
+            problems.append(f"requests[{i}].offset_s invalid: {off!r}")
+        elif off < last_off:
+            problems.append(f"requests[{i}].offset_s decreases ({off} < {last_off})")
+        else:
+            last_off = float(off)
+        for field in ("prompt_tokens", "output_tokens"):
+            v = row.get(field)
+            if not isinstance(v, int) or v < 0:
+                problems.append(f"requests[{i}].{field} invalid: {v!r}")
+    return problems
+
+
+__all__ = [
+    "TRACE_VERSION",
+    "export_trace",
+    "export_fleet_trace",
+    "stitch_timelines",
+    "fleet_request_legs",
+    "stitched_fleet_timelines",
+    "validate_trace",
+]

@@ -1,0 +1,29 @@
+"""Scenario factory: trace-driven load generation and the scenario
+library (see docs/scenarios.md).
+
+``observability/trace_export.py`` turns flight-recorder history into
+anonymized trace documents; this package plays them back — deterministic
+virtual-time schedule, seeded synthetic content, 1x/10x/100x — against a
+single Engine or the fleet router, and ``analysis/slo_gate.py`` judges the
+resulting SLO percentiles against per-scenario envelopes."""
+
+from .library import SCENARIOS, build
+from .replay import (
+    ReplayReport,
+    ReplayRow,
+    TraceReplayer,
+    byte_identical,
+    replay,
+    synth_prompt,
+)
+
+__all__ = [
+    "SCENARIOS",
+    "build",
+    "TraceReplayer",
+    "ReplayReport",
+    "ReplayRow",
+    "replay",
+    "byte_identical",
+    "synth_prompt",
+]

@@ -1,0 +1,300 @@
+"""Scenario library: parameterized generators for the traffic shapes the
+engine CLAIMS to handle, emitted as ordinary trace documents.
+
+Every generator returns the same versioned trace format
+``observability/trace_export.py`` exports from live traffic, so there is
+exactly one replayer: a synthetic persona storm and a trace captured off a
+production engine go through the same ``TraceReplayer``, the same
+``acp_scenario_*`` metrics, and the same SLO envelope gate.
+
+The axes (and where each claim was made):
+
+- ``persona_storm``  — same-persona dedup storms: many requests sharing a
+  long prefix arrive nearly at once (prefix-cache dedup, cache-affinity
+  routing, PR 16's hit-rate claims).
+- ``long_tail``      — a short-prompt majority with a long-prompt tail
+  (chunked prefill's head-of-line claims; "Accelerating Long-Tail
+  Generation via Adaptive TP" in PAPERS.md is the traffic model).
+- ``tool_swarm``     — tool-heavy agent turns per Conveyor: every request
+  carries teacher-forced tool-call envelopes, optionally with ``tool.slow``
+  armed so tool latency overlaps decode.
+- ``cancel_churn``   — adversarial deadline/cancel pressure: short
+  deadlines and mid-flight cancels interleaved with healthy traffic (the
+  scheduler's cleanup paths, not its happy path).
+- ``fault_cocktail`` — the fault switchboard rides the trace: preemption
+  pressure, queue-full sheds, and (against a fleet target) a replica crash
+  mid-run, on deterministic ``faults.py`` sites.
+
+Offsets are virtual seconds at 1x; the replayer's ``speed`` compresses
+them. Generators are pure functions of their parameters — no randomness
+that isn't derived from ``seed`` — so a scenario name + kwargs IS the
+workload, reproducibly.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Callable
+
+from ..observability.trace_export import TRACE_VERSION
+
+
+def _persona_key(name: str, seed: int) -> str:
+    """Stable 16-hex persona label, same shape as exported fingerprints."""
+    return hashlib.sha1(f"{seed}:{name}".encode()).hexdigest()[:16]
+
+
+def _doc(
+    name: str,
+    rows: list[dict[str, Any]],
+    personas: dict[str, dict[str, Any]],
+    faults: list[dict[str, Any]],
+) -> dict[str, Any]:
+    rows.sort(key=lambda r: (r["offset_s"], r["i"]))
+    for i, row in enumerate(rows):
+        row["i"] = i
+    return {
+        "version": TRACE_VERSION,
+        "source": f"scenario:{name}",
+        "anonymized": True,
+        "complete": True,
+        "span_s": rows[-1]["offset_s"] if rows else 0.0,
+        "requests": rows,
+        "personas": personas,
+        "faults": faults,
+        "flight": {"evicted_timelines": 0, "truncated_rids": 0, "missing_legs": 0},
+    }
+
+
+def persona_storm(
+    *,
+    n: int = 12,
+    personas: int = 2,
+    prompt_tokens: int = 48,
+    prefix_tokens: int = 32,
+    output_tokens: int = 8,
+    burst_gap_s: float = 0.005,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """``n`` requests across ``personas`` personas, near-simultaneous
+    arrivals, long shared prefixes — the dedup/affinity stress shape."""
+    keys = [_persona_key(f"storm{p}", seed) for p in range(personas)]
+    rows = [
+        {
+            "i": i,
+            "offset_s": round(i * burst_gap_s, 6),
+            "prompt_tokens": prompt_tokens,
+            "output_tokens": output_tokens,
+            "persona": keys[i % personas],
+            "finish": "stop",
+        }
+        for i in range(n)
+    ]
+    meta = {
+        k: {"requests": n // personas, "prefix_tokens": prefix_tokens}
+        for k in keys
+    }
+    return _doc("persona_storm", rows, meta, [])
+
+
+def long_tail(
+    *,
+    n: int = 12,
+    short_tokens: int = 12,
+    long_tokens: int = 120,
+    tail_every: int = 4,
+    short_output: int = 4,
+    long_output: int = 24,
+    interval_s: float = 0.01,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Mostly short prompts with every ``tail_every``-th request a long
+    one — the head-of-line shape chunked prefill exists for."""
+    rows = []
+    personas: dict[str, dict[str, Any]] = {}
+    for i in range(n):
+        tail = tail_every > 0 and (i % tail_every == tail_every - 1)
+        key = _persona_key(f"tail{i}", seed)
+        personas[key] = {"requests": 1, "prefix_tokens": 0}
+        rows.append({
+            "i": i,
+            "offset_s": round(i * interval_s, 6),
+            "prompt_tokens": long_tokens if tail else short_tokens,
+            "output_tokens": long_output if tail else short_output,
+            "persona": key,
+            "finish": "stop",
+        })
+    return _doc("long_tail", rows, personas, [])
+
+
+def tool_swarm(
+    *,
+    n: int = 8,
+    tools_per_request: int = 2,
+    prompt_tokens: int = 32,
+    output_tokens: int = 48,
+    interval_s: float = 0.02,
+    slow_tools: int = 4,
+    tool_delay_s: float = 0.02,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Tool-heavy agent swarm: every request decodes ``tools_per_request``
+    teacher-forced tool-call envelopes; ``slow_tools`` executions run
+    through an armed ``tool.slow`` so tool latency overlaps decode."""
+    key = _persona_key("swarm", seed)
+    rows = [
+        {
+            "i": i,
+            "offset_s": round(i * interval_s, 6),
+            "prompt_tokens": prompt_tokens,
+            "output_tokens": output_tokens,
+            "persona": key,
+            "tool_calls": [
+                {"offset_s": round(0.01 * (j + 1), 6)}
+                for j in range(tools_per_request)
+            ],
+            "finish": "stop",
+        }
+        for i in range(n)
+    ]
+    meta = {key: {"requests": n, "prefix_tokens": min(16, prompt_tokens)}}
+    faults = []
+    if slow_tools > 0:
+        faults.append({
+            "site": "tool.slow", "times": slow_tools, "delay_s": tool_delay_s,
+        })
+    return _doc("tool_swarm", rows, meta, faults)
+
+
+def cancel_churn(
+    *,
+    n: int = 12,
+    lead: int = 2,
+    deadlines: int = 3,
+    cancels: int = 4,
+    prompt_tokens: int = 24,
+    output_tokens: int = 8,
+    doomed_output_tokens: int = 224,
+    burst_gap_s: float = 0.002,
+    cancel_after_s: float = 0.05,
+    deadline_s: float = 0.02,
+    slow_cycles: int = 100,
+    slow_cycle_s: float = 0.03,
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Adversarial churn, arriving in one burst: ``lead`` healthy
+    requests, then ``deadlines`` requests with tight deadlines, then
+    ``cancels`` requests cancelled mid-flight, then healthy stragglers.
+
+    The trace arms ``engine.slow_cycle`` (``slow_cycles`` cycles stretched
+    by ``slow_cycle_s``) so the churn actually churns on fast hardware:
+    with cycles longer than ``deadline_s``, a deadline request still
+    queued when a stretched cycle ends has necessarily out-waited its
+    deadline and is expired by the admission sweep before any prefill is
+    spent on it — a warmed tiny engine would otherwise finish every
+    request before a realistic timer fired and the scenario would silently
+    degrade to happy-path completions. Timing-only: sampled tokens are
+    untouched. Doomed requests carry ``doomed_output_tokens`` so a cancel
+    landing on an already-active slot still finds it decoding."""
+    rows = []
+    personas: dict[str, dict[str, Any]] = {}
+    for i in range(n):
+        key = _persona_key(f"churn{i % 3}", seed)
+        personas.setdefault(key, {"requests": 0, "prefix_tokens": 8})
+        personas[key]["requests"] += 1
+        row: dict[str, Any] = {
+            "i": i,
+            "offset_s": round(i * burst_gap_s, 6),
+            "prompt_tokens": prompt_tokens,
+            "output_tokens": output_tokens,
+            "persona": key,
+            "finish": "stop",
+        }
+        if lead <= i < lead + deadlines:
+            row["output_tokens"] = doomed_output_tokens
+            row["deadline_s"] = deadline_s
+            row["finish"] = "expire"
+        elif lead + deadlines <= i < lead + deadlines + cancels:
+            row["output_tokens"] = doomed_output_tokens
+            row["cancel_after_s"] = cancel_after_s
+            row["finish"] = "cancel"
+        rows.append(row)
+    faults: list[dict[str, Any]] = []
+    if slow_cycles > 0:
+        faults.append({
+            "site": "engine.slow_cycle",
+            "times": slow_cycles,
+            "delay_s": slow_cycle_s,
+        })
+    return _doc("cancel_churn", rows, personas, faults)
+
+
+def fault_cocktail(
+    *,
+    n: int = 10,
+    prompt_tokens: int = 32,
+    output_tokens: int = 12,
+    interval_s: float = 0.02,
+    preempts: int = 2,
+    queue_fulls: int = 1,
+    crash_replica: str = "",
+    seed: int = 0,
+) -> dict[str, Any]:
+    """Steady traffic over an armed fault switchboard: forced preemptions,
+    a queue-full shed, and — when ``crash_replica`` names a fleet replica —
+    a mid-run replica crash that must fail over, all on deterministic
+    ``faults.py`` sites."""
+    key = _persona_key("cocktail", seed)
+    rows = [
+        {
+            "i": i,
+            "offset_s": round(i * interval_s, 6),
+            "prompt_tokens": prompt_tokens,
+            "output_tokens": output_tokens,
+            "persona": key,
+            "finish": "stop",
+        }
+        for i in range(n)
+    ]
+    meta = {key: {"requests": n, "prefix_tokens": min(16, prompt_tokens)}}
+    faults: list[dict[str, Any]] = []
+    if preempts > 0:
+        faults.append({"site": "engine.force_preempt", "times": preempts})
+    if queue_fulls > 0:
+        faults.append({"site": "engine.queue_full", "times": queue_fulls})
+    if crash_replica:
+        faults.append({
+            "site": "fleet.replica_crash", "times": 1, "replica": crash_replica,
+        })
+    return _doc("fault_cocktail", rows, meta, faults)
+
+
+SCENARIOS: dict[str, Callable[..., dict[str, Any]]] = {
+    "persona_storm": persona_storm,
+    "long_tail": long_tail,
+    "tool_swarm": tool_swarm,
+    "cancel_churn": cancel_churn,
+    "fault_cocktail": fault_cocktail,
+}
+
+
+def build(name: str, **kwargs) -> dict[str, Any]:
+    """Build a scenario trace by name (KeyError lists the library)."""
+    try:
+        gen = SCENARIOS[name]
+    except KeyError:
+        raise KeyError(
+            f"unknown scenario {name!r}; library: {sorted(SCENARIOS)}"
+        ) from None
+    return gen(**kwargs)
+
+
+__all__ = [
+    "SCENARIOS",
+    "build",
+    "persona_storm",
+    "long_tail",
+    "tool_swarm",
+    "cancel_churn",
+    "fault_cocktail",
+]

@@ -291,7 +291,9 @@ class RestServer:
         r.add_get("/v1/engine", self.engine_status)
         r.add_get("/v1/engine/perf", self.engine_perf)
         r.add_get("/v1/engine/flight", self.engine_flight)
+        r.add_get("/v1/engine/trace", self.engine_trace)
         r.add_get("/v1/fleet", self.fleet_status)
+        r.add_get("/v1/fleet/trace", self.fleet_trace)
         r.add_get("/v1/requests/{rid}/timeline", self.request_timeline)
         r.add_get("/metrics", self.metrics)
         r.add_get("/healthz", self.healthz)
@@ -1193,6 +1195,33 @@ class RestServer:
             ),
         })
 
+    async def engine_trace(self, request: web.Request) -> web.Response:
+        """Anonymized replayable workload trace derived from the flight
+        recorder (observability/trace_export.py): arrival offsets, token
+        lengths, persona mix, tool-call offsets, deadlines/cancels — no
+        content. Token-authed like every non-health route; the export walks
+        the recorder's declared cross-thread read surface only."""
+        engine = self.operator.engine
+        if engine is None:
+            return _json_error(503, "no TPU engine configured")
+        from ..observability.trace_export import export_trace
+
+        return web.json_response(export_trace(engine.flight))
+
+    async def fleet_trace(self, request: web.Request) -> web.Response:
+        """Fleet-wide trace: one row per ROUTER request, stitched across
+        the router's recorder and every replica-local leg it linked, so
+        handoff/failover traffic appears as one timeline with queue_wait
+        counted once."""
+        fleet = getattr(self.operator, "fleet", None)
+        if fleet is None:
+            return _json_error(
+                503, "no fleet router configured (single-engine deployment)"
+            )
+        from ..observability.trace_export import export_fleet_trace
+
+        return web.json_response(export_fleet_trace(fleet))
+
     async def fleet_status(self, request: web.Request) -> web.Response:
         """Pool status: per-replica row (role, liveness, lease holder +
         fencing epoch, queue depth, goodput, homed affinity keys) plus the
@@ -1318,6 +1347,42 @@ class RestServer:
                 # serves
             except Exception:
                 pass  # a crashed engine must not take /metrics down
+        # fleet gauges refreshed from the router's declared stats() surface
+        # at scrape time, same contract as the engine block above: the pool
+        # only republishes acp_fleet_replicas on membership edges, which
+        # reads stale between a silent replica death and the next heartbeat
+        fleet = getattr(self.operator, "fleet", None)
+        if fleet is not None:
+            try:
+                fs = fleet.stats()
+                routing = fs.get("routing") or {}
+                rows = fs.get("replicas") or []
+                REGISTRY.gauge_set(
+                    "acp_fleet_replicas",
+                    float(sum(1 for r in rows if r.get("alive"))),
+                    help="live engine replicas registered in the fleet pool "
+                    "(lease-backed membership; a crashed or deposed replica "
+                    "drops out on mark_dead)",
+                )
+                REGISTRY.gauge_set(
+                    "acp_fleet_inflight", float(routing.get("inflight", 0)),
+                    help="router submissions alive across the pool (not yet "
+                    "resolved, failed over, or shed)",
+                )
+                REGISTRY.gauge_set(
+                    "acp_fleet_affinity_keys",
+                    float(routing.get("affinity_keys", 0)),
+                    help="distinct persona/prefix affinity keys currently "
+                    "homed to a replica by the cache-affinity router",
+                )
+                REGISTRY.gauge_set(
+                    "acp_fleet_queue_depth",
+                    float(sum(r.get("queue_depth") or 0 for r in rows)),
+                    help="admission-queue depth summed across live fleet "
+                    "replicas (pool-wide backpressure signal)",
+                )
+            except Exception:
+                pass  # a sick router must not take /metrics down
 
     async def healthz(self, request: web.Request) -> web.Response:
         return web.json_response({"status": "ok"})

@@ -72,6 +72,11 @@ ACP_BENCH_QUANT_BASE_TASKS (quantized-serving fixture: effective
 concurrent slots bf16 vs int8 KV at a fixed HBM byte budget, bar >=
 1.5x, plus the byte-identity-relaxed accuracy-gate numbers — emitted as
 the doc's additive ``quant`` block),
+ACP_BENCH_SCENARIOS=1 / ACP_BENCH_SCENARIO_SPEED / ACP_BENCH_SCENARIO_N
+(scenario factory: replay the scenario library — persona storm, long
+tail, tool swarm, cancel churn, fault cocktail — against a single engine
+and a 2-replica fleet pool; per-scenario SLO percentiles land under
+``scenarios.<name>.<single|fleet>`` for --slo-envelopes / --bench-trend),
 ACP_BENCH_FLEET=1 / ACP_BENCH_FLEET_PERSONAS / ACP_BENCH_FLEET_TURNS /
 ACP_BENCH_FLEET_PERSONA / ACP_BENCH_FLEET_PROMPT /
 ACP_BENCH_FLEET_MAX_TOKENS (fleet-tier fixture: affinity vs round-robin
@@ -568,6 +573,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["quant"] = val
             elif key == "fleet" and "fleet" not in doc:
                 doc["fleet"] = val
+            elif key == "scenarios" and "scenarios" not in doc:
+                doc["scenarios"] = val
             elif key == "flight" and "flight" not in doc:
                 doc["flight"] = val
             elif key == "prof" and "prof" not in doc:
@@ -594,6 +601,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT quant", 900))
     if os.environ.get("ACP_BENCH_FLEET", "0") == "1":
         main_schedule.append(("RESULT fleet", 900))
+    if os.environ.get("ACP_BENCH_SCENARIOS", "0") == "1":
+        main_schedule.append(("RESULT scenarios", 1200))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
     if os.environ.get("ACP_BENCH_PROF", "0") == "1":
@@ -1029,6 +1038,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("fleet", _bench_fleet())
         except Exception as e:  # the fixture must not lose the headline
             _result("fleet", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_SCENARIOS", "0") == "1"
+    ):
+        try:
+            _result("scenarios", _bench_scenarios())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("scenarios", {"error": str(e)})
 
     if (
         not args.only_ttft
@@ -1713,6 +1731,102 @@ def _bench_mem() -> dict:
             f"{swap_identical and dedup_identical}"
         ),
     }
+
+
+def _bench_scenarios() -> dict:
+    """Scenario factory fixture (ACP_BENCH_SCENARIOS=1): replay the whole
+    scenario library (scenarios/library.py) against a single engine and a
+    2-replica fleet pool, recording each run's SLO percentile summary
+    under ``scenarios.<name>.<single|fleet>`` — the blocks
+    ``--slo-envelopes`` gates and ``--bench-trend`` trends.
+
+    The single arm also replays the persona storm twice and records the
+    ``byte_identical`` verdict (the replay-determinism contract the
+    scenario tests pin per KV layout).
+
+    Fault scenarios arm the global switchboard from the trace itself; the
+    fleet arm's cocktail crashes replica ``r1`` mid-run, so it runs LAST
+    and the pool is torn down right after. Knobs:
+    ACP_BENCH_SCENARIO_SPEED (1.0), ACP_BENCH_SCENARIO_N (0 = library
+    defaults)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.faults import FAULTS
+    from agentcontrolplane_tpu.fleet import FleetRouter
+    from agentcontrolplane_tpu.kernel import Store
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.scenarios import SCENARIOS, byte_identical, replay
+
+    speed = float(os.environ.get("ACP_BENCH_SCENARIO_SPEED", "1.0"))
+    n = int(os.environ.get("ACP_BENCH_SCENARIO_N", "0"))
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+
+    def build():
+        cfg = dataclasses.replace(
+            PRESETS["tiny"], max_seq_len=512, vocab_size=512
+        )
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            max_ctx=256,
+            prefill_buckets=(32, 64, 128),
+            decode_block_size=4,
+            kv_layout="paged",
+            page_size=16,
+            max_slots=4,
+            check_invariants=armed,
+        )
+        eng.start()
+        return eng
+
+    def traces(crash_replica: str = "") -> list[tuple[str, dict]]:
+        out = []
+        for name, gen in SCENARIOS.items():
+            kw = {"n": n} if n > 0 else {}
+            if name == "fault_cocktail" and crash_replica:
+                kw["crash_replica"] = crash_replica
+            out.append((name, gen(**kw)))
+        # the cocktail (and any replica crash it carries) goes last
+        out.sort(key=lambda p: p[0] == "fault_cocktail")
+        return out
+
+    out: dict = {}
+
+    # -- single-engine arm -------------------------------------------------
+    engine = build()
+    try:
+        engine.prewarm(constrained=True)
+        for name, trace in traces():
+            report = replay(trace, engine, speed=speed, scenario=name)
+            out.setdefault(name, {})["single"] = report.slo_doc()
+            FAULTS.reset()
+        storm = SCENARIOS["persona_storm"](**({"n": n} if n > 0 else {}))
+        a = replay(storm, engine, speed=speed, scenario="persona_storm")
+        b = replay(storm, engine, speed=speed, scenario="persona_storm")
+        out["persona_storm"]["single"]["byte_identical"] = byte_identical(a, b)
+    finally:
+        engine.stop()
+
+    # -- fleet arm ---------------------------------------------------------
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0)
+    engines = [build() for _ in range(2)]
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng)
+    try:
+        for name, trace in traces(crash_replica="r1"):
+            report = replay(trace, router, speed=speed, scenario=name)
+            out.setdefault(name, {})["fleet"] = report.slo_doc()
+            FAULTS.reset()
+    finally:
+        router.stop()
+        for eng in engines:
+            try:
+                eng.stop()
+            except Exception:
+                pass
+    return out
 
 
 def _bench_fleet() -> dict:

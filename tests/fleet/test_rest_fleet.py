@@ -96,3 +96,45 @@ async def test_fleet_endpoint_503_without_router():
         assert resp.status == 503
         doc = await resp.json()
         assert "no fleet router" in doc["error"]
+
+
+async def test_scrape_refresh_fleet_gauges_agree_with_router_stats():
+    """Satellite (ISSUE 17, extending the ISSUE 12 drift gate): every
+    fleet-side gauge the scrape path refreshes must agree with
+    FleetRouter.stats() — a gauge whose scrape-time refresh reads a
+    different field than /v1/fleet serves would silently fork the
+    dashboard from the API."""
+    import re as _re
+
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0)
+    router.add_replica("r0", _StubEngine())
+    router.add_replica("r1", _StubEngine())
+    try:
+        # route traffic with an affinity key so the gauges have signal
+        for i in range(3):
+            router.submit(f"drift probe {i}", affinity_key="persona-a")\
+                .result(timeout=10)
+        async with FleetHarness(fleet=router) as h:
+            text = await (await h.http.get(f"{h.base}/metrics")).text()
+            fs = router.stats()
+
+            def gauge(name: str) -> float:
+                m = _re.search(rf"^{name} (\S+)$", text, _re.M)
+                assert m, f"{name} missing from /metrics"
+                return float(m.group(1))
+
+            rows = fs["replicas"]
+            assert gauge("acp_fleet_replicas") == float(
+                sum(1 for r in rows if r["alive"])
+            ) == 2.0
+            assert gauge("acp_fleet_inflight") == float(
+                fs["routing"]["inflight"]
+            )
+            assert gauge("acp_fleet_affinity_keys") == float(
+                fs["routing"]["affinity_keys"]
+            ) >= 1.0
+            assert gauge("acp_fleet_queue_depth") == float(
+                sum(r["queue_depth"] or 0 for r in rows)
+            )
+    finally:
+        router.stop()

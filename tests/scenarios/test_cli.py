@@ -1,0 +1,196 @@
+"""``acp-tpu trace export`` / ``acp-tpu replay`` CLI: exit codes, the
+validate-before-write contract, scenario building with ``--set``
+overrides, and the ``--gate`` envelope judgement — engine construction is
+stubbed so these stay in the fast tier."""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import Future
+from types import SimpleNamespace
+
+import pytest
+
+from agentcontrolplane_tpu import cli
+from agentcontrolplane_tpu.cli import main as cli_main
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.observability.flight import FlightRecorder
+from agentcontrolplane_tpu.observability.trace_export import validate_trace
+from agentcontrolplane_tpu.scenarios import build
+from agentcontrolplane_tpu.testing import FAULTS
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+# -- replay: validation paths (no engine involved) --------------------------
+
+
+def test_replay_check_validates_a_trace_file(tmp_path, capsys):
+    path = tmp_path / "trace.json"
+    path.write_text(json.dumps(build("persona_storm", n=5)))
+    assert cli_main(["replay", str(path), "--check"]) == 0
+    out = capsys.readouterr().out
+    assert "5 request(s)" in out and "scenario:persona_storm" in out
+
+
+def test_replay_rejects_bad_inputs(tmp_path, capsys):
+    # no trace and no scenario
+    assert cli_main(["replay"]) == 1
+    # both at once
+    assert cli_main(["replay", "x.json", "--scenario", "long_tail"]) == 1
+    # missing file
+    assert cli_main(["replay", str(tmp_path / "ghost.json"), "--check"]) == 1
+    # malformed JSON
+    bad = tmp_path / "bad.json"
+    bad.write_text("{nope")
+    assert cli_main(["replay", str(bad), "--check"]) == 1
+    # valid JSON, unreplayable doc
+    bad.write_text(json.dumps({"version": 999, "requests": []}))
+    assert cli_main(["replay", str(bad), "--check"]) == 1
+    # unknown scenario and malformed --set
+    assert cli_main(["replay", "--scenario", "nope", "--check"]) == 1
+    assert cli_main(
+        ["replay", "--scenario", "long_tail", "--set", "garbage", "--check"]
+    ) == 1
+    err = capsys.readouterr().err
+    assert "unreplayable" in err and "unknown scenario" in err
+
+
+def test_replay_scenario_set_overrides(capsys):
+    assert cli_main(
+        ["replay", "--scenario", "cancel_churn", "--set", "n=6", "--check"]
+    ) == 0
+    assert "6 request(s)" in capsys.readouterr().out
+
+
+# -- replay: the run + --gate exit codes (stubbed engine) -------------------
+
+
+class _InstantEngine:
+    """Duck-typed replay target: every request completes immediately with
+    the same tokens — deterministic, fast, and envelope-friendly for
+    persona_storm but (by construction) churn-free."""
+
+    tokenizer = ByteTokenizer()
+
+    def start(self):
+        pass
+
+    def stop(self):
+        pass
+
+    def submit(self, prompt, sampling=None, on_tokens=None, **kw):
+        toks = [1, 2, 3]
+        if on_tokens is not None:
+            on_tokens(toks[:2])
+            on_tokens(toks[2:])
+        fut = Future()
+        fut.set_result(SimpleNamespace(
+            text="abc", tokens=toks, finish_reason="stop", preempt_count=0,
+        ))
+        return fut
+
+    def cancel(self, fut):
+        fut.cancel()
+
+    def stats(self):
+        return {"perf": {"goodput": {"ratio": 0.9}}}
+
+
+@pytest.fixture
+def instant_engine(monkeypatch):
+    monkeypatch.setattr(cli, "_build_engine", lambda args: _InstantEngine())
+
+
+def test_replay_run_prints_slo_json_and_passes_gate(instant_engine, capsys):
+    rc = cli_main([
+        "replay", "--scenario", "persona_storm", "--set", "n=4",
+        "--no-prewarm", "--json", "--gate",
+    ])
+    assert rc == 0
+    out = capsys.readouterr().out
+    slo = json.loads(out[:out.rindex("}") + 1])
+    assert slo["scenario"] == "persona_storm"
+    assert slo["completed"] == 4 and slo["errors"] == 0
+    assert slo["goodput_ratio"] == 0.9
+    assert "inside its envelope" in out
+
+
+def test_replay_gate_violation_exits_2(instant_engine, capsys):
+    # an instant engine never cancels or expires anything, so cancel_churn's
+    # envelope (min_cancelled/min_expired floors) must trip
+    rc = cli_main([
+        "replay", "--scenario", "cancel_churn", "--set", "n=6",
+        "--no-prewarm", "--gate",
+    ])
+    assert rc == 2
+    out = capsys.readouterr().out
+    assert "envelope violation" in out
+
+
+# -- trace export against a live REST server --------------------------------
+
+
+def _recorded_engine():
+    rec = FlightRecorder(enabled=True)
+    for i, rid in enumerate(("ra", "rb")):
+        rec.record("submit", rid=rid, prompt_tokens=12 + i, key=f"k{i}")
+        rec.record("admit", rid=rid)
+        rec.record("prefill_done", rid=rid)
+        rec.finish(rid, "stop", tokens=3)
+    return SimpleNamespace(flight=rec)
+
+
+def test_cli_trace_export_roundtrips_through_replay_check(tmp_path):
+    """Export off a live server, then feed the written file straight back
+    through ``replay --check``: an exit-0 export is a replayable trace."""
+    import asyncio
+    import threading
+
+    from agentcontrolplane_tpu.operator import Operator, OperatorOptions
+
+    started = threading.Event()
+    port = {}
+    box = {}
+
+    def server_thread():
+        async def run():
+            op = Operator(options=OperatorOptions(
+                enable_rest=True, api_port=0, llm_probe=False,
+                verify_channel_credentials=False,
+            ))
+            op.engine = _recorded_engine()
+            await op.start()
+            while not op.rest_server.bound_port:
+                await asyncio.sleep(0.01)
+            port["p"] = op.rest_server.bound_port
+            box["stop"] = asyncio.Event()
+            started.set()
+            await box["stop"].wait()
+            await op.stop()
+
+        loop = asyncio.new_event_loop()
+        box["loop"] = loop
+        loop.run_until_complete(run())
+
+    t = threading.Thread(target=server_thread, daemon=True)
+    t.start()
+    assert started.wait(10)
+    server = f"http://127.0.0.1:{port['p']}"
+    try:
+        out = tmp_path / "trace.json"
+        assert cli_main(["--server", server, "trace", "export", "-o", str(out)]) == 0
+        doc = json.loads(out.read_text())
+        assert validate_trace(doc) == []
+        assert len(doc["requests"]) == 2
+        # no fleet configured: the fleet arm fails loudly, not emptily
+        assert cli_main(["--server", server, "trace", "export", "--fleet"]) == 1
+        # the round trip: exported file -> replayer validation
+        assert cli_main(["replay", str(out), "--check"]) == 0
+    finally:
+        box["loop"].call_soon_threadsafe(box["stop"].set)
+        t.join(timeout=10)

@@ -1,0 +1,249 @@
+"""Trace export (observability/trace_export.py): anonymization, the
+stitched cross-replica attribution contract (queue_wait counted once — the
+PR's pinned bugfix), and the no-silent-truncation guarantees around the
+flight recorder's bounded windows."""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.flight import (
+    FlightRecorder,
+    attribute_phases,
+)
+from agentcontrolplane_tpu.observability.trace_export import (
+    TRACE_VERSION,
+    export_trace,
+    stitch_timelines,
+    validate_trace,
+)
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(
+    PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2
+)
+
+
+def make_engine(**kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=64,
+        prefill_buckets=(32, 64), decode_block_size=4, kv_layout="paged",
+        page_size=8, **kw,
+    )
+    eng.start()
+    return eng
+
+
+# -- stitching: the queue_wait double-count bugfix (pure, no engine) -------
+
+
+def _ev(seq, t, kind, **detail):
+    out = {"seq": seq, "t": t, "kind": kind}
+    if detail:
+        out["detail"] = detail
+    return out
+
+
+def _disagg_legs():
+    """A synthetic disaggregated request: router timeline + a prefill
+    probe leg + the decode leg, each with its own submit/admit lifecycle
+    (exactly what two independent recorders capture today)."""
+    origin = [
+        _ev(1, 10.0, "submit", prompt_tokens=40, key="p"),
+        _ev(2, 10.001, "handoff_start", prefill="pf", engine_rid="e1"),
+        _ev(3, 10.9, "finish", reason="stop", tokens=8),
+    ]
+    prefill = [
+        _ev(1, 10.002, "submit", prompt_tokens=40),
+        _ev(2, 10.102, "admit"),        # queue_wait leg 1: 100ms
+        _ev(3, 10.302, "prefill_done"),  # the 1-token probe
+        _ev(4, 10.303, "finish", reason="length", tokens=1),
+    ]
+    decode = [
+        _ev(1, 10.35, "submit", prompt_tokens=40),
+        _ev(2, 10.55, "admit"),         # queue_wait leg 2: 200ms
+        _ev(3, 10.65, "prefill_done"),  # caller-visible first token
+        _ev(4, 10.9, "finish", reason="stop", tokens=8),
+    ]
+    return [("origin", origin), ("prefill", prefill), ("attempt", decode)]
+
+
+def test_naive_per_leg_sum_double_counts_queue_wait():
+    """The bug being fixed, pinned: attributing each replica's leg
+    independently and summing counts queue_wait twice (once per leg)."""
+    legs = _disagg_legs()
+    total_queue = sum(
+        attribute_phases(events)[0].get("queue_wait", 0.0)
+        for _, events in legs
+    )
+    assert total_queue == pytest.approx(0.3, abs=1e-6)  # 0.1 + 0.2 — wrong
+
+
+def test_stitched_timeline_counts_queue_wait_once_and_sums_to_e2e():
+    """Stitched: queue_wait = arrival -> FIRST admission anywhere in the
+    pool (the prefill replica's, here); the decode replica's own wait is
+    transfer latency inside prefill; phases sum to ~end-to-end."""
+    stitched = stitch_timelines(_disagg_legs())
+    durations, _ = attribute_phases(stitched)
+    # arrival 10.0 (router submit) -> prefill admit 10.102
+    assert durations["queue_wait"] == pytest.approx(0.102, abs=1e-6)
+    # first admission -> caller-visible first token (decode leg's)
+    assert durations["prefill"] == pytest.approx(10.65 - 10.102, abs=1e-6)
+    assert durations["decode"] == pytest.approx(10.9 - 10.65, abs=1e-6)
+    total = (
+        durations["queue_wait"] + durations["prefill"] + durations["decode"]
+    )
+    assert total == pytest.approx(0.9, abs=1e-6)  # submit 10.0 -> finish 10.9
+
+
+def test_stitch_rewrites_non_final_edges():
+    stitched = stitch_timelines(_disagg_legs())
+    kinds = [e["kind"] for e in stitched]
+    assert kinds.count("submit") == 1
+    assert kinds.count("admit") == 1
+    assert kinds.count("prefill_done") == 1  # the decode leg's
+    assert kinds.count("finish") == 1        # the globally last terminal
+    assert "handoff_submit" in kinds and "handoff_admit" in kinds
+    assert "handoff_prefill_done" in kinds and "handoff_finish" in kinds
+    # seq renumbered monotonically over the merged order
+    assert [e["seq"] for e in stitched] == list(range(1, len(stitched) + 1))
+
+
+def test_stitch_failover_keeps_crashed_attempts_first_token():
+    """A failover retry: the crashed attempt streamed caller-visible
+    tokens, so ITS prefill_done is the request's first token — attempt
+    legs keep prefill_done, only the prefill role loses it."""
+    origin = [
+        _ev(1, 5.0, "submit", prompt_tokens=10, key="p"),
+        _ev(2, 6.0, "finish", reason="stop", tokens=6),
+    ]
+    crashed = [
+        _ev(1, 5.001, "submit"), _ev(2, 5.1, "admit"),
+        _ev(3, 5.2, "prefill_done"),
+    ]
+    retry = [
+        _ev(1, 5.4, "submit"), _ev(2, 5.5, "admit"),
+        _ev(3, 5.6, "prefill_done"),
+        _ev(4, 5.99, "finish", reason="stop", tokens=6),
+    ]
+    stitched = stitch_timelines(
+        [("origin", origin), ("attempt", crashed), ("attempt", retry)]
+    )
+    durations, _ = attribute_phases(stitched)
+    assert durations["queue_wait"] == pytest.approx(0.1, abs=1e-6)
+    # first token stays the crashed attempt's (5.2), decode runs to the
+    # router finish (6.0)
+    assert durations["prefill"] == pytest.approx(0.1, abs=1e-6)
+    assert durations["decode"] == pytest.approx(0.8, abs=1e-6)
+
+
+# -- single-engine export --------------------------------------------------
+
+
+def test_export_is_anonymized_and_replayable():
+    eng = make_engine()
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=4)
+        prompts = ["shared persona prefix A!", "shared persona prefix A!",
+                   "a different one entirely"]
+        for f in [eng.submit(p, sp) for p in prompts]:
+            f.result(timeout=120)
+        doc = export_trace(eng.flight)
+        assert doc["version"] == TRACE_VERSION
+        assert doc["anonymized"] is True and doc["complete"] is True
+        assert validate_trace(doc) == []
+        rows = doc["requests"]
+        assert len(rows) == 3
+        # no content anywhere: only lengths, offsets, hashes
+        for row in rows:
+            assert set(row) <= {
+                "i", "offset_s", "prompt_tokens", "output_tokens", "persona",
+                "finish", "deadline_s", "cancel_after_s", "tool_calls",
+            }
+            assert row["prompt_tokens"] == len(prompts[0])  # ASCII 1:1
+            assert 1 <= row["output_tokens"] <= 4  # actual, EOS may cut in
+            assert len(row["persona"]) == 16
+        # the two same-prefix prompts share a persona fingerprint
+        personas = [r["persona"] for r in rows]
+        assert len(set(personas)) == 2
+        shared = [k for k, v in doc["personas"].items() if v["requests"] == 2]
+        assert len(shared) == 1
+        assert doc["personas"][shared[0]]["prefix_tokens"] > 0
+    finally:
+        eng.stop()
+
+
+def test_validate_trace_rejects_malformed_docs():
+    assert validate_trace([]) == ["trace is not a JSON object"]
+    assert any("version" in p for p in validate_trace({"version": 99}))
+    bad = {
+        "version": TRACE_VERSION,
+        "requests": [
+            {"offset_s": 1.0, "prompt_tokens": 4, "output_tokens": 1},
+            {"offset_s": 0.5, "prompt_tokens": -1, "output_tokens": 1},
+        ],
+    }
+    probs = validate_trace(bad)
+    assert any("decreases" in p for p in probs)
+    assert any("prompt_tokens" in p for p in probs)
+
+
+# -- no-silent-truncation: window roll + finished-LRU eviction -------------
+
+
+def test_timelines_survive_global_window_roll():
+    """The global deque rolling must not cost per-request replayability:
+    a recorder whose window holds 16 events still renders every event of
+    every request (the _by_rid index is independent of the deque)."""
+    rec = FlightRecorder(capacity=16, enabled=True, finished_timelines=64)
+    rids = [f"r{i}" for i in range(8)]
+    for i, rid in enumerate(rids):
+        rec.record("submit", rid=rid, prompt_tokens=4)
+        rec.record("admit", rid=rid)
+        rec.record("prefill_done", rid=rid)
+        rec.finish(rid, "stop", tokens=2)
+    stats = rec.stats()
+    assert stats["window_events"] == 16          # the window DID roll
+    assert stats["recorded_total"] == 32
+    assert stats["evicted_timelines"] == 0
+    doc = export_trace(rec)
+    assert doc["complete"] is True
+    assert len(doc["requests"]) == 8             # nothing truncated
+    for rid in rids:
+        assert [e["kind"] for e in rec.timeline(rid)] == [
+            "submit", "admit", "prefill_done", "finish",
+        ]
+
+
+def test_finished_lru_eviction_is_counted_not_silent():
+    """What CAN truncate an export is the finished-timeline LRU; the
+    recorder counts evictions and the trace doc drops its ``complete``
+    verdict instead of quietly shipping a short request list."""
+    rec = FlightRecorder(capacity=256, enabled=True, finished_timelines=2)
+    for i in range(5):
+        rid = f"r{i}"
+        rec.record("submit", rid=rid, prompt_tokens=4)
+        rec.finish(rid, "stop", tokens=1)
+    stats = rec.stats()
+    assert stats["finished_timelines"] == 2
+    assert stats["finished_timeline_cap"] == 2
+    assert stats["evicted_timelines"] == 3
+    doc = export_trace(rec)
+    assert doc["complete"] is False
+    assert doc["flight"]["evicted_timelines"] == 3
+    assert len(doc["requests"]) == 2
+
+
+def test_flight_timelines_env_knob(monkeypatch):
+    monkeypatch.setenv("ACP_FLIGHT_TIMELINES", "7")
+    rec = FlightRecorder(enabled=True)
+    assert rec.stats()["finished_timeline_cap"] == 7

@@ -1,0 +1,91 @@
+"""``GET /v1/engine/trace`` and ``GET /v1/fleet/trace`` — the flight
+recorder's anonymized replayable trace through the REST front door, the
+503 posture when unconfigured, and the bearer-token gate."""
+
+from __future__ import annotations
+
+from types import SimpleNamespace
+
+from agentcontrolplane_tpu.fleet import FleetRouter
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.observability.flight import FlightRecorder
+from agentcontrolplane_tpu.observability.trace_export import (
+    TRACE_VERSION,
+    validate_trace,
+)
+
+from .test_rest import RestHarness
+from ..fleet.test_rest_fleet import FleetHarness, _StubEngine
+
+
+def _recorded_engine() -> SimpleNamespace:
+    """A stand-in engine whose flight recorder carries two finished
+    requests — /v1/engine/trace only walks the recorder's declared
+    cross-thread surface, so the trace path needs no TPU engine."""
+    rec = FlightRecorder(enabled=True)
+    for i, rid in enumerate(("ra", "rb")):
+        rec.record("submit", rid=rid, prompt_tokens=10 + i, key=f"k{i}")
+        rec.record("admit", rid=rid)
+        rec.record("prefill_done", rid=rid)
+        rec.finish(rid, "stop", tokens=3)
+    return SimpleNamespace(flight=rec)
+
+
+async def test_engine_trace_503_without_engine():
+    async with RestHarness() as h:
+        resp = await h.http.get(f"{h.base}/v1/engine/trace")
+        assert resp.status == 503
+
+
+async def test_engine_trace_serves_valid_anonymized_doc():
+    h = RestHarness()
+    h.operator.engine = _recorded_engine()
+    async with h:
+        resp = await h.http.get(f"{h.base}/v1/engine/trace")
+        assert resp.status == 200
+        doc = await resp.json()
+        assert doc["version"] == TRACE_VERSION
+        assert doc["anonymized"] is True
+        assert validate_trace(doc) == []
+        assert len(doc["requests"]) == 2
+        assert {r["prompt_tokens"] for r in doc["requests"]} == {10, 11}
+
+
+async def test_engine_trace_requires_token_when_configured():
+    h = RestHarness(api_token="s3cret-trace")
+    h.operator.engine = _recorded_engine()
+    async with h:
+        resp = await h.http.get(f"{h.base}/v1/engine/trace")
+        assert resp.status == 401
+        resp = await h.http.get(
+            f"{h.base}/v1/engine/trace",
+            headers={"Authorization": "Bearer s3cret-trace"},
+        )
+        assert resp.status == 200
+
+
+async def test_fleet_trace_503_without_router():
+    async with RestHarness() as h:
+        resp = await h.http.get(f"{h.base}/v1/fleet/trace")
+        assert resp.status == 503
+
+
+async def test_fleet_trace_serves_stitched_doc():
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0)
+    router.add_replica("r0", _StubEngine())
+    router.add_replica("r1", _StubEngine())
+    try:
+        for i in range(3):
+            router.submit(f"fleet trace req {i}").result(timeout=10)
+        async with FleetHarness(fleet=router) as h:
+            resp = await h.http.get(f"{h.base}/v1/fleet/trace")
+            assert resp.status == 200
+            doc = await resp.json()
+            assert doc["source"] == "fleet"
+            assert validate_trace(doc) == []
+            assert len(doc["requests"]) == 3
+            # stub replicas have no recorders: every linked engine leg is
+            # reported missing rather than silently dropped
+            assert doc["flight"]["missing_legs"] >= 1
+    finally:
+        router.stop()
