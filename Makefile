@@ -4,6 +4,7 @@
 PY ?= python
 
 .PHONY: test test-unit test-e2e test-stress bench run run-multi lint lint-acp \
+	chaos-smoke chaos-soak \
 	dryrun ci docker-build docker-run observability-up observability-down
 
 IMG ?= acp-tpu:dev
@@ -36,6 +37,13 @@ test-stress:
 bench:
 	$(PY) bench.py
 
+chaos-smoke:  ## one seeded fault cocktail against a live 3-replica fleet, invariants gated (fast CI tier)
+	$(PY) -m agentcontrolplane_tpu.cli chaos --seed 3 --gate --replicas 3 --speed 20 \
+	  --set n=8 --tpu-preset tiny --tpu-slots 4 --tpu-ctx 64 --tpu-kv-layout paged --no-prewarm
+
+chaos-soak:  ## multi-seed chaos soak + the rest of the slow tier's chaos coverage
+	$(PY) -m pytest tests/scenarios/test_chaos.py -q -m slow
+
 dryrun:
 	$(PY) -c "from __graft_entry__ import dryrun_multichip; dryrun_multichip(8)"
 
@@ -67,6 +75,7 @@ ACP_LINT_BUDGET_S ?= 30
 
 lint-acp:  ## repo-custom static analysis (acplint) — the engine's correctness contracts
 	$(PY) -m agentcontrolplane_tpu.analysis --metrics-docs docs/observability.md \
+		--faults-docs \
 		--timing --timing-budget $(ACP_LINT_BUDGET_S) \
 		--suppression-budget $(ACP_LINT_SUPPRESSIONS) \
 		--json acplint-findings.json \

@@ -120,6 +120,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         "every Registry call in the package (both drift directions fail)",
     )
     ap.add_argument(
+        "--faults-docs",
+        action="store_true",
+        help="also check the faults.py docstring inventory against every "
+        "switchboard consumption site in the package (both drift "
+        "directions fail)",
+    )
+    ap.add_argument(
         "--bench-trend",
         nargs="?",
         const=str(_PACKAGE_ROOT.parent),
@@ -168,6 +175,14 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
 
         violations = sorted(
             violations + check_metrics_docs(_PACKAGE_ROOT, args.metrics_docs),
+            key=lambda v: (v.path, v.line, v.rule),
+        )
+    if args.faults_docs and not args.rule:
+        # same scoping contract as --metrics-docs
+        from .faults_docs import check_faults_docs
+
+        violations = sorted(
+            violations + check_faults_docs(_PACKAGE_ROOT),
             key=lambda v: (v.path, v.line, v.rule),
         )
     total_s = time.perf_counter() - t0

@@ -138,6 +138,19 @@ METRICS: tuple[MetricSpec, ...] = (
         ("fleet", "handoff", "handoff_bytes"),
         "higher", rel_tol=0.5,
     ),
+    # gray-failure hardening (PR 19): hedged re-dispatch must keep cutting
+    # the stuck-request tail vs the no-hedging control arm (self-relative
+    # ratio, judged everywhere; >1 means hedging helps), and the hedged
+    # arm's absolute tail gets a very wide CPU-wall-clock band. The chaos
+    # verdict itself is a boolean the chaos smoke test pins, not a trend.
+    MetricSpec(
+        "chaos_e2e_p99_improvement_x",
+        ("chaos", "e2e_p99_improvement"), "higher", rel_tol=0.5,
+    ),
+    MetricSpec(
+        "chaos_hedging_on_e2e_p99_ms",
+        ("chaos", "hedging_on", "e2e_p99_ms"), "lower", rel_tol=3.0,
+    ),
 )
 
 # scenario SLO percentiles (PR 17): every library scenario the bench runs

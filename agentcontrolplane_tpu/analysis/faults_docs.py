@@ -1,0 +1,145 @@
+"""faults-docs: the fault-site inventory in faults.py must not rot.
+
+``faults.py``'s module docstring is the load-bearing catalogue of every
+injection site — docs/scenarios.md, the chaos conductor, and the test
+suite all treat it as the contract for what can be armed and what each
+site guarantees (byte-identical vs. cleanly-degrading). PR 19 added new
+consumers (``engine.slow_cycle`` grew a ``replica=`` match; chaos arms
+cocktails straight from the inventory), which is exactly how drift
+starts: a site gets added or renamed at its ``pop`` call site and the
+docstring keeps describing the old world.
+
+acplint-style gate, both directions:
+
+- **code side** — every consumption site is harvested from the AST:
+  string literals passed as the first argument to ``<...>.pop(...)``
+  where the receiver chain ends in ``FAULTS`` or ``_faults`` (the
+  injector handle under either name), plus ``<...>._armed.get(...)``
+  (the ``engine.page_pressure`` idiom, which converges instead of
+  popping). A NON-literal site name on a switchboard ``pop`` is itself a
+  violation: a dynamically built site can't be inventoried.
+- **docs side** — every ``- ``site.name``` bullet in the faults.py
+  module docstring.
+
+Every consumed site must be catalogued and every catalogued site must
+still have a consumer; either direction of drift is a violation pointing
+at the call site (or the stale docstring bullet). Runs stdlib-only from
+a bare checkout like the rest of ``analysis/`` (``make lint-acp`` wires
+it in via ``--faults-docs``).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+from .core import Violation, dotted_name, iter_py_files
+
+# the injector handle, whichever alias a module holds it under
+_INJECTOR_TAILS = {"FAULTS", "_faults"}
+# docstring bullets: "- ``engine.slow_cycle`` — ..."
+_BULLET_RE = re.compile(r"^\s*-\s+``([a-z_]+(?:\.[a-z_]+)+)``")
+
+
+def _receiver_tail(node: ast.Call) -> str:
+    recv = dotted_name(node.func.value) if isinstance(node.func, ast.Attribute) else None
+    return recv.rsplit(".", 1)[-1] if recv else ""
+
+
+def code_fault_sites(package_root: str | Path) -> tuple[dict[str, tuple[str, int]], list[Violation]]:
+    """Harvest ``{site: (relpath, line)}`` of first consumption per site
+    from every module under ``package_root``, plus violations for dynamic
+    (un-inventoriable) site names on switchboard pops."""
+    sites: dict[str, tuple[str, int]] = {}
+    problems: list[Violation] = []
+    for path, rel in iter_py_files([package_root]):
+        try:
+            tree = ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+        except (SyntaxError, UnicodeDecodeError):
+            continue  # the main lint already reports parse errors
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute)):
+                continue
+            tail = _receiver_tail(node)
+            is_pop = node.func.attr == "pop" and tail in _INJECTOR_TAILS
+            # engine.page_pressure converges via _armed.get() instead of
+            # popping; the injector's own generic get(site) uses a
+            # variable and is skipped by the literal filter below
+            is_get = node.func.attr == "get" and tail == "_armed"
+            if not (is_pop or is_get) or not node.args:
+                continue
+            first = node.args[0]
+            if isinstance(first, ast.Constant) and isinstance(first.value, str):
+                name = first.value
+                if "." in name and name not in sites:
+                    sites[name] = (rel, node.lineno)
+            elif is_pop:
+                problems.append(
+                    Violation(
+                        "faults-docs",
+                        rel,
+                        node.lineno,
+                        "pop() called with a non-literal fault site — "
+                        "dynamic sites can't be inventoried against the "
+                        "faults.py docstring (use the match= filter for "
+                        "scoping, not name construction)",
+                    )
+                )
+    return sites, problems
+
+
+def doc_fault_sites(faults_path: str | Path) -> dict[str, int]:
+    """``{site: line number}`` of every inventory bullet in the faults.py
+    module docstring."""
+    source = Path(faults_path).read_text(encoding="utf-8")
+    tree = ast.parse(source, filename=str(faults_path))
+    doc = ast.get_docstring(tree, clean=False)
+    out: dict[str, int] = {}
+    if not doc:
+        return out
+    # the docstring starts on line 1 in this repo's layout; locate each
+    # bullet by its literal line so the violation points at the entry
+    lines = source.splitlines()
+    for lineno, line in enumerate(lines, 1):
+        m = _BULLET_RE.match(line)
+        if m:
+            out.setdefault(m.group(1), lineno)
+    return out
+
+
+def check_faults_docs(package_root: str | Path) -> list[Violation]:
+    """Violations for both drift directions (empty = inventory in sync)."""
+    package_root = Path(package_root)
+    faults_path = package_root / "faults.py"
+    if not faults_path.exists():
+        return [Violation("faults-docs", str(faults_path), 1, "faults.py does not exist")]
+    consumed, problems = code_fault_sites(package_root)
+    documented = doc_fault_sites(faults_path)
+    doc_rel = faults_path.as_posix()
+    for name, (rel, line) in sorted(consumed.items()):
+        if name not in documented:
+            problems.append(
+                Violation(
+                    "faults-docs",
+                    rel,
+                    line,
+                    f"fault site {name} is consumed here but missing from "
+                    "the faults.py inventory docstring — document it (the "
+                    "inventory is the chaos/test contract for what each "
+                    "site guarantees)",
+                )
+            )
+    for name, line in sorted(documented.items()):
+        if name not in consumed:
+            problems.append(
+                Violation(
+                    "faults-docs",
+                    doc_rel,
+                    line,
+                    f"fault site {name} is catalogued but no module "
+                    "consumes it — delete the stale bullet or restore the "
+                    "call site",
+                )
+            )
+    return problems

@@ -167,10 +167,12 @@ def _add_tpu_flags(p) -> None:
     )
 
 
-def _build_engine(args, coordination=None):
+def _build_engine(args, coordination=None, **engine_kw):
     """Engine construction shared by `run` (leader/single-host) and
     `engine-follower` — multi-host lockstep requires every rank to build
-    the IDENTICAL engine (same config/mesh/layout flags)."""
+    the IDENTICAL engine (same config/mesh/layout flags). ``engine_kw``
+    lets callers layer construction-only knobs the flag surface doesn't
+    carry (the chaos drill arms ``check_invariants`` on every replica)."""
     from .engine.engine import Engine
     from .engine.tokenizer import ByteTokenizer, HFTokenizer
 
@@ -194,6 +196,7 @@ def _build_engine(args, coordination=None):
         autopilot=bool(args.tpu_autopilot),
         coordination=coordination,
     )
+    kw.update(engine_kw)
     if args.tpu_tp or args.tpu_sp > 1 or args.tpu_ep > 1:
         from .parallel.mesh import serving_mesh
 
@@ -1050,6 +1053,83 @@ def cmd_replay(args) -> int:
     return 0
 
 
+def cmd_chaos(args) -> int:
+    """Seeded chaos drill: build an in-process fleet of ``--replicas``
+    engines (invariant checkers armed) behind a FleetRouter, pour the
+    seed's deterministic fault cocktail over a library-scenario replay,
+    and judge the invariants that must survive graceful faults — request
+    conservation, exactly-once streams, zero unexplained errors.
+
+    Exit codes: 0 the run survived (or no --gate); 1 operational failure
+    (construction / scenario errors); 2 an invariant tripped (--gate)."""
+    from .fleet import FleetRouter
+    from .kernel import Store
+    from .scenarios import run_chaos
+
+    try:
+        overrides = _scenario_overrides(args.overrides)
+    except ValueError as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    engines: list = []
+    router = None
+    try:
+        router = FleetRouter(
+            store=Store(), heartbeat_interval=60.0,
+            hedge_after_s=args.hedge_after_s,
+        )
+        for i in range(max(1, args.replicas)):
+            engine = _build_engine(args, check_invariants=True)
+            engine.start()
+            engines.append(engine)
+            router.add_replica(f"r{i}", engine)
+        if args.prewarm:
+            for engine in engines:
+                engine.prewarm(constrained=True)
+        report = run_chaos(
+            router, seed=args.seed, scenario=args.scenario,
+            speed=args.speed, scenario_kwargs=overrides,
+        )
+    except (KeyError, TypeError, ValueError) as exc:
+        print(f"error: {exc}", file=sys.stderr)
+        return 1
+    finally:
+        if router is not None:
+            router.stop()
+        for engine in engines:
+            try:
+                engine.stop()
+            except Exception:
+                pass
+    doc = report.doc()
+    if args.json:
+        print(json.dumps(doc, indent=2, sort_keys=True))
+    else:
+        slo = doc["slo"]
+        print(
+            f"chaos seed {report.seed} over {report.scenario}: "
+            f"{len(report.ledger)}/{len(report.schedule)} fault(s) armed "
+            f"across {slo['requests']} request(s) at {args.speed:g}x"
+        )
+        for offset, site, spec in report.ledger:
+            detail = " ".join(f"{k}={v}" for k, v in sorted(spec.items()))
+            print(f"  +{offset:7.3f}s  {site:<24}{detail}")
+        print(
+            f"  outcomes: {slo['completed']} completed, {slo['shed']} shed, "
+            f"{slo['cancelled']} cancelled, {slo['expired']} expired, "
+            f"{slo['errors']} error(s)"
+        )
+        if report.ok():
+            print("  invariants: all held")
+        else:
+            print(f"  invariants: {len(report.violations)} violation(s):")
+            for violation in report.violations:
+                print(f"    {violation}")
+    if args.gate and not report.ok():
+        return 2
+    return 0
+
+
 def _print_flight_event(e: dict, rel_key: str | None = None) -> None:
     stamp = (
         f"+{e[rel_key] * 1e3:9.1f}ms" if rel_key and rel_key in e
@@ -1277,6 +1357,44 @@ def build_parser() -> argparse.ArgumentParser:
     )
     _add_tpu_flags(rp)
     rp.set_defaults(fn=cmd_replay)
+
+    ch = sub.add_parser(
+        "chaos",
+        help="seeded chaos drill: a deterministic fault cocktail poured "
+        "over a library scenario against an in-process replica fleet, "
+        "with exactly-once/conservation invariants judged at the end",
+    )
+    ch.add_argument("--seed", type=int, default=0,
+                    help="schedule seed (same seed = same fault schedule)")
+    ch.add_argument(
+        "--scenario", default="persona_storm",
+        help="library scenario to replay under the cocktail",
+    )
+    ch.add_argument(
+        "--set", action="append", default=[], metavar="K=V", dest="overrides",
+        help="scenario generator kwarg override, repeatable (e.g. --set n=24)",
+    )
+    ch.add_argument("--replicas", type=int, default=3,
+                    help="fleet size: in-process engine replicas")
+    ch.add_argument("--speed", type=float, default=10.0,
+                    help="virtual-time compression for arrivals AND faults")
+    ch.add_argument(
+        "--hedge-after-s", type=float, default=0.5, dest="hedge_after_s",
+        help="router hedge threshold in seconds; 0 disables hedged "
+        "re-dispatch (health observation stays on either way)",
+    )
+    ch.add_argument("--gate", action="store_true",
+                    help="exit 2 when an invariant tripped")
+    ch.add_argument("--json", action="store_true",
+                    help="print the chaos report as JSON")
+    ch.add_argument(
+        "--prewarm",
+        action=argparse.BooleanOptionalAction,
+        default=True,
+        help="compile serving programs on every replica before the drill",
+    )
+    _add_tpu_flags(ch)
+    ch.set_defaults(fn=cmd_chaos)
 
     tr = sub.add_parser("train", help="LoRA fine-tune a checkpoint on a JSONL dataset")
     tr.add_argument("--checkpoint", required=True, help="HF checkpoint dir")

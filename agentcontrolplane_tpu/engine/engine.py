@@ -376,6 +376,22 @@ class Engine:
         # coordination (host-local wall-clock inputs would fork lockstep).
         autopilot: bool = False,
         autopilot_interval: int = 128,
+        # dispatch-cycle stall watchdog: a busy cycle (fault throttles
+        # included) whose wall time exceeds BOTH stall_mult x the fastest
+        # cycle seen (the cadence floor) and stall_min_s records a `stall` flight
+        # event + acp_engine_stalls_total — the cheap gray-failure signal
+        # the fleet health state machine (fleet/health.py) consumes.
+        # Observation-only: a stall never changes what is sampled.
+        stall_mult: float = 8.0,
+        stall_min_s: float = 0.25,
+        # degradation ladder (engine/brownout.py): under sustained
+        # pressure (admission sheds + watchdog stalls) step optional
+        # features down in the pinned order spec_len -> park acceptance ->
+        # chunk quota, one bounded rung per interval, restoring fully on
+        # recovery. Off by default; constructor-disabled under
+        # coordination (host-local pressure counters would fork lockstep).
+        brownout: bool = False,
+        brownout_interval: int = 64,
         # parked-slot lifetime: a slot parked at generation end (see
         # _Request.park) that no follow-up turn adopts within this window
         # is released. 0 disables parking entirely. Parking is also
@@ -778,6 +794,29 @@ class Engine:
             if self.autopilot_enabled
             else None
         )
+        # gray-failure instrumentation: the dispatch watchdog + the
+        # degradation ladder (see _stall_check / _brownout_tick)
+        self.stall_mult = float(stall_mult)
+        self.stall_min_s = float(stall_min_s)
+        self.stalls = 0  # dispatch cycles the watchdog judged stalled
+        self.sheds = 0  # admission sheds (bounded queue / fault site)
+        self._cycle_s = 0.0  # acp: mirror — cycle EWMA snapshot for stats()
+        # fastest busy cycle seen: the stall baseline. The EWMA seeds on
+        # the first (compile-heavy) cycles and decays with alpha=0.1, so
+        # judging against it leaves the watchdog deaf for dozens of
+        # cycles after start; the min converges to honest cadence after a
+        # single fast cycle and a slow cycle can never inflate it.
+        self._cycle_floor = 0.0
+        from .brownout import BrownoutController, BrownoutPolicy
+
+        self.brownout_enabled = bool(brownout) and coordination is None
+        self._brownout = (  # acp: mirror (immutable; stats reads plain ints off it)
+            BrownoutController(BrownoutPolicy(interval=max(1, int(brownout_interval))))
+            if self.brownout_enabled
+            else None
+        )
+        self._brownout_level = 0  # acp: mirror — applied ladder rung
+        self._brownout_saved: dict = {}  # knob -> pre-brownout value
         # overlapped tool execution (see _stream / _park). _parked_count is
         # a plain int mirror of "slots in _slots with parked=True" so
         # cross-thread readers (stats()) never iterate the engine-mutated
@@ -1384,6 +1423,7 @@ class Engine:
             ) is not None
             depth = self._queue.qsize() + len(self._waiting)
             if forced_full or (self.max_queue and depth >= self.max_queue):
+                self.sheds += 1
                 REGISTRY.counter_add("acp_engine_shed_requests_total", 1.0)
                 self.flight.record("shed", rid=req.rid, depth=depth)
                 req.future.set_exception(EngineOverloadedError(
@@ -1753,6 +1793,22 @@ class Engine:
             "decode_block_size": self.decode_block_size,
             "decode_steps": self.decode_steps,
             "tokens_generated": self.tokens_generated,
+            # gray-failure signals (fleet/health.py samples these): cycle
+            # cadence EWMA, watchdog stall count, admission sheds
+            "cycle_s": round(self._cycle_s, 6),
+            "stalls": self.stalls,
+            "sheds": self.sheds,
+            # degradation ladder posture (engine/brownout.py)
+            "brownout": {
+                "enabled": self.brownout_enabled,
+                "level": self._brownout_level,
+                "steps_down": (
+                    self._brownout.steps_down if self._brownout is not None else 0
+                ),
+                "steps_up": (
+                    self._brownout.steps_up if self._brownout is not None else 0
+                ),
+            },
             # decode efficiency: tokens committed per model step. Without
             # speculation this is <= 1 (finished lanes pad blocks); with it,
             # each verify dispatch counts ONE step however many tokens land,
@@ -1910,6 +1966,11 @@ class Engine:
                 admitted = self._admit(block=not self._has_work())
                 if self._stopping:
                     break
+                # stall-watchdog window: everything between here and the
+                # post-dispatch check counts as ONE cycle's wall time —
+                # including fault-injected throttles (engine.slow_cycle),
+                # which is exactly the wedge the watchdog exists to see
+                t_cycle = time.monotonic()
                 # after _admit, not before: the loop parks in _admit while
                 # idle, so a crash armed then would otherwise fire only
                 # AFTER the next request completed a full loop iteration —
@@ -1929,13 +1990,23 @@ class Engine:
                     # match filter keeps sibling engines in the same process
                     # alive); after_steps gates it mid-decode
                     raise RuntimeError("fault injection: fleet replica crash")
-                if self._faults.enabled:
+                if self._faults.enabled and (admitted or self._has_work()):
                     # throttle drill: stretch scheduler cycles so wall-clock
                     # races (deadlines, mid-flight cancels) land while
                     # requests are genuinely queued/decoding — a tiny model
                     # on fast hardware otherwise outruns any realistic
                     # timer. Timing-only: sampled tokens are untouched.
-                    slow = self._faults.pop("engine.slow_cycle")
+                    # BUSY cycles only: _admit's idle park wakes on a short
+                    # timeout, and letting those empty iterations pop would
+                    # silently drain the times= budget before work arrives.
+                    # match on the fleet identity (when registered) so a
+                    # spec armed with replica="rN" throttles exactly the
+                    # named replica — the gray-failure drill — while an
+                    # unscoped spec keeps firing on any engine
+                    slow = self._faults.pop(
+                        "engine.slow_cycle",
+                        match={"replica": self.fleet_replica_id},
+                    )
                     if slow is not None:
                         time.sleep(float(slow.get("delay_s", 0.01)))
                 self._sweep_parked()
@@ -1947,6 +2018,7 @@ class Engine:
                         self._publish_memory_state()
                         continue
                 self._dispatch_once()
+                self._stall_check(time.monotonic() - t_cycle)
                 # memory-tier mirrors/gauges refresh BEFORE the armed audit
                 # below, so mirror-vs-truth checks see post-cycle state
                 self._publish_memory_state()
@@ -1955,6 +2027,8 @@ class Engine:
                 self.profiler.publish()
                 if self._autopilot is not None:
                     self._autopilot_tick()
+                if self._brownout is not None:
+                    self._brownout_tick()
                 if self.check_invariants:
                     if self._faults.enabled and self._faults.pop(
                         "engine.invariant_break"
@@ -2571,6 +2645,71 @@ class Engine:
             "attribution, budget utilization and spec acceptance)",
         )
         log.info("autopilot adjusted knobs: %s", changes)
+
+    def _stall_check(self, dt: float) -> None:
+        """Dispatch watchdog: ``dt`` is the full busy-cycle wall time
+        (fault throttles included); a cycle over ``stall_mult`` x the
+        replica's normal cadence *and* over ``stall_min_s`` is a stall.
+        The cadence baseline is the MIN busy-cycle time seen
+        (``_cycle_floor``) — one-sided, so a slow cycle can never mask
+        later stalls the way a compile-polluted EWMA would. Also
+        publishes the EWMA mirror the cross-thread stats surface (and
+        the fleet health sampler behind it) reads."""
+        self._cycle_s = self._cycle_clock.cycle_s
+        if dt > 0 and (self._cycle_floor == 0.0 or dt < self._cycle_floor):
+            self._cycle_floor = dt
+        base = self._cycle_floor
+        if base <= 0.0 or dt < self.stall_min_s or dt < self.stall_mult * base:
+            return
+        self.stalls += 1
+        self.flight.record("stall", cycle_s=round(dt, 4), floor_s=round(base, 5))
+        REGISTRY.counter_add(
+            "acp_engine_stalls_total", 1.0,
+            help="dispatch cycles the engine-side watchdog judged stalled "
+            "(wall time over stall_mult x the cycle-cadence EWMA and over "
+            "stall_min_s) — the gray-failure signal the fleet health "
+            "state machine consumes",
+        )
+
+    def _brownout_tick(self) -> None:
+        """Degradation ladder (engine/brownout.py): on interval
+        boundaries, judge shed/stall pressure and move at most one rung.
+        Stepping DOWN saves and sheds the next optional knob in the
+        pinned order (spec_len -> park acceptance -> chunk quota);
+        stepping UP restores the most recent one. Mirrors the autopilot's
+        apply-seam: the controller decides, the engine applies the knob
+        and flight-records it, and the gauge tracks the level."""
+        bo = self._brownout
+        if bo is None or not bo.due():
+            return
+        from .brownout import LADDER
+
+        target = bo.step(self.sheds, self.stalls)
+        if target == self._brownout_level:
+            return
+        if target > self._brownout_level:
+            knob, downed = LADDER[self._brownout_level]
+            self._brownout_saved[knob] = getattr(self, knob)
+            setattr(self, knob, downed)
+            self._brownout_level += 1
+            self.flight.record(
+                "brownout", level=self._brownout_level, **{f"set_{knob}": downed}
+            )
+        else:
+            knob, _ = LADDER[self._brownout_level - 1]
+            restored = self._brownout_saved.pop(knob, getattr(self, knob))
+            setattr(self, knob, restored)
+            self._brownout_level -= 1
+            self.flight.record(
+                "brownout", level=self._brownout_level, **{f"set_{knob}": restored}
+            )
+        REGISTRY.gauge_set(
+            "acp_engine_brownout_level", float(self._brownout_level),
+            help="current rung of the degradation ladder (0 = full "
+            "service; 1 = speculation off; 2 = + park acceptance off; "
+            "3 = + chunk quota floored) — engine/brownout.py",
+        )
+        log.info("brownout level -> %d", self._brownout_level)
 
     def _has_work(self) -> bool:
         """Anything the dispatch loop must advance: decoding or mid-prefill

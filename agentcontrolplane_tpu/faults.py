@@ -27,8 +27,13 @@ Engine sites (see ``engine/engine.py``):
   drill so wall-clock races — tight deadlines, mid-flight cancels — land
   while requests are genuinely queued or decoding, which a tiny model on
   fast hardware otherwise outruns. Timing-only: sampled tokens are
-  untouched. The ``cancel_churn`` scenario trace arms this site
-  (``scenarios/library.py``, docs/scenarios.md).
+  untouched. Consumed on BUSY cycles only (idle admission-park wakeups
+  never drain the budget), so ``times=N`` means N cycles that were doing
+  work. Arm with ``replica="<fleet_replica_id>"`` to throttle ONE pool
+  member — the gray-replica drill the stall watchdog, health state
+  machine and hedged re-dispatch are tested against (fleet/health.py,
+  docs/fleet.md). The ``cancel_churn`` scenario trace and the chaos
+  conductor arm this site (``scenarios/library.py``, docs/scenarios.md).
 - ``engine.page_pressure`` — hold ``pages`` KV pages out of the allocator
   (released when disarmed/reset), shrinking the pool mid-serve.
 - ``engine.invariant_break`` — corrupt a mirror counter (``_parked_count``)

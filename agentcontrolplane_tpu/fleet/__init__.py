@@ -18,13 +18,28 @@ over a :class:`FleetPool` (pool.py) of lease-registered replicas:
   chunked prefill, its prompt KV rides out as a ``HostKVEntry``
   (``submit(export_kv=True)``), and the decode replica restores it through
   ``inject_host_kv`` + the existing PREFILLING restore path.
+- **gray-failure hardening** — a watchdog thread feeds each replica's
+  public stats into a health state machine (health.py: healthy →
+  degraded → dead with hysteresis); degraded replicas stop winning new
+  placements and shed re-homeable persona keys, and with
+  ``hedge_after_s > 0`` stuck requests are hedge re-dispatched onto a
+  healthy replica (first delivery wins, streams stay exactly-once).
 
 See docs/fleet.md. Fleet code consumes ONLY public engine surfaces —
 acplint's thread-ownership pass flags ``engine._*`` reaches here exactly
 like it does in ``server/``.
 """
 
+from .health import HealthPolicy, HealthSample, ReplicaHealth
 from .pool import FleetPool, FleetReplica
 from .router import FleetRouter, persona_affinity_key
 
-__all__ = ["FleetPool", "FleetReplica", "FleetRouter", "persona_affinity_key"]
+__all__ = [
+    "FleetPool",
+    "FleetReplica",
+    "FleetRouter",
+    "HealthPolicy",
+    "HealthSample",
+    "ReplicaHealth",
+    "persona_affinity_key",
+]

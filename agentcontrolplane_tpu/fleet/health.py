@@ -1,0 +1,172 @@
+"""Per-replica health state machine: healthy → degraded → dead.
+
+PR 16's fleet tier is crash-complete (lease failover, exactly-once
+re-dispatch) but gray-blind: a replica that is slow, wedged mid-dispatch,
+or flapping keeps its lease, keeps winning affinity routing, and holds
+every persona homed on it hostage. This module turns the cheap per-cycle
+signals the engine already publishes — dispatch-cycle cadence (the
+``stall`` watchdog counter), queue-depth trend, goodput from
+``/v1/engine/perf`` — into a three-state judgment the router consumes:
+
+- **healthy**   — full routing citizenship.
+- **degraded**  — keeps serving its in-flight work, but stops receiving
+  NEW affinity homes and its re-homeable persona keys are shed so the
+  next turn of each conversation re-homes on a healthy replica; the
+  router's per-request watchdog may hedge work stuck in its queue.
+- **dead**      — the existing lease path (error taxonomy / deposition)
+  owns this transition; the monitor only mirrors it into the ledger.
+
+Transitions carry **hysteresis** so a flapping replica doesn't oscillate:
+degradation needs ``degrade_after`` consecutive bad samples, recovery
+``recover_after`` consecutive clean ones. A "bad" sample is any of: new
+stalls since the previous sample, queue depth growing monotonically for
+``queue_trend_len`` samples at/above ``queue_min``, or a goodput ratio
+under ``goodput_floor`` while work is queued. The judgment is a pure
+function of the sample stream — no wall clock, no randomness — so the
+state machine unit-tests without an engine and a replayed sample stream
+reproduces the same transition ledger.
+
+The router samples each replica's public ``stats()`` surface from its
+watchdog thread (fleet/router.py); every transition lands in the router's
+flight recorder (``health`` events) and in the per-replica
+``acp_fleet_replica_health`` gauge (2 = healthy, 1 = degraded, 0 = dead).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+HEALTHY = "healthy"
+DEGRADED = "degraded"
+DEAD = "dead"
+
+# gauge encoding for acp_fleet_replica_health
+HEALTH_GAUGE = {HEALTHY: 2.0, DEGRADED: 1.0, DEAD: 0.0}
+
+
+@dataclass(frozen=True)
+class HealthPolicy:
+    """Hysteresis bounds and signal thresholds for one replica monitor.
+
+    The defaults are deliberately conservative: two consecutive bad
+    samples (at the router's watchdog cadence) to degrade, four clean
+    ones to recover — a single compile stall or one queue burst never
+    flips routing, while a genuinely gray replica degrades within a
+    couple of watchdog ticks."""
+
+    degrade_after: int = 2      # consecutive bad samples -> degraded
+    recover_after: int = 4      # consecutive clean samples -> healthy
+    queue_trend_len: int = 3    # strictly-growing depth samples that count
+    queue_min: int = 4          # trend ignored below this depth
+    goodput_floor: float = 0.2  # ratio under this (with work queued) is bad
+
+
+@dataclass(frozen=True)
+class HealthSample:
+    """One observation of a replica's public stats surface."""
+
+    queue_depth: int = 0
+    stalls: int = 0                       # cumulative acp_engine_stalls_total
+    goodput_ratio: Optional[float] = None
+    alive: bool = True
+
+
+class ReplicaHealth:
+    """The per-replica state machine. ``observe`` consumes samples and
+    returns the new state on a transition (None = no change); the caller
+    (the router's watchdog) owns the side effects — flight events, gauge,
+    affinity shedding. ``transitions`` is the append-only ledger the
+    chaos conductor and ``/v1/fleet`` read."""
+
+    def __init__(self, replica_id: str, policy: Optional[HealthPolicy] = None):
+        self.replica_id = replica_id
+        self.policy = policy or HealthPolicy()
+        self.state = HEALTHY
+        self.samples = 0
+        self.bad_streak = 0
+        self.good_streak = 0
+        self._last_stalls: Optional[int] = None
+        self._last_depth: Optional[int] = None
+        self._growth_streak = 0
+        # (sample_index, from_state, to_state, reason) — bounded by the
+        # number of real transitions, which hysteresis keeps tiny
+        self.transitions: list[tuple[int, str, str, str]] = []
+
+    # -- signal extraction -------------------------------------------------
+
+    def _reasons(self, s: HealthSample) -> list[str]:
+        p = self.policy
+        reasons: list[str] = []
+        if self._last_stalls is not None and s.stalls > self._last_stalls:
+            reasons.append(f"stalls+{s.stalls - self._last_stalls}")
+        self._last_stalls = s.stalls
+        if self._last_depth is not None and s.queue_depth > self._last_depth:
+            self._growth_streak += 1
+        elif self._last_depth is not None and s.queue_depth < self._last_depth:
+            self._growth_streak = 0
+        self._last_depth = s.queue_depth
+        if (
+            self._growth_streak >= p.queue_trend_len
+            and s.queue_depth >= p.queue_min
+        ):
+            reasons.append(f"queue_trend:{s.queue_depth}")
+        if (
+            s.goodput_ratio is not None
+            and s.queue_depth > 0
+            and s.goodput_ratio < p.goodput_floor
+        ):
+            reasons.append(f"goodput:{s.goodput_ratio:.2f}")
+        return reasons
+
+    # -- transitions -------------------------------------------------------
+
+    def _transition(self, to_state: str, reason: str) -> str:
+        self.transitions.append((self.samples, self.state, to_state, reason))
+        self.state = to_state
+        self.bad_streak = 0
+        self.good_streak = 0
+        return to_state
+
+    def observe(self, sample: HealthSample) -> Optional[str]:
+        """Feed one sample; returns the new state when this sample caused
+        a transition, else None. A dead replica never recovers through
+        observation — re-registration is an operator act."""
+        self.samples += 1
+        if not sample.alive:
+            if self.state != DEAD:
+                return self._transition(DEAD, "lease")
+            return None
+        if self.state == DEAD:
+            return None
+        reasons = self._reasons(sample)
+        p = self.policy
+        if reasons:
+            self.bad_streak += 1
+            self.good_streak = 0
+            if self.state == HEALTHY and self.bad_streak >= p.degrade_after:
+                return self._transition(DEGRADED, ",".join(reasons))
+        else:
+            self.good_streak += 1
+            self.bad_streak = 0
+            if self.state == DEGRADED and self.good_streak >= p.recover_after:
+                return self._transition(HEALTHY, "recovered")
+        return None
+
+    def mark_dead(self, reason: str = "error") -> Optional[str]:
+        """Mirror an externally-decided death (error taxonomy / lease
+        deposition) into the ledger; idempotent."""
+        if self.state == DEAD:
+            return None
+        return self._transition(DEAD, reason)
+
+
+__all__ = [
+    "DEAD",
+    "DEGRADED",
+    "HEALTHY",
+    "HEALTH_GAUGE",
+    "HealthPolicy",
+    "HealthSample",
+    "ReplicaHealth",
+]

@@ -23,6 +23,19 @@ the per-submission stream-dedupe counters suppress already-delivered
 tokens/tool-calls — the caller observes every token exactly once,
 byte-identical to an uncrashed run.
 
+Gray failures: a watchdog thread samples every replica's public
+``stats()`` surface each ``watchdog_interval_s`` and feeds a per-replica
+health state machine (fleet/health.py: healthy → degraded → dead, with
+hysteresis) from the stall-watchdog counter, queue-depth trend, and
+goodput ratio. Degraded replicas keep serving their in-flight work but
+stop winning NEW placements while a healthy candidate exists, and their
+re-homeable persona keys are shed so each conversation's next turn homes
+healthy. With ``hedge_after_s > 0`` the same thread hedge re-dispatches a
+request stuck pre-first-token on a gray replica onto a healthy one: both
+attempts race, the first to deliver a token claims the stream and the
+loser is cancelled, with the delivered-token-offset dedupe keeping the
+caller's bytes exactly-once and identical either way.
+
 Disaggregation (``handoff_min_tokens > 0`` + a ``role="prefill"``
 replica): long prompts prefill on the designated prefill replica
 (``submit(export_kv=True)``, chunked prefill to a page-aligned cut), the
@@ -34,7 +47,8 @@ pool eviction) degrades to a full local prefill with identical output.
 
 All decisions land in the router's own flight recorder (``route``,
 ``route_stale``, ``shed_skip``, ``failover``, ``replica_dead``,
-``lease_takeover``, ``handoff_start`` / ``handoff_done`` /
+``lease_takeover``, ``health`` / ``affinity_shed``, ``hedge`` /
+``hedge_cancel`` / ``hedge_drop``, ``handoff_start`` / ``handoff_done`` /
 ``handoff_error``) so pool behavior is debuggable from timelines —
 ``/v1/fleet`` and ``acp-tpu fleet`` read :meth:`FleetRouter.stats`.
 """
@@ -53,6 +67,14 @@ from ..engine.engine import EngineOverloadedError, SamplingParams
 from ..faults import FAULTS
 from ..observability.flight import FlightRecorder
 from ..observability.metrics import REGISTRY
+from .health import (
+    DEAD,
+    HEALTH_GAUGE,
+    HEALTHY,
+    HealthPolicy,
+    HealthSample,
+    ReplicaHealth,
+)
 from .pool import FleetPool, FleetReplica
 
 # engine-failure signatures (the public error taxonomy of Engine.submit
@@ -80,16 +102,20 @@ def persona_affinity_key(messages) -> str:
 
 class _Submission:
     """Router-side request state: the caller-facing future plus the
-    dedupe counters that make a failed-over stream exactly-once. One live
-    attempt at a time; attempt callbacks run on that attempt's engine
-    thread, and attempts are strictly sequential (the next starts from
-    the previous future's done-callback), so the counters need no lock."""
+    dedupe counters that make a failed-over stream exactly-once. Failover
+    attempts are strictly sequential (the next starts from the previous
+    future's done-callback), but a HEDGE races two attempts concurrently:
+    ``lock`` guards the winner election and the dedupe counters, and
+    ``live`` maps attempt tag → (replica id, engine future) so the loser
+    can be cancelled the moment a winner claims the stream."""
 
     __slots__ = (
         "rid", "prompt", "sampling", "user_on_tokens", "user_on_tool_call",
         "park", "trace", "deadline", "affinity_key", "future", "admitted",
-        "attempts", "failovers", "tokens_delivered", "tool_calls_delivered",
-        "replica_id", "engine_future", "tried", "cancelled",
+        "attempts", "failovers", "hedges", "tokens_delivered",
+        "tool_calls_delivered", "replica_id", "engine_future", "tried",
+        "cancelled", "lock", "winner", "live", "attempt_t0",
+        "retry_after_max",
     )
 
     def __init__(
@@ -112,52 +138,83 @@ class _Submission:
         self.future.early_tool_calls = []  # type: ignore[attr-defined]
         self.attempts = 0
         self.failovers = 0
+        self.hedges = 0
         self.tokens_delivered = 0
         self.tool_calls_delivered = 0
         self.replica_id: Optional[str] = None
         self.engine_future: Optional[Future] = None
         self.tried: set[str] = set()
         self.cancelled = False
+        self.lock = threading.Lock()
+        # winner: the attempt tag that owns the caller-facing stream —
+        # elected by the first token (or first completion) once attempts
+        # can race; every other attempt's output is dropped
+        self.winner: Optional[int] = None
+        self.live: dict[int, tuple[str, Optional[Future]]] = {}
+        self.attempt_t0 = time.monotonic()
+        self.retry_after_max = 0.0  # pool-max Retry-After across sheds
 
     def remaining_timeout(self) -> Optional[float]:
         if self.deadline is None:
             return None
         return max(0.1, self.deadline - time.monotonic())
 
-    def attempt_on_tokens(self):
-        """Per-attempt stream callback: suppress the first
-        ``tokens_delivered`` tokens (a failover retry regenerates the
-        whole output; greedy determinism makes the replayed prefix
-        identical), deliver only what the caller hasn't seen."""
+    def attempt_on_tokens(self, tag: int, claim):
+        """Per-attempt stream callback: elect this attempt the winner on
+        its first delivery (``claim`` cancels concurrent losers), then
+        suppress the first ``tokens_delivered`` tokens (a retry
+        regenerates the whole output; greedy determinism makes the
+        replayed prefix identical) and deliver only what the caller
+        hasn't seen."""
         if self.user_on_tokens is None:
             return None
         sub = self
         state = {"seen": 0}
 
         def on_tokens(toks):
-            s = state["seen"]
-            state["seen"] = s + len(toks)
-            skip = max(0, sub.tokens_delivered - s)
-            fresh = toks[skip:]
+            won, fresh = False, ()
+            with sub.lock:
+                if sub.winner is None:
+                    sub.winner, won = tag, True
+                if sub.winner != tag:
+                    return  # a concurrent attempt already owns the stream
+                s = state["seen"]
+                state["seen"] = s + len(toks)
+                skip = max(0, sub.tokens_delivered - s)
+                fresh = toks[skip:]
+                if fresh:
+                    sub.tokens_delivered = s + len(toks)
+            # side effects OUTSIDE the lock: claim cancels the loser on
+            # its replica, and the user callback may block
+            if won:
+                claim(tag)
             if fresh:
-                sub.tokens_delivered = s + len(toks)
                 sub.user_on_tokens(fresh)
 
         return on_tokens
 
-    def attempt_on_tool_call(self):
+    def attempt_on_tool_call(self, tag: int, claim):
         """Tool-call indices are dense and deterministic under greedy
         decoding, so a replayed call is exactly 'index already
-        delivered'."""
+        delivered'; the winner election matches the token path."""
         if self.user_on_tool_call is None:
             return None
         sub = self
 
         def on_tool_call(index, call):
-            if index < sub.tool_calls_delivered:
-                return
-            sub.tool_calls_delivered = index + 1
-            sub.user_on_tool_call(index, call)
+            won = deliver = False
+            with sub.lock:
+                if sub.winner is None:
+                    sub.winner, won = tag, True
+                if sub.winner != tag:
+                    return
+                if index >= sub.tool_calls_delivered:
+                    sub.tool_calls_delivered = index + 1
+                    deliver = True
+            if won:
+                claim(tag)
+            if deliver:
+                sub.user_on_tool_call(index, call)
 
         return on_tool_call
 
@@ -183,6 +240,13 @@ class FleetRouter:
         handoff_min_tokens: int = 0,
         failover_max: int = 2,
         flight: Optional[FlightRecorder] = None,
+        health_policy: Optional[HealthPolicy] = None,
+        # sampling-cadence contract: the interval must be >= the engines'
+        # stall_min_s (default 0.25) — sampling FASTER than stalls can be
+        # produced interleaves clean samples between the deltas, and the
+        # health machine's consecutive-bad hysteresis then never trips
+        watchdog_interval_s: float = 0.25,
+        hedge_after_s: float = 0.0,
     ) -> None:
         if policy not in ("affinity", "round_robin"):
             raise ValueError(f"policy must be affinity|round_robin, got {policy!r}")
@@ -209,14 +273,31 @@ class FleetRouter:
         self.handoffs = 0
         self.handoff_errors = 0
         self.handoff_bytes = 0
+        # gray-failure hardening: per-replica health monitors sampled by
+        # the watchdog thread; hedging stays OFF unless hedge_after_s > 0
+        # (health observation alone never changes dispatch outputs)
+        self.health_policy = health_policy
+        self.watchdog_interval_s = max(0.005, float(watchdog_interval_s))
+        self.hedge_after_s = float(hedge_after_s)
+        self.hedges = 0
+        self.hedge_cancels = 0
+        self._health: dict[str, ReplicaHealth] = {}
+        self._watchdog: Optional[threading.Thread] = None
+        self._watchdog_stop = threading.Event()
 
     # -- pool management --------------------------------------------------
 
     def add_replica(self, replica_id: str, engine, role: str = "both") -> FleetReplica:
         replica = self.pool.register(replica_id, engine, role)
+        with self._lock:
+            self._health[replica_id] = ReplicaHealth(
+                replica_id, policy=self.health_policy
+            )
+        self._set_health_gauge(replica_id, HEALTHY)
         self.flight.record(
             "replica_join", replica=replica_id, role=role, epoch=replica.epoch
         )
+        self._ensure_watchdog()
         return replica
 
     @property
@@ -242,6 +323,10 @@ class FleetRouter:
         return ok
 
     def stop(self, stop_engines: bool = False) -> None:
+        self._watchdog_stop.set()
+        watchdog = self._watchdog
+        if watchdog is not None:
+            watchdog.join(timeout=2.0)
         self.pool.stop(stop_engines=stop_engines)
 
     # -- submit surface ---------------------------------------------------
@@ -300,12 +385,17 @@ class FleetRouter:
         if sub is None:
             return
         sub.cancelled = True
-        engine_future, replica = sub.engine_future, self.pool.get(sub.replica_id)
-        if engine_future is not None and replica is not None:
-            try:
-                replica.engine.cancel(engine_future)
-            except Exception:
-                pass
+        with sub.lock:
+            live = list(sub.live.values())
+        if not live:
+            live = [(sub.replica_id, sub.engine_future)]
+        for replica_id, engine_future in live:
+            replica = self.pool.get(replica_id)
+            if engine_future is not None and replica is not None:
+                try:
+                    replica.engine.cancel(engine_future)
+                except Exception:
+                    pass
 
     # -- routing ----------------------------------------------------------
 
@@ -320,6 +410,11 @@ class FleetRouter:
         ]
         if not candidates:
             return None
+        # degraded replicas keep their in-flight work but stop winning NEW
+        # placements (including affinity re-homes) while any healthy
+        # candidate exists; with zero healthy survivors they still serve
+        healthy = [r for r in candidates if self._health_state(r.id) == HEALTHY]
+        candidates = healthy or candidates
         key = sub.affinity_key
         chosen: Optional[FleetReplica] = None
         hit = False
@@ -396,20 +491,32 @@ class FleetRouter:
     def _dispatch(self, sub: _Submission, allow_handoff: bool, last_exc=None) -> None:
         if sub.future.done():
             return
+        with sub.lock:
+            if sub.live:
+                return  # a concurrent hedge attempt still carries it
         replica = self._route(sub)
         if replica is None:
             alive = self.pool.alive()
-            if not alive:
-                err = last_exc if last_exc is not None else RuntimeError(
-                    "no live replicas in the fleet pool"
-                )
+            if not alive and last_exc is not None and not isinstance(
+                last_exc, EngineOverloadedError
+            ):
+                # failover exhausted INTO an empty pool: the crash error
+                # is the truth the caller should see
+                err = last_exc
             else:
-                # every live replica shed: propagate the overload with the
-                # last Retry-After so callers back off pool-wide
-                retry = getattr(last_exc, "retry_after_s", 5.0) or 5.0
+                # nothing routable — every replica dead or shedding. Shed
+                # pool-wide with the LARGEST Retry-After any replica
+                # quoted, so callers back off past the whole pool's
+                # horizon (never raise from an empty candidate list)
+                with sub.lock:
+                    retry = sub.retry_after_max
+                retry = retry or getattr(last_exc, "retry_after_s", 0.0) or 5.0
+                msg = (
+                    f"all {len(alive)} live fleet replicas shed this request"
+                    if alive else "no live replicas in the fleet pool"
+                )
                 err = EngineOverloadedError(
-                    f"all {len(alive)} fleet replicas shed this request; "
-                    "retry later", retry_after_s=retry,
+                    msg + "; retry later", retry_after_s=retry
                 )
             if not sub.future.done():
                 try:
@@ -423,28 +530,52 @@ class FleetRouter:
         else:
             self._submit_to(sub, replica)
 
-    def _submit_to(self, sub: _Submission, replica: FleetReplica) -> None:
-        sub.attempts += 1
-        sub.replica_id = replica.id
+    def _submit_to(
+        self, sub: _Submission, replica: FleetReplica, hedge: bool = False
+    ) -> None:
+        with sub.lock:
+            sub.attempts += 1
+            tag = sub.attempts
+            sub.replica_id = replica.id
+            sub.live[tag] = (replica.id, None)
+            if not hedge:
+                sub.attempt_t0 = time.monotonic()
+        claim = lambda t: self._claim(sub, t)  # noqa: E731
         engine_future = replica.engine.submit(
             list(sub.prompt), sub.sampling,
-            on_tokens=sub.attempt_on_tokens(),
+            on_tokens=sub.attempt_on_tokens(tag, claim),
             timeout_s=sub.remaining_timeout(),
-            on_tool_call=sub.attempt_on_tool_call(),
+            on_tool_call=sub.attempt_on_tool_call(tag, claim),
             park=sub.park, trace=sub.trace,
         )
-        sub.engine_future = engine_future
+        with sub.lock:
+            # a racing attempt may have claimed the stream while this
+            # submit was in flight; register late so _claim can still
+            # cancel us, then sweep immediately below
+            lost = sub.winner is not None and sub.winner != tag
+            if tag in sub.live:
+                sub.live[tag] = (replica.id, engine_future)
+            if not lost:
+                sub.engine_future = engine_future
+        if lost:
+            try:
+                replica.engine.cancel(engine_future)
+            except Exception:
+                pass
         # linkage for /v1/fleet/trace: the replica-local rid lets the
         # stitcher fetch this leg's timeline from the replica's recorder
         self.flight.record(
             "attempt", rid=sub.rid, replica=replica.id,
-            engine_rid=getattr(engine_future, "rid", None), n=sub.attempts,
+            engine_rid=getattr(engine_future, "rid", None), n=tag,
+            hedge=hedge,
         )
-        # the live attempt's early-call list is the caller's view; a
-        # failover retry regenerates the full list (greedy determinism)
-        sub.future.early_tool_calls = getattr(  # type: ignore[attr-defined]
-            engine_future, "early_tool_calls", []
-        )
+        if not hedge:
+            # the live attempt's early-call list is the caller's view; a
+            # failover retry regenerates the full list (greedy
+            # determinism); a hedge re-points it only on claim
+            sub.future.early_tool_calls = getattr(  # type: ignore[attr-defined]
+                engine_future, "early_tool_calls", []
+            )
         admitted = getattr(engine_future, "admitted", None)
         if admitted is not None:
             def _chain_admitted(f):
@@ -457,18 +588,77 @@ class FleetRouter:
 
             admitted.add_done_callback(_chain_admitted)
         engine_future.add_done_callback(
-            lambda f: self._on_attempt_done(sub, replica, f)
+            lambda f: self._on_attempt_done(sub, replica, tag, f)
         )
 
-    def _on_attempt_done(self, sub: _Submission, replica: FleetReplica, f: Future) -> None:
+    def _claim(self, sub: _Submission, tag: int) -> None:
+        """First-delivery-wins bookkeeping once ``tag`` is elected: point
+        the caller-facing early-calls view at the winner's list and
+        cancel every other live attempt on its replica."""
+        with sub.lock:
+            winner = sub.live.get(tag)
+            losers = [
+                (t, rid, f) for t, (rid, f) in sub.live.items() if t != tag
+            ]
+        if winner is not None and winner[1] is not None:
+            sub.future.early_tool_calls = getattr(  # type: ignore[attr-defined]
+                winner[1], "early_tool_calls", []
+            )
+        for t, replica_id, engine_future in losers:
+            replica = self.pool.get(replica_id)
+            if replica is not None and engine_future is not None:
+                try:
+                    replica.engine.cancel(engine_future)
+                except Exception:
+                    pass
+            with self._lock:
+                self.hedge_cancels += 1
+            self.flight.record(
+                "hedge_cancel", rid=sub.rid, replica=replica_id, attempt=t
+            )
+
+    def _on_attempt_done(
+        self, sub: _Submission, replica: FleetReplica, tag: int, f: Future
+    ) -> None:
+        with sub.lock:
+            sub.live.pop(tag, None)
+            n_live = len(sub.live)
+            is_loser = sub.winner is not None and sub.winner != tag
+            if sub.winner == tag and (
+                f.cancelled() or f.exception() is not None
+            ):
+                # the winning attempt died before finishing: pass the
+                # baton so a live hedge or a failover retry can claim the
+                # stream (the dedupe counters keep it exactly-once)
+                sub.winner = None
         if sub.future.done():
             return
+        if is_loser:
+            # a concurrent attempt owns the stream; this one's result (or
+            # cancellation) is dropped — greedy identity means the winner
+            # delivers the same bytes the caller would have seen here
+            self.flight.record(
+                "hedge_drop", rid=sub.rid, replica=replica.id, attempt=tag
+            )
+            return
         if f.cancelled():
-            sub.future.cancel()
+            if n_live:
+                return  # a concurrent attempt still carries the request
+            if sub.cancelled:
+                sub.future.cancel()
+                return
+            # cancelled under us without a caller cancel (a hedge loser
+            # whose winner died after cancelling it): re-dispatch — the
+            # dedupe counters keep the resumed stream exactly-once
+            self._dispatch(sub, allow_handoff=False)
             return
         exc = f.exception()
         if exc is None:
             result = f.result()
+            with sub.lock:
+                if sub.winner is None:
+                    sub.winner = tag  # nothing streamed: completion claims
+            self._claim(sub, tag)  # sweep any still-live concurrent loser
             self.flight.record(
                 "finish", rid=sub.rid, replica=replica.id,
                 reason=result.finish_reason, tokens=len(result.tokens),
@@ -489,41 +679,65 @@ class FleetRouter:
             # this replica shed — skip it and try the rest of the pool
             with self._lock:
                 self.sheds_skipped += 1
+            retry = getattr(exc, "retry_after_s", 0.0) or 0.0
+            with sub.lock:
+                sub.retry_after_max = max(sub.retry_after_max, float(retry))
             self.flight.record(
                 "shed_skip", rid=sub.rid, replica=replica.id,
                 retry_after_s=getattr(exc, "retry_after_s", None),
             )
             sub.tried.add(replica.id)
+            if n_live:
+                return  # the concurrent attempt still carries the request
             self._dispatch(sub, allow_handoff=False, last_exc=exc)
             return
         if isinstance(exc, RuntimeError) and any(
             m in str(exc) for m in _REPLICA_DEAD_MARKERS
         ):
+            self._note_replica_dead(replica, exc)
+            sub.tried.add(replica.id)
+            if n_live:
+                # the hedge IS the failover: a concurrent attempt is
+                # already racing on a survivor — no resubmission needed
+                self.flight.record(
+                    "attempt_lost", rid=sub.rid, replica=replica.id,
+                    attempt=tag,
+                )
+                return
             self._failover(sub, replica, exc)
             return
         # DeadlineExceeded and everything else: the request's own failure
+        if n_live:
+            return
         try:
             sub.future.set_exception(exc)
         except InvalidStateError:
             pass
 
-    def _failover(self, sub: _Submission, replica: FleetReplica, exc) -> None:
+    def _note_replica_dead(self, replica: FleetReplica, exc) -> None:
+        """Pool-side death bookkeeping, split from resubmission so a
+        hedged request can record the death without double-dispatching."""
         dead = self.pool.mark_dead(replica.id)
-        if dead is not None:
-            # FIRST observer of this death owns the one-time side effects
-            self.flight.record("replica_dead", replica=replica.id, error=str(exc))
-            with self._lock:
-                for k in [k for k, v in self._affinity.items() if v == replica.id]:
-                    del self._affinity[k]
-            survivor = next((r for r in self.pool.replicas() if r.alive), None)
-            if survivor is not None:
-                epoch = self.pool.adopt_lease(dead, survivor)
-                if epoch is not None:
-                    self.flight.record(
-                        "lease_takeover", replica=survivor.id,
-                        lease=dead.lease_name, epoch=epoch,
-                    )
-        sub.tried.add(replica.id)
+        if dead is None:
+            return
+        # FIRST observer of this death owns the one-time side effects
+        self.flight.record("replica_dead", replica=replica.id, error=str(exc))
+        with self._lock:
+            monitor = self._health.get(replica.id)
+            for k in [k for k, v in self._affinity.items() if v == replica.id]:
+                del self._affinity[k]
+        if monitor is not None and monitor.mark_dead("error") is not None:
+            self._apply_health(replica.id, DEAD, "error")
+        survivor = next((r for r in self.pool.replicas() if r.alive), None)
+        if survivor is not None:
+            epoch = self.pool.adopt_lease(dead, survivor)
+            if epoch is not None:
+                self.flight.record(
+                    "lease_takeover", replica=survivor.id,
+                    lease=dead.lease_name, epoch=epoch,
+                )
+
+    def _failover(self, sub: _Submission, replica: FleetReplica, exc) -> None:
         if sub.cancelled or sub.future.done():
             return
         if sub.failovers >= self.failover_max:
@@ -545,6 +759,151 @@ class FleetRouter:
             delivered_tokens=sub.tokens_delivered,
         )
         self._dispatch(sub, allow_handoff=False, last_exc=exc)
+
+    # -- gray-failure watchdog --------------------------------------------
+
+    def _ensure_watchdog(self) -> None:
+        with self._lock:
+            if self._watchdog is not None or self._watchdog_stop.is_set():
+                return
+            self._watchdog = threading.Thread(
+                target=self._watchdog_loop, name="fleet-watchdog", daemon=True
+            )
+        self._watchdog.start()
+
+    def _watchdog_loop(self) -> None:
+        """One thread for the whole pool: sample every replica's public
+        ``stats()`` into its health monitor, then scan in-flight requests
+        for hedge candidates. Both ticks are best-effort — a replica
+        whose stats raise just contributes an empty sample."""
+        while not self._watchdog_stop.wait(self.watchdog_interval_s):
+            try:
+                self._health_tick()
+                if self.hedge_after_s > 0:
+                    self._hedge_tick()
+            except Exception as e:  # pragma: no cover - defensive
+                self.flight.record("watchdog_error", error=str(e))
+
+    def _health_tick(self) -> None:
+        for replica in self.pool.replicas():
+            with self._lock:
+                monitor = self._health.get(replica.id)
+            if monitor is None:
+                continue
+            if not replica.alive:
+                sample = HealthSample(alive=False)
+            else:
+                try:
+                    st = replica.engine.stats()
+                except Exception:
+                    st = {}
+                perf = st.get("perf") or {}
+                ratio = (perf.get("goodput") or {}).get("ratio")
+                sample = HealthSample(
+                    queue_depth=int(st.get("waiting", 0)),
+                    stalls=int(st.get("stalls", 0)),
+                    goodput_ratio=float(ratio) if ratio is not None else None,
+                )
+            new_state = monitor.observe(sample)
+            if new_state is not None:
+                self._apply_health(
+                    replica.id, new_state, monitor.transitions[-1][3]
+                )
+
+    def _set_health_gauge(self, replica_id: str, state: str) -> None:
+        REGISTRY.gauge_set(
+            "acp_fleet_replica_health", HEALTH_GAUGE.get(state, 0.0),
+            labels={"replica": replica_id},
+            help="per-replica position in the fleet health state machine "
+            "(2 = healthy, 1 = degraded, 0 = dead) — fleet/health.py",
+        )
+
+    def _apply_health(self, replica_id: str, state: str, reason: str) -> None:
+        """Side effects of one health transition: flight event, the
+        per-replica gauge, and (on leaving healthy) shedding the
+        replica's re-homeable persona keys so each conversation's next
+        turn homes on a healthy replica."""
+        self.flight.record(
+            "health", replica=replica_id, state=state, reason=reason
+        )
+        self._set_health_gauge(replica_id, state)
+        if state == HEALTHY:
+            return
+        replica = self.pool.get(replica_id)
+        with self._lock:
+            shed = [k for k, v in self._affinity.items() if v == replica_id]
+            for k in shed:
+                del self._affinity[k]
+        if replica is not None:
+            replica.affinity_keys.clear()
+        if shed:
+            self.flight.record(
+                "affinity_shed", replica=replica_id, keys=len(shed)
+            )
+
+    def _health_state(self, replica_id: Optional[str]) -> str:  # acp: cross-thread
+        with self._lock:
+            monitor = self._health.get(replica_id)
+        return monitor.state if monitor is not None else HEALTHY
+
+    def _hedge_tick(self) -> None:
+        now = time.monotonic()
+        with self._lock:
+            subs = list(self._inflight.values())
+        for sub in subs:
+            self._maybe_hedge(sub, now)
+
+    def _maybe_hedge(self, sub: _Submission, now: float) -> None:
+        """Hedge re-dispatch: a request stuck PRE-first-token on a gray
+        replica past ``hedge_after_s`` races a second attempt on a
+        healthy survivor. At most one hedge per request; requests already
+        streaming are left alone (their replica is making progress, and
+        failover covers death)."""
+        with sub.lock:
+            stuck = (
+                not sub.cancelled and sub.winner is None and sub.hedges == 0
+                and sub.tokens_delivered == 0 and len(sub.live) == 1
+                and now - sub.attempt_t0 >= self.hedge_after_s
+            )
+            replica_id = sub.replica_id
+        if not stuck or sub.future.done():
+            return
+        replica = self.pool.get(replica_id)
+        if self._health_state(replica_id) == HEALTHY and (
+            replica is not None and replica.alive
+        ):
+            return
+        target = self._hedge_target(sub, replica_id)
+        if target is None:
+            return
+        with sub.lock:
+            sub.hedges += 1
+        with self._lock:
+            self.hedges += 1
+        REGISTRY.counter_add(
+            "acp_fleet_hedges_total", 1.0,
+            help="hedge re-dispatches: requests stuck pre-first-token on a "
+            "degraded replica raced onto a healthy one (first delivery "
+            "wins, the loser is cancelled; streams stay exactly-once)",
+        )
+        self.flight.record(
+            "hedge", rid=sub.rid, from_replica=replica_id,
+            to_replica=target.id, waited_s=round(now - sub.attempt_t0, 3),
+        )
+        self._submit_to(sub, target, hedge=True)
+
+    def _hedge_target(
+        self, sub: _Submission, exclude: Optional[str]
+    ) -> Optional[FleetReplica]:
+        candidates = [
+            r for r in self.pool.replicas()
+            if r.alive and r.serves_decode() and r.id != exclude
+            and r.id not in sub.tried
+            and self._health_state(r.id) == HEALTHY
+        ]
+        if not candidates:
+            return None
+        return min(candidates, key=self._load_score)
 
     # -- prefill/decode disaggregation ------------------------------------
 
@@ -653,6 +1012,8 @@ class FleetRouter:
                 "id": r.id,
                 "role": r.role,
                 "alive": r.alive,
+                "health": self._health_state(r.id),
+                "stalls": st.get("stalls", 0),
                 "lease": {
                     "name": r.lease_name,
                     "holder": self.pool.lease_holder(r),
@@ -689,9 +1050,19 @@ class FleetRouter:
                 "errors": self.handoff_errors,
                 "bytes": self.handoff_bytes,
             }
+            health = {
+                "hedge_after_s": self.hedge_after_s,
+                "hedges": self.hedges,
+                "hedge_cancels": self.hedge_cancels,
+                "watchdog_interval_s": self.watchdog_interval_s,
+                "transitions": sum(
+                    len(m.transitions) for m in self._health.values()
+                ),
+            }
         return {
             "replicas": replicas,
             "routing": routing,
             "failover": failover,
             "handoff": handoff,
+            "health": health,
         }

@@ -5,8 +5,14 @@ library (see docs/scenarios.md).
 anonymized trace documents; this package plays them back — deterministic
 virtual-time schedule, seeded synthetic content, 1x/10x/100x — against a
 single Engine or the fleet router, and ``analysis/slo_gate.py`` judges the
-resulting SLO percentiles against per-scenario envelopes."""
+resulting SLO percentiles against per-scenario envelopes.
 
+``chaos.py`` is the robustness twin: a seeded, deterministic schedule of
+overlapping fault-switchboard arms poured over a library scenario against
+a live target, with exactly-once and conservation invariants judged at
+the end (``acp-tpu chaos``)."""
+
+from .chaos import ChaosConductor, ChaosReport, chaos_schedule, run_chaos
 from .library import SCENARIOS, build
 from .replay import (
     ReplayReport,
@@ -26,4 +32,8 @@ __all__ = [
     "replay",
     "byte_identical",
     "synth_prompt",
+    "ChaosConductor",
+    "ChaosReport",
+    "chaos_schedule",
+    "run_chaos",
 ]

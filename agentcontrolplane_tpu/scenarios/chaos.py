@@ -1,0 +1,289 @@
+"""Seeded chaos conductor: deterministic fault cocktails against a live
+target, with the invariants armed and the conservation checks on.
+
+The replay harness proves the engine does the right thing on a CLEAN run
+of a recorded shape; this module is its robustness twin. A chaos run is
+
+1. a **schedule** — :func:`chaos_schedule` draws a staggered cocktail of
+   fault-switchboard arms (``engine.slow_cycle``, ``fleet.replica_crash``,
+   ``fleet.handoff_error``, ``engine.host_swap_error``, ``tool.slow``)
+   from ``random.Random(seed)``. The schedule is a pure function of
+   ``(seed, replica_ids, span_s)``: same seed ⇒ same sites, same specs,
+   same virtual offsets — reproducibility lives HERE, not in wall-clock
+   health transitions.
+2. a **conductor** — :class:`ChaosConductor` arms each event on the
+   global ``FAULTS`` switchboard when its virtual offset comes due while
+   a :class:`~.replay.TraceReplayer` plays a library scenario against the
+   live target. Every arm lands in the conductor's ledger, the
+   deterministic transcript the seed-reproducibility test compares.
+3. a **verdict** — :func:`run_chaos` asserts what must survive ANY
+   cocktail of graceful faults: request conservation (every submitted
+   request reaches exactly one outcome), exactly-once streams (what
+   ``on_tokens`` delivered equals the final result, however many
+   failovers/hedges a request survived), zero unexplained errors, and the
+   SLO gate's conservation-class checks. Latency envelopes are explicitly
+   NOT judged — chaos exists to stretch them.
+
+Every scheduled site is *graceful by contract* (faults.py documents each
+as byte-identical or cleanly-degrading), so a chaos failure is a real
+robustness bug, never an expected casualty. ``acp-tpu chaos --seed N``
+wraps this for CI: one seed in the fast tier, a multi-seed soak marked
+slow.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..faults import FAULTS
+from .library import build
+from .replay import ReplayReport, TraceReplayer
+
+# slo_gate checks that are CONSERVATION claims (must hold under chaos),
+# as opposed to latency-envelope claims (chaos deliberately stretches)
+_CONSERVATION_CHECKS = frozenset(
+    {"requests", "conservation", "errors", "ttft", "percentiles", "goodput"}
+)
+
+
+def chaos_schedule(
+    seed: int,
+    *,
+    replica_ids: tuple[str, ...] = (),
+    span_s: float = 1.0,
+    tools: bool = False,
+) -> list[dict[str, Any]]:
+    """The deterministic fault schedule for one seed: a list of
+    ``{"offset_s", "site", "spec"}`` events sorted by virtual offset.
+
+    Replica-scoped sites need ``replica_ids``: the crash victim and the
+    slow-cycle victim are drawn from the pool (never the same replica, so
+    the run keeps a healthy majority). Against a single engine (no ids)
+    the schedule stays engine-local — no crash, unscoped throttle.
+    ``tools`` adds ``tool.slow`` arms for traces that carry tool calls."""
+    rng = random.Random(int(seed))
+    span = max(0.05, float(span_s))
+    events: list[dict[str, Any]] = []
+
+    def at(frac_lo: float, frac_hi: float) -> float:
+        return round(rng.uniform(frac_lo, frac_hi) * span, 6)
+
+    # the gray replica: a sustained throttle early in the run, long
+    # enough to trip the stall watchdog and the health machine
+    slow: dict[str, Any] = {
+        "times": rng.randint(6, 12),
+        "delay_s": round(rng.uniform(0.04, 0.10), 3),
+    }
+    ids = list(replica_ids)
+    slow_victim: Optional[str] = None
+    if ids:
+        slow_victim = rng.choice(ids)
+        slow["replica"] = slow_victim
+    events.append({"offset_s": at(0.0, 0.15), "site": "engine.slow_cycle",
+                   "spec": slow})
+    # a hard crash mid-run, never on the throttled replica and only when
+    # survivors remain to adopt the lease and absorb the failover
+    if len(ids) >= 2:
+        victims = [r for r in ids if r != slow_victim]
+        events.append({
+            "offset_s": at(0.25, 0.55),
+            "site": "fleet.replica_crash",
+            "spec": {"times": 1, "replica": rng.choice(victims)},
+        })
+    # wire/host-tier failures: both degrade to recompute, byte-identically
+    if ids:
+        events.append({
+            "offset_s": at(0.1, 0.7),
+            "site": "fleet.handoff_error",
+            "spec": {"times": rng.randint(1, 2)},
+        })
+    events.append({
+        "offset_s": at(0.2, 0.8),
+        "site": "engine.host_swap_error",
+        "spec": {"times": rng.randint(1, 2)},
+    })
+    if tools:
+        events.append({
+            "offset_s": at(0.0, 0.6),
+            "site": "tool.slow",
+            "spec": {"times": rng.randint(1, 3),
+                     "delay_s": round(rng.uniform(0.01, 0.03), 3)},
+        })
+    events.sort(key=lambda e: (e["offset_s"], e["site"]))
+    return events
+
+
+class ChaosConductor:
+    """Arms a :func:`chaos_schedule` against the global switchboard in
+    virtual time (``offset_s / speed`` after :meth:`start`). The ledger
+    records every arm actually performed, in order — the reproducibility
+    surface ``run_chaos`` reports."""
+
+    def __init__(self, schedule: list[dict[str, Any]], *, speed: float = 1.0):
+        self.schedule = list(schedule)
+        self.speed = max(1e-6, float(speed))
+        self.ledger: list[tuple[float, str, dict[str, Any]]] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        t0 = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._run, args=(t0,), name="chaos-conductor", daemon=True
+        )
+        self._thread.start()
+
+    def _run(self, t0: float) -> None:
+        for event in self.schedule:
+            due = t0 + float(event["offset_s"]) / self.speed
+            delay = due - time.monotonic()
+            if delay > 0 and self._stop.wait(delay):
+                return
+            if self._stop.is_set():
+                return
+            spec = dict(event["spec"])
+            FAULTS.arm(
+                event["site"],
+                times=int(spec.pop("times", 1)),
+                after_steps=int(spec.pop("after_steps", 0)),
+                **spec,
+            )
+            self.ledger.append(
+                (float(event["offset_s"]), str(event["site"]),
+                 dict(event["spec"]))
+            )
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=2.0)
+
+
+@dataclass
+class ChaosReport:
+    """One chaos run: the schedule that drove it, the ledger of arms that
+    actually landed, the replay outcome, and the violated invariants
+    (empty = the run survived the cocktail)."""
+
+    seed: int
+    scenario: str
+    schedule: list[dict[str, Any]]
+    ledger: list[tuple[float, str, dict[str, Any]]]
+    replay: ReplayReport
+    violations: list[str] = field(default_factory=list)
+
+    def ok(self) -> bool:
+        return not self.violations
+
+    def doc(self) -> dict[str, Any]:
+        """JSON-shaped summary (the CLI's --json payload)."""
+        return {
+            "seed": self.seed,
+            "scenario": self.scenario,
+            "schedule": self.schedule,
+            "armed": [
+                {"offset_s": o, "site": s, "spec": spec}
+                for o, s, spec in self.ledger
+            ],
+            "slo": self.replay.slo_doc(),
+            "violations": list(self.violations),
+            "ok": self.ok(),
+        }
+
+
+def _verify(report: ReplayReport, conductor: ChaosConductor) -> list[str]:
+    """The invariants a graceful-fault cocktail must not break."""
+    from ..analysis.slo_gate import check_block
+
+    violations: list[str] = []
+    if len(conductor.ledger) != len(conductor.schedule):
+        violations.append(
+            f"conductor armed {len(conductor.ledger)} of "
+            f"{len(conductor.schedule)} scheduled faults — the run ended "
+            "before the cocktail finished pouring"
+        )
+    if report.count("completed") == 0:
+        violations.append("no request completed under chaos")
+    stream_bad = report.stream_violations()
+    if stream_bad:
+        violations.append(
+            f"exactly-once broken: streamed tokens != result for request "
+            f"indices {stream_bad[:5]} — a failover or hedge double- or "
+            "under-delivered"
+        )
+    errors = [r for r in report.rows if r.outcome == "error"]
+    if errors:
+        violations.append(
+            "unexplained errors under graceful faults: "
+            + "; ".join(f"#{r.index}: {r.error}" for r in errors[:3])
+        )
+    for v in check_block(report.scenario, "chaos", report.slo_doc()):
+        if v.check in _CONSERVATION_CHECKS:
+            violations.append(f"slo-gate {v.check}: {v.detail}")
+    return violations
+
+
+def run_chaos(
+    target,
+    *,
+    seed: int = 0,
+    scenario: str = "persona_storm",
+    speed: float = 10.0,
+    request_timeout_s: float = 120.0,
+    scenario_kwargs: Optional[dict[str, Any]] = None,
+) -> ChaosReport:
+    """One seeded chaos run against a live ``target`` (Engine or
+    FleetRouter): build the scenario trace, derive the seed's fault
+    schedule, pour it over the replay, and judge the invariants.
+    Resets the switchboard afterwards (leftover arms must never leak
+    into the caller's next run)."""
+    kwargs = dict(scenario_kwargs or {})
+    kwargs.setdefault("seed", seed)
+    trace = build(scenario, **kwargs)
+    replica_ids = tuple(
+        str(r.get("id"))
+        for r in (target.stats().get("replicas") or ())
+        if isinstance(r, dict) and r.get("alive")
+    )
+    tools = any(row.get("tool_calls") for row in trace.get("requests") or ())
+    schedule = chaos_schedule(
+        seed,
+        replica_ids=replica_ids,
+        span_s=float(trace.get("span_s") or 0.0) or 1.0,
+        tools=tools,
+    )
+    conductor = ChaosConductor(schedule, speed=speed)
+    replayer = TraceReplayer(
+        trace, speed=speed, seed=seed, scenario=f"chaos:{scenario}",
+        request_timeout_s=request_timeout_s,
+    )
+    was_enabled = FAULTS.enabled
+    FAULTS.enable()
+    conductor.start()
+    try:
+        replay_report = replayer.run(target)
+    finally:
+        conductor.stop()
+        FAULTS.reset()
+        if was_enabled:
+            FAULTS.enable()
+    # the gate keys envelopes by the LIBRARY scenario name
+    replay_report.scenario = scenario
+    report = ChaosReport(
+        seed=int(seed), scenario=scenario, schedule=schedule,
+        ledger=list(conductor.ledger), replay=replay_report,
+    )
+    report.violations = _verify(replay_report, conductor)
+    return report
+
+
+__all__ = [
+    "ChaosConductor",
+    "ChaosReport",
+    "chaos_schedule",
+    "run_chaos",
+]

@@ -104,6 +104,9 @@ class _RequestProbe:
     t_last: Optional[float] = None
     max_gap_s: float = 0.0
     tool_calls: int = 0
+    # every token batch as delivered: the exactly-once evidence — under
+    # faults (failover, hedging) this must still equal the final result
+    streamed: list = field(default_factory=list)
 
     def on_tokens(self, tokens) -> None:
         now = time.monotonic()
@@ -112,6 +115,7 @@ class _RequestProbe:
         elif self.t_last is not None:
             self.max_gap_s = max(self.max_gap_s, now - self.t_last)
         self.t_last = now
+        self.streamed.extend(tokens)
 
 
 @dataclass
@@ -123,6 +127,7 @@ class ReplayRow:
     outcome: str = "error"  # completed | shed | cancelled | expired | error
     text: str = ""
     tokens: tuple = ()
+    streamed: tuple = ()  # what on_tokens actually delivered, in order
     finish_reason: str = ""
     ttft_ms: Optional[float] = None
     e2e_ms: Optional[float] = None
@@ -154,6 +159,16 @@ class ReplayReport:
 
     def count(self, outcome: str) -> int:
         return sum(1 for r in self.rows if r.outcome == outcome)
+
+    def stream_violations(self) -> list[int]:
+        """Indices of completed requests whose delivered stream differs
+        from the final result — the exactly-once check. Empty under the
+        router's dedupe contract no matter how many failovers or hedges
+        the request survived."""
+        return [
+            r.index for r in self.rows
+            if r.outcome == "completed" and r.streamed != r.tokens
+        ]
 
     def slo_doc(self) -> dict[str, Any]:
         ttft = [r.ttft_ms for r in self.rows if r.ttft_ms is not None]
@@ -364,6 +379,7 @@ class TraceReplayer:
         )
         out.text = result.text
         out.tokens = tuple(result.tokens)
+        out.streamed = tuple(probe.streamed)
         out.finish_reason = result.finish_reason
         out.preempts = int(getattr(result, "preempt_count", 0) or 0)
         if probe.t_first is not None:

@@ -83,7 +83,14 @@ ACP_BENCH_FLEET_MAX_TOKENS (fleet-tier fixture: affinity vs round-robin
 routing on a same-persona burst — pool-wide prefix-cache hit rate and
 TTFT p99 — plus disaggregated prefill->decode handoff TTFT vs a full
 local prefill and the KV bytes moved — emitted as the doc's additive
-``fleet`` block).
+``fleet`` block),
+ACP_BENCH_CHAOS=1 / ACP_BENCH_CHAOS_SPEED / ACP_BENCH_CHAOS_N /
+ACP_BENCH_CHAOS_DELAY_S / ACP_BENCH_CHAOS_TIMES /
+ACP_BENCH_CHAOS_HEDGE_S / ACP_BENCH_CHAOS_SEED (gray-failure fixture:
+persona storm on a 3-replica fleet with ``engine.slow_cycle`` pinned to
+one replica, hedging OFF vs ON — stuck-request e2e p99 both ways plus
+the byte-identical verdict — and one seeded chaos-conductor run's
+invariant verdict, emitted as the doc's additive ``chaos`` block).
 
 ``ACP_INVARIANTS=1`` additionally arms the engine's runtime invariant
 checker (engine/invariants.py) for every bench engine — per-dispatch state
@@ -603,6 +610,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT fleet", 900))
     if os.environ.get("ACP_BENCH_SCENARIOS", "0") == "1":
         main_schedule.append(("RESULT scenarios", 1200))
+    if os.environ.get("ACP_BENCH_CHAOS", "0") == "1":
+        main_schedule.append(("RESULT chaos", 1200))
     if os.environ.get("ACP_BENCH_FLIGHT", "0") == "1":
         main_schedule.append(("RESULT flight", 900))
     if os.environ.get("ACP_BENCH_PROF", "0") == "1":
@@ -1047,6 +1056,15 @@ def _child(args: argparse.Namespace) -> None:
             _result("scenarios", _bench_scenarios())
         except Exception as e:  # the fixture must not lose the headline
             _result("scenarios", {"error": str(e)})
+
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_CHAOS", "0") == "1"
+    ):
+        try:
+            _result("chaos", _bench_chaos())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("chaos", {"error": str(e)})
 
     if (
         not args.only_ttft
@@ -1751,7 +1769,7 @@ def _bench_scenarios() -> dict:
     defaults)."""
     import dataclasses
 
-    from agentcontrolplane_tpu.engine.engine import Engine
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
     from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
     from agentcontrolplane_tpu.faults import FAULTS
     from agentcontrolplane_tpu.fleet import FleetRouter
@@ -1826,6 +1844,147 @@ def _bench_scenarios() -> dict:
                 eng.stop()
             except Exception:
                 pass
+    return out
+
+
+def _bench_chaos() -> dict:
+    """Gray-failure fixture (ACP_BENCH_CHAOS=1) — the robustness claims
+    PR 19 makes measurable:
+
+    - **hedging arm** — a 3-replica tiny fleet with ``engine.slow_cycle``
+      pinned to ``r0`` (replica-scoped match) replays the persona storm
+      twice: hedging OFF (requests homed to the gray replica ride it to
+      the end) and hedging ON (the router's per-request watchdog
+      re-dispatches stuck requests onto a healthy replica). Recorded:
+      both arms' full SLO docs, the stuck-request tail ratio
+      ``e2e_p99_improvement`` (off/on — >1 means hedging cut the tail),
+      the hedge count, and the ``byte_identical`` verdict (a hedged
+      winner must stream exactly what the unhedged run produced).
+    - **chaos arm** — one seeded conductor run (``scenarios/chaos.py``)
+      against a fresh fleet: the full cocktail lands and the invariant
+      verdict (conservation, exactly-once streams, zero errors) is
+      recorded — ``ok: true`` is the gate claim CI's chaos smoke pins.
+
+    Knobs: ACP_BENCH_CHAOS_SPEED (10), ACP_BENCH_CHAOS_N (0 = library
+    default), ACP_BENCH_CHAOS_DELAY_S (0.3 — must clear the engines'
+    ``stall_min_s`` or throttled cycles never register as stalls),
+    ACP_BENCH_CHAOS_TIMES (200), ACP_BENCH_CHAOS_HEDGE_S (0.3),
+    ACP_BENCH_CHAOS_SEED (0)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.faults import FAULTS
+    from agentcontrolplane_tpu.fleet import FleetRouter
+    from agentcontrolplane_tpu.kernel import Store
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.scenarios import (
+        SCENARIOS,
+        byte_identical,
+        replay,
+        run_chaos,
+    )
+
+    speed = float(os.environ.get("ACP_BENCH_CHAOS_SPEED", "10"))
+    n = int(os.environ.get("ACP_BENCH_CHAOS_N", "0"))
+    delay_s = float(os.environ.get("ACP_BENCH_CHAOS_DELAY_S", "0.3"))
+    times = int(os.environ.get("ACP_BENCH_CHAOS_TIMES", "200"))
+    hedge_s = float(os.environ.get("ACP_BENCH_CHAOS_HEDGE_S", "0.3"))
+    seed = int(os.environ.get("ACP_BENCH_CHAOS_SEED", "0"))
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+    storm_kw = {"n": n} if n > 0 else {}
+
+    def build_engine():
+        cfg = dataclasses.replace(
+            PRESETS["tiny"], max_seq_len=512, vocab_size=512
+        )
+        eng = Engine(
+            config=cfg,
+            tokenizer=ByteTokenizer(),
+            max_ctx=256,
+            prefill_buckets=(32, 64, 128),
+            decode_block_size=4,
+            kv_layout="paged",
+            page_size=16,
+            max_slots=4,
+            check_invariants=armed,
+        )
+        eng.start()
+        eng.prewarm(constrained=True)
+        # one honest busy request seeds the cadence floor (the stall
+        # baseline) — prewarm never goes through the run loop, and an
+        # unseeded floor leaves the stall watchdog deaf to the throttle
+        eng.submit(
+            "warm the cadence floor",
+            SamplingParams(temperature=0.0, max_tokens=16),
+        ).result(timeout=300)
+        return eng
+
+    def build_fleet(hedge_after_s: float):
+        router = FleetRouter(
+            store=Store(), heartbeat_interval=60.0,
+            hedge_after_s=hedge_after_s,
+        )
+        engines = [build_engine() for _ in range(3)]
+        for i, eng in enumerate(engines):
+            router.add_replica(f"r{i}", eng)
+        return router, engines
+
+    def teardown(router, engines) -> None:
+        router.stop()
+        for eng in engines:
+            try:
+                eng.stop()
+            except Exception:
+                pass
+
+    out: dict = {
+        "slow_cycle": {"replica": "r0", "delay_s": delay_s, "times": times},
+        "hedge_after_s": hedge_s,
+    }
+    reports: dict = {}
+    for arm, hedge in (("hedging_off", 0.0), ("hedging_on", hedge_s)):
+        router, engines = build_fleet(hedge)
+        try:
+            trace = SCENARIOS["persona_storm"](**storm_kw)
+            FAULTS.arm(
+                "engine.slow_cycle",
+                times=times, delay_s=delay_s, replica="r0",
+            )
+            report = replay(trace, router, speed=speed, scenario="persona_storm")
+            reports[arm] = report
+            doc = report.slo_doc()
+            health = router.stats().get("health") or {}
+            doc["hedges"] = health.get("hedges", 0)
+            doc["hedge_cancels"] = health.get("hedge_cancels", 0)
+            out[arm] = doc
+        finally:
+            FAULTS.reset()
+            teardown(router, engines)
+    off = out["hedging_off"]["e2e_p99_ms"]
+    on = out["hedging_on"]["e2e_p99_ms"]
+    out["e2e_p99_improvement"] = round(off / on, 3) if on else None
+    out["byte_identical"] = byte_identical(
+        reports["hedging_off"], reports["hedging_on"]
+    )
+
+    # the seeded conductor verdict rides along so the perf doc also pins
+    # "the cocktail was survivable" — not just "hedging is fast"
+    router, engines = build_fleet(hedge_s)
+    try:
+        chaos = run_chaos(
+            router, seed=seed, speed=speed,
+            scenario_kwargs=storm_kw or None,
+        )
+        out["chaos"] = {
+            "seed": seed,
+            "ok": chaos.ok(),
+            "violations": list(chaos.violations),
+            "armed": len(chaos.ledger),
+            "scheduled": len(chaos.schedule),
+        }
+    finally:
+        teardown(router, engines)
     return out
 
 

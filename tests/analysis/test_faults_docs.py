@@ -1,0 +1,103 @@
+"""analysis/faults_docs.py: the fault-site inventory gate — the shipped
+tree must be in sync, and synthetic packages prove both drift
+directions, the non-literal-site violation, and the ``_armed.get``
+harvest path."""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+import agentcontrolplane_tpu
+from agentcontrolplane_tpu.analysis.faults_docs import (
+    check_faults_docs,
+    code_fault_sites,
+    doc_fault_sites,
+)
+
+PKG_ROOT = Path(agentcontrolplane_tpu.__file__).parent
+
+FAULTS_DOC = '''"""Switchboard.
+
+- ``engine.crash`` — documented and consumed.
+- ``tool.slow`` — documented and consumed via self._faults.
+- ``engine.page_pressure`` — consumed via the _armed.get idiom.
+"""
+'''
+
+
+def _pkg(tmp_path, faults_doc=FAULTS_DOC, consumer_src=None):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "faults.py").write_text(faults_doc)
+    if consumer_src is not None:
+        (pkg / "consumer.py").write_text(consumer_src)
+    return pkg
+
+
+def test_shipped_inventory_in_sync():
+    """The gate ``make lint-acp`` runs via --faults-docs: every consumed
+    site is catalogued in the faults.py docstring and vice versa."""
+    violations = check_faults_docs(PKG_ROOT)
+    assert not violations, "\n".join(str(v) for v in violations)
+
+
+def test_shipped_inventory_covers_the_known_sites():
+    sites, problems = code_fault_sites(PKG_ROOT)
+    assert not problems
+    documented = doc_fault_sites(PKG_ROOT / "faults.py")
+    for site in ("engine.slow_cycle", "fleet.replica_crash",
+                 "engine.page_pressure", "tool.slow"):
+        assert site in sites
+        assert site in documented
+
+
+def test_both_drift_directions_fire(tmp_path):
+    pkg = _pkg(
+        tmp_path,
+        consumer_src=(
+            "FAULTS.pop('engine.crash')\n"
+            "self._faults.pop('tool.slow', match={'name': n})\n"
+            "self._armed.get('engine.page_pressure')\n"
+            "FAULTS.pop('engine.undocumented_site')\n"  # missing from doc
+        ),
+    )
+    violations = check_faults_docs(pkg)
+    msgs = sorted(v.message for v in violations)
+    assert len(msgs) == 1  # every documented site consumed; one undocumented
+    assert "engine.undocumented_site" in msgs[0]
+    assert "missing from" in msgs[0]
+
+    # now drop a consumer: the stale bullet fires the other direction
+    (pkg / "consumer.py").write_text("FAULTS.pop('engine.crash')\n")
+    violations = check_faults_docs(pkg)
+    stale = sorted(v.message for v in violations)
+    assert len(stale) == 2
+    assert any("engine.page_pressure" in m and "no module consumes" in m
+               for m in stale)
+    assert any("tool.slow" in m and "no module consumes" in m for m in stale)
+
+
+def test_non_literal_pop_site_is_a_violation(tmp_path):
+    pkg = _pkg(
+        tmp_path,
+        consumer_src=(
+            "FAULTS.pop('engine.crash')\n"
+            "self._faults.pop('tool.slow')\n"
+            "self._armed.get('engine.page_pressure')\n"
+            "site = 'engine.' + kind\n"
+            "FAULTS.pop(site)\n"                 # dynamic: must fire
+            "other.pop(key)\n"                   # not the injector: skipped
+            "self._armed.get(site_var)\n"        # generic get: skipped
+        ),
+    )
+    violations = check_faults_docs(pkg)
+    assert len(violations) == 1
+    assert "non-literal fault site" in violations[0].message
+    assert violations[0].line == 5
+
+
+def test_missing_faults_py_is_a_violation(tmp_path):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    violations = check_faults_docs(pkg)
+    assert len(violations) == 1 and "does not exist" in violations[0].message

@@ -1,0 +1,136 @@
+"""engine/brownout.py: the degradation ladder — controller unit tests
+(pressure deltas, one-rung moves, restore hysteresis) plus the engine
+apply-seam (stall pressure sheds ``spec_len`` and calm cycles restore
+it, with the level mirrored into ``stats()``)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.brownout import (
+    LADDER,
+    BrownoutController,
+    BrownoutPolicy,
+)
+from agentcontrolplane_tpu.engine.engine import PRESETS, Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256,
+                          n_kv_heads=2)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+# -- controller (no engine) ---------------------------------------------------
+
+
+def test_due_gates_on_interval_boundaries():
+    bo = BrownoutController(BrownoutPolicy(interval=4))
+    fired = [i for i in range(1, 13) if bo.due()]
+    assert fired == [4, 8, 12]
+
+
+def test_pressure_steps_down_one_rung_per_tick():
+    """Delta judgment off CUMULATIVE counters, one rung per decision,
+    clamped at the ladder depth."""
+    bo = BrownoutController()
+    assert bo.step(sheds=0, stalls=0) == 0     # baseline tick, no delta
+    assert bo.step(sheds=0, stalls=3) == 1     # stall delta -> rung 1
+    assert bo.step(sheds=1, stalls=3) == 2     # shed delta counts too
+    assert bo.step(sheds=2, stalls=4) == 3
+    assert bo.step(sheds=9, stalls=9) == len(LADDER)  # clamped
+    assert bo.steps_down == 3
+    # an unchanged cumulative counter is calm, not pressure
+    assert bo.step(sheds=9, stalls=9) == 3
+
+
+def test_restore_hysteresis_and_whipsaw_guard():
+    """up_after consecutive calm ticks restore one rung; a single
+    pressured tick resets the calm streak so a loaded engine never
+    whipsaws back into speculative work."""
+    bo = BrownoutController(BrownoutPolicy(down_after=2, up_after=2))
+    bo.step(0, 0)
+    assert bo.step(0, 5) == 0      # pressured #1: not yet
+    assert bo.step(0, 9) == 1      # pressured #2: step down
+    assert bo.step(0, 9) == 1      # calm #1: not yet
+    assert bo.step(0, 10) == 1     # relapse: calm streak resets (and the
+    assert bo.step(0, 10) == 1     # down streak restarts); calm #1 again
+    assert bo.step(0, 10) == 0     # calm #2: restore
+    assert bo.steps_up == 1
+    assert bo.step(0, 10) == 0     # floor: never below full service
+
+
+def test_down_after_streak_requirement():
+    bo = BrownoutController(BrownoutPolicy(down_after=2, up_after=1))
+    bo.step(0, 0)
+    assert bo.step(0, 1) == 0      # pressured #1: not yet
+    assert bo.step(0, 1) == 0      # calm: streak resets (and restores n/a)
+    assert bo.step(0, 2) == 0      # pressured #1 again
+    assert bo.step(0, 3) == 1      # pressured #2: step down
+
+
+# -- engine apply-seam --------------------------------------------------------
+
+
+def _wait_for(predicate, timeout_s=30.0, interval_s=0.05):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def test_engine_sheds_and_restores_spec_len_under_stall_pressure():
+    """brownout=True + sustained stalls: the engine walks down the
+    ladder (saving ``spec_len``), mirrors the level into ``stats()``,
+    and walks back up to full service once the throttle budget drains —
+    with the saved knob value restored exactly."""
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=64,
+        prefill_buckets=(32, 64), decode_block_size=4, kv_layout="paged",
+        page_size=8, check_invariants=True,
+        brownout=True, brownout_interval=1,
+        stall_mult=2.0, stall_min_s=0.02,
+    )
+    eng.start()
+    try:
+        orig_spec = eng.spec_len
+        sp16 = SamplingParams(temperature=0.0, max_tokens=16)
+        # honest post-compile cycles settle the cadence floor first
+        eng.submit("warm the cadence floor", sp16).result(timeout=120)
+        assert eng.stats()["brownout"] == {
+            "enabled": True, "level": 0, "steps_down": 0, "steps_up": 0,
+        }
+        FAULTS.arm("engine.slow_cycle", times=6, delay_s=0.08)
+        slow = eng.submit("sustained pressure",
+                          SamplingParams(temperature=0.0, max_tokens=24))
+        assert _wait_for(lambda: eng.stats()["brownout"]["level"] >= 1), \
+            "stall pressure never stepped the ladder down"
+        assert eng.spec_len == 0  # rung 1: speculation off
+        slow.result(timeout=180)
+        # throttle drained: calm busy cycles walk the ladder back up
+        for i in range(12):
+            eng.submit(f"calm {i}", SamplingParams(temperature=0.0,
+                                                   max_tokens=8)).result(timeout=120)
+            if eng.stats()["brownout"]["level"] == 0:
+                break
+        st = eng.stats()["brownout"]
+        assert st["level"] == 0, "ladder never restored full service"
+        assert st["steps_down"] >= 1
+        assert st["steps_up"] == st["steps_down"]
+        assert eng.spec_len == orig_spec  # saved value restored exactly
+    finally:
+        eng.stop()

@@ -1,0 +1,197 @@
+"""scenarios/chaos.py: the seeded chaos conductor — schedule purity
+(same seed => same cocktail), ledger reproducibility, a live fleet
+surviving a full chaos run with the invariants armed, and the
+gray-failure acceptance test (persona storm over a fleet with one
+throttled replica, byte-identical to a clean single engine)."""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+
+import jax
+import pytest
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.fleet import FleetRouter
+from agentcontrolplane_tpu.fleet.health import HealthPolicy
+from agentcontrolplane_tpu.kernel import Store
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.scenarios import build, byte_identical, replay
+from agentcontrolplane_tpu.scenarios.chaos import (
+    ChaosConductor,
+    chaos_schedule,
+    run_chaos,
+)
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(
+    PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2
+)
+
+STORM_KW = dict(n=6, personas=2, prompt_tokens=24, prefix_tokens=16,
+                output_tokens=8)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def make_engine(**kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    eng = Engine(
+        config=CFG, tokenizer=TOK, mesh=mesh, max_slots=4, max_ctx=64,
+        prefill_buckets=(32, 64), decode_block_size=4, kv_layout="paged",
+        page_size=8, **kw,
+    )
+    eng.start()
+    return eng
+
+
+def make_fleet(n=3, **router_kw):
+    router = FleetRouter(store=Store(), heartbeat_interval=60.0, **router_kw)
+    engines = [make_engine() for _ in range(n)]
+    for i, eng in enumerate(engines):
+        router.add_replica(f"r{i}", eng)
+    return router, engines
+
+
+def teardown(router, *engines):
+    router.stop()
+    for eng in engines:
+        try:
+            eng.stop()
+        except Exception:
+            pass
+
+
+# -- pure: the schedule -------------------------------------------------------
+
+
+def test_schedule_is_a_pure_function_of_the_seed():
+    ids = ("r0", "r1", "r2")
+    a = chaos_schedule(7, replica_ids=ids, span_s=2.0, tools=True)
+    b = chaos_schedule(7, replica_ids=ids, span_s=2.0, tools=True)
+    assert a == b
+    assert a != chaos_schedule(8, replica_ids=ids, span_s=2.0, tools=True)
+    # sorted by virtual offset; every event inside the span
+    offsets = [e["offset_s"] for e in a]
+    assert offsets == sorted(offsets)
+    assert all(0.0 <= o <= 2.0 for o in offsets)
+
+
+def test_schedule_keeps_a_healthy_majority():
+    """The crash victim is never the throttled replica, and a schedule
+    with fewer than two replicas never crashes anyone."""
+    for seed in range(20):
+        sched = chaos_schedule(seed, replica_ids=("r0", "r1", "r2"))
+        by_site = {e["site"]: e for e in sched}
+        slow_victim = by_site["engine.slow_cycle"]["spec"]["replica"]
+        crash_victim = by_site["fleet.replica_crash"]["spec"]["replica"]
+        assert crash_victim != slow_victim
+    solo = chaos_schedule(3)  # single engine: no ids
+    sites = [e["site"] for e in solo]
+    assert "fleet.replica_crash" not in sites
+    assert "fleet.handoff_error" not in sites
+    assert "replica" not in next(
+        e for e in solo if e["site"] == "engine.slow_cycle"
+    )["spec"]
+
+
+def test_conductor_ledger_matches_schedule_in_order():
+    """The ledger is the reproducibility surface: every scheduled arm
+    lands, in offset order, with the spec recorded verbatim."""
+    sched = chaos_schedule(11, replica_ids=("r0", "r1"), span_s=0.2)
+    conductor = ChaosConductor(sched, speed=10.0)
+    conductor.start()
+    deadline = time.monotonic() + 10.0
+    while len(conductor.ledger) < len(sched) and time.monotonic() < deadline:
+        time.sleep(0.01)
+    conductor.stop()
+    FAULTS.reset()  # the arms above enabled the switchboard
+    assert conductor.ledger == [
+        (e["offset_s"], e["site"], e["spec"]) for e in sched
+    ]
+
+
+# -- live: one seeded run + the acceptance test -------------------------------
+
+
+def test_run_chaos_fleet_survives_and_ledger_reproduces():
+    """One seed poured over a 3-replica fleet twice: both runs hold
+    every invariant (conservation, exactly-once, zero errors) and arm
+    the identical ledger — the CLI smoke tier runs exactly this."""
+    reports = []
+    for _ in range(2):
+        router, engines = make_fleet(3)
+        try:
+            reports.append(
+                run_chaos(router, seed=3, speed=20.0,
+                          scenario_kwargs=dict(STORM_KW))
+            )
+        finally:
+            teardown(router, *engines)
+    for rep in reports:
+        assert rep.ok(), rep.violations
+        assert rep.seed == 3 and rep.scenario == "persona_storm"
+        assert len(rep.ledger) == len(rep.schedule)
+        assert rep.replay.count("completed") == STORM_KW["n"]
+        doc = rep.doc()
+        assert doc["ok"] and doc["armed"] and doc["slo"]["requests"] == 6
+    assert reports[0].schedule == reports[1].schedule
+    assert reports[0].ledger == reports[1].ledger
+    # chaos must not leak arms into the caller's next run
+    assert not FAULTS.enabled
+    assert not any(FAULTS.armed(e["site"]) for e in reports[1].schedule)
+
+
+@pytest.mark.slow
+def test_chaos_soak_multiple_seeds():
+    """Slow tier: several seeds, several cocktails — every one must hold
+    the conservation invariants (latency envelopes deliberately not
+    judged; chaos exists to stretch them)."""
+    for seed in (0, 1, 2, 7):
+        router, engines = make_fleet(3)
+        try:
+            rep = run_chaos(router, seed=seed, speed=20.0,
+                            scenario_kwargs=dict(STORM_KW))
+        finally:
+            teardown(router, *engines)
+        assert rep.ok(), (seed, rep.violations)
+
+
+def test_gray_failure_acceptance_byte_identical_to_clean_engine():
+    """THE acceptance test: a persona storm over a 3-replica fleet with
+    one replica throttled gray (hedging on) completes every request
+    exactly-once, byte-identical to the same trace on an unfaulted
+    single engine."""
+    trace = build("persona_storm", seed=5, **STORM_KW)
+    baseline = make_engine()
+    try:
+        clean = replay(trace, baseline, speed=20.0, scenario="persona_storm")
+    finally:
+        baseline.stop()
+    assert clean.count("completed") == STORM_KW["n"]
+
+    router, engines = make_fleet(
+        3, hedge_after_s=0.3, watchdog_interval_s=0.1,
+        health_policy=HealthPolicy(degrade_after=1),
+    )
+    try:
+        # honest post-compile cycles seed each replica's cadence floor
+        sp = SamplingParams(temperature=0.0, max_tokens=16)
+        for r in router.pool.replicas():
+            r.engine.submit("warm the cadence floor", sp).result(timeout=120)
+        FAULTS.arm("engine.slow_cycle", times=40, delay_s=0.1, replica="r0")
+        gray = replay(trace, router, speed=20.0, scenario="persona_storm")
+    finally:
+        teardown(router, *engines)
+    assert gray.count("completed") == STORM_KW["n"]
+    assert gray.stream_violations() == []   # exactly-once, every request
+    assert byte_identical(clean, gray)
