@@ -138,6 +138,22 @@ METRICS: tuple[MetricSpec, ...] = (
         ("fleet", "handoff", "handoff_bytes"),
         "higher", rel_tol=0.5,
     ),
+    # down-to-the-metal (PR 20): the fused leg's absolute dispatches per
+    # busy cycle now counts the absorbed residuals (swap_scatter + plain
+    # prefill) — it must hold at or under PR 13's 1.12 bar and not creep
+    # back as new dispatch sites appear; the prefetch-on swap-in stall p99
+    # is CPU wall clock (very wide band — the on/off reduction inside one
+    # doc is the real contract, pinned byte-identical by the fixture).
+    MetricSpec(
+        "metal_dispatches_per_busy_cycle",
+        ("metal", "dispatch", "dispatches_per_busy_cycle"),
+        "lower", rel_tol=0.5,
+    ),
+    MetricSpec(
+        "metal_swap_stall_p99_ms",
+        ("metal", "swap_stall", "prefetch_on_p99_ms"),
+        "lower", rel_tol=3.0,
+    ),
     # gray-failure hardening (PR 19): hedged re-dispatch must keep cutting
     # the stuck-request tail vs the no-hedging control arm (self-relative
     # ratio, judged everywhere; >1 means hedging helps), and the hedged

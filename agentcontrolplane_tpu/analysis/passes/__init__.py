@@ -8,6 +8,7 @@
 | coord-wallclock        | wall-clock decisions are leader-local            | PR 4/7 |
 | budget-sharing         | token budgets computed only in the declared seam | PR 5   |
 | dispatch-seam          | compiled-program calls only at declared seams    | PR 13  |
+| swap-stage             | host-KV prefetch stage/commit at declared seams  | PR 20  |
 | donated-after-dispatch | stale donated-buffer captures never re-dispatch  | PR 13  |
 | kv-leaf-completeness   | KV seams move cache leaves generically (ks/vs)   | PR 14  |
 | resolve-after-record   | flight finish precedes future resolution         | PR 9   |
@@ -27,6 +28,7 @@ from .kv_leaf import KvLeafPass
 from .lane_defaults import LaneDefaultsPass
 from .mirror_publish import MirrorPublishPass
 from .resolve_record import ResolveRecordPass
+from .swap_stage import SwapStagePass
 from .thread_ownership import ThreadOwnershipPass
 
 ALL_PASSES = [
@@ -36,6 +38,7 @@ ALL_PASSES = [
     CoordWallclockPass(),
     BudgetSeamPass(),
     DispatchSeamPass(),
+    SwapStagePass(),
     DonatedDispatchPass(),
     KvLeafPass(),
     ResolveRecordPass(),
@@ -56,5 +59,6 @@ __all__ = [
     "LaneDefaultsPass",
     "MirrorPublishPass",
     "ResolveRecordPass",
+    "SwapStagePass",
     "ThreadOwnershipPass",
 ]

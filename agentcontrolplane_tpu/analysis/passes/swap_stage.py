@@ -1,0 +1,92 @@
+"""swap-stage: the host-KV prefetch stage/commit split is structural.
+
+Bug class (PR 20): the async host-KV prefetcher splits a restore into a
+STAGE half (host->device copies launched a cycle early, parked on the
+slot as ``swap_staged``) and a COMMIT half (the scatter that lands the
+rows inside the next cycle's dispatch window). The overlap property — and
+its byte-identity fallback contract — regress silently: a later feature
+that stages copies from a new spot (assigning ``swap_staged`` mid-cycle)
+or lands restore rows through a new scatter/restore call site quietly
+turns overlapped copies back into blocking stalls, or worse, commits
+staged rows a fault/teardown path believed discarded. Nothing fails; the
+engine just stalls more (or replays stale rows). The split is a
+structural contract, so it gets a structural check.
+
+The rule: in any class that declares at least one ``# acp: swap-stage``
+method, (a) every assignment of a non-``None`` value to a ``swap_staged``
+attribute (launching staged host->device copies) and (b) every LOAD of
+``self._jit_swap_scatter`` / ``self._jit_swap_restore`` (landing restore
+rows) must occur inside a method carrying ``# acp: swap-stage`` or
+``# acp: megastep-seam``. The marked set IS the audited surface — the
+stage builder, the staged-commit scatter, and the blocking swap-in the
+fault paths degrade to. Clearing ``swap_staged = None`` is teardown, not
+a copy, and is allowed anywhere (fault aborts and slot teardown must stay
+free to discard a stage).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import LintPass, SourceFile, Violation, iter_classes, marked_methods, methods_of
+
+_MARKERS = ("swap-stage", "megastep-seam")
+_STAGE_ATTR = "swap_staged"
+_RESTORE_JITS = ("_jit_swap_scatter", "_jit_swap_restore")
+
+
+def _is_none(node: ast.AST) -> bool:
+    return isinstance(node, ast.Constant) and node.value is None
+
+
+class SwapStagePass(LintPass):
+    name = "swap-stage"
+
+    def run(self, sf: SourceFile) -> Iterator[Violation]:
+        for cls in iter_classes(sf):
+            if not marked_methods(sf, cls, "swap-stage"):
+                continue
+            allowed = set()
+            for marker in _MARKERS:
+                allowed |= marked_methods(sf, cls, marker)
+            for fn in methods_of(cls):
+                if fn.name in allowed:
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and not _is_none(node.value):
+                        for tgt in node.targets:
+                            if (
+                                isinstance(tgt, ast.Attribute)
+                                and tgt.attr == _STAGE_ATTR
+                            ):
+                                yield self.violation(
+                                    sf,
+                                    node,
+                                    f"staged restore copy ({tgt.attr} "
+                                    f"assigned) in {fn.name}, outside the "
+                                    "declared stage/commit surface "
+                                    f"({', '.join(sorted(allowed))}) — a "
+                                    "new stage site bypasses the prefetch "
+                                    "split's fault/teardown contract; mark "
+                                    "the method '# acp: swap-stage' or "
+                                    "route through the stage builder",
+                                )
+                    if (
+                        isinstance(node, ast.Attribute)
+                        and node.attr in _RESTORE_JITS
+                        and isinstance(node.ctx, ast.Load)
+                        and isinstance(node.value, ast.Name)
+                        and node.value.id == "self"
+                    ):
+                        yield self.violation(
+                            sf,
+                            node,
+                            f"restore-row landing self.{node.attr} in "
+                            f"{fn.name}, outside the declared stage/commit "
+                            f"surface ({', '.join(sorted(allowed))}) — a "
+                            "new commit site can land rows a fault or "
+                            "teardown path believed discarded; mark the "
+                            "method '# acp: swap-stage' or "
+                            "'# acp: megastep-seam'",
+                        )

@@ -192,6 +192,13 @@ class TPUProviderConfig(APIModel):
     # default; serve-time CLI: --tpu-host-kv-bytes. See
     # docs/serving-engine.md "KV memory tiers".
     host_kv_bytes: int = Field(default=0, ge=0)
+    # Async host-KV prefetch (paged layout): restore chunks past the first
+    # stage their host->device copies a cycle early and commit by scatter
+    # inside the next dispatch window instead of blocking the engine
+    # thread. Byte-identical on or off; only changes WHEN the copies
+    # happen. On by default; serve-time CLI: --tpu-host-prefetch. See
+    # docs/serving-engine.md "KV memory tiers".
+    host_prefetch: bool = Field(default=True)
 
 
 class OpenAIProviderConfig(APIModel):

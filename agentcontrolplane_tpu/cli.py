@@ -128,6 +128,15 @@ def _add_tpu_flags(p) -> None:
         "'KV memory tiers'); 0 = off (discard and recompute)",
     )
     p.add_argument(
+        "--tpu-host-prefetch", type=int, default=1,
+        help="async host-KV prefetch (paged layout): stage the NEXT "
+        "restore chunk's host->device copies a cycle early so the scatter "
+        "commit rides the dispatch window instead of blocking the engine "
+        "thread (byte-identical on or off; "
+        "acp_engine_kv_prefetch_commits_total counts the overlap); "
+        "1 = on (default), 0 = blocking swap-ins",
+    )
+    p.add_argument(
         "--tpu-prefix-dedup", type=int, default=1,
         help="cross-request shared-prefix page dedup (paged KV layout): "
         "requests whose page-aligned prompt prefix matches a live slot "
@@ -190,6 +199,7 @@ def _build_engine(args, coordination=None, **engine_kw):
         prefill_chunk=args.tpu_prefill_chunk,
         token_budget=args.tpu_token_budget,
         host_kv_bytes=args.tpu_host_kv_bytes,
+        host_prefetch=bool(args.tpu_host_prefetch),
         prefix_dedup=bool(args.tpu_prefix_dedup),
         megastep=bool(args.tpu_megastep),
         rate_planner=bool(args.tpu_rate_planner),

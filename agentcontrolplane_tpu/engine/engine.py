@@ -239,6 +239,16 @@ class _Slot:
     # blocked inside host->device restore copies (the host_stall phase).
     swap_entry: Optional[object] = None
     swap_stall_s: float = 0.0
+    # async host-KV prefetch (host_prefetch, paged layout): the NEXT restore
+    # chunk's rows, already launched host->device with non-blocking device
+    # puts — {"start", "n", "groups": [(ids_dev, blocks_dev), ...]} in the
+    # same pow2 page groups the blocking _swap_in_rows would scatter. The
+    # commit half consumes it next cycle (scatter inside the dispatch
+    # window, megastep-absorbed when fused) so the copy overlaps model
+    # compute instead of stalling the engine thread. Cleared on commit,
+    # fallback, abort, and swap teardown; a stale or mismatched stage is
+    # discarded and the blocking path runs — byte-identical either way.
+    swap_staged: Optional[dict] = None
     # cross-request shared-prefix dedup: (leader slot, leader rid, cut) —
     # this slot's rows [0, cut) are the leader's refcount-shared pages. A
     # follower admitted while its leader was still mid-prefill WAITS (no
@@ -409,6 +419,18 @@ class Engine:
         # what recompute would produce). 0 = off: exactly today's
         # discard-and-recompute behavior. CLI: --tpu-host-kv-bytes.
         host_kv_bytes: int = 0,
+        # async host-KV prefetch (paged layout): after each restore chunk
+        # commits, the NEXT chunk's rows are staged host->device with
+        # non-blocking device puts so the copy overlaps model compute; the
+        # scatter into pages happens inside the next cycle's dispatch
+        # window (megastep-absorbed when fused). The first restore chunk
+        # stays on the blocking path (it anchors the host_swap_slow/error
+        # fault ordering), and any stage that is stale, mismatched, or
+        # aborted by engine.prefetch_error degrades to the blocking copy —
+        # byte-identical on or off; only swap_stall_s / the host_stall
+        # flight phase shrink. Inert in the slot layout and when
+        # host_kv_bytes=0.
+        host_prefetch: bool = True,
         # cross-request shared-prefix page dedup (paged layout only): at
         # admission, a request whose page-aligned prompt prefix matches a
         # live slot's row (or an earlier member of the same admission
@@ -631,23 +653,36 @@ class Engine:
             # over its page slices (pos_base masking) and the unnormalized
             # (acc, m, l) states merge across ranks with one pmax + two
             # [S, H]-sized psums (paged_attention.py *_sp_sharded).
-            # quantize_kv also forces the reference path: the Pallas kernel
-            # has no int8 page walk yet, and the XLA reference dequantizes
-            # after the per-slot gather (the pool stays int8 in HBM)
+            # quantize_kv rides the kernel too: the int8 page walk DMAs the
+            # f32 scale twins with each fetch and dequantizes in VMEM
+            # (paged_attention.py), so the pool stays int8 in HBM and decode
+            # keeps the no-gather path.
             self._use_pallas = (
-                jax.default_backend() == "tpu"
-                and config.head_dim % 128 == 0
-                and not self.quantize_kv
+                jax.default_backend() == "tpu" and config.head_dim % 128 == 0
             )
             if jax.default_backend() == "tpu" and not self._use_pallas:
+                reason = "head_dim"
                 log.warning(
                     "paged kv_layout on TPU without the Pallas kernel: %s; "
                     "decode uses the XLA gather reference (materializes the "
                     "gathered context every step)",
-                    "quantize_kv has no int8 kernel path yet"
-                    if self.quantize_kv
-                    else f"head_dim {config.head_dim} is not a multiple of 128",
+                    f"head_dim {config.head_dim} is not a multiple of 128",
                 )
+                # a silent perf cliff deserves a first-class signal: count
+                # it and drop a flight breadcrumb so dashboards and dumps
+                # show WHY decode is on the slow path (docs/observability.md).
+                # The flight recorder doesn't exist yet this early in init,
+                # so the event is emitted right after it is constructed.
+                REGISTRY.counter_add(
+                    "acp_engine_kernel_fallbacks_total",
+                    1.0,
+                    labels={"kernel": "paged_decode", "reason": reason},
+                    help="accelerator kernel paths that fell back to the XLA "
+                    "reference at engine init (kernel= which kernel, reason= "
+                    "why); 0 on a healthy TPU deployment — the quantized "
+                    "paged-decode path dispatches the int8 Pallas walk",
+                )
+                self._kernel_fallback_reason = reason
         log.info("engine init: params+cache in %.1fs", time.monotonic() - t0)
 
         # computed ON device (jit + out_shardings) rather than device_put so
@@ -832,6 +867,10 @@ class Engine:
         self._host_pool = (
             HostKVPool(self.host_kv_bytes) if self.host_kv_bytes else None
         )
+        # mutable for bench A/B (the swap-in stall scoreboard flips it
+        # between runs); read per restore chunk, so a flip applies to the
+        # next chunk boundary, never mid-copy
+        self.host_prefetch = bool(host_prefetch)
         self.prefix_dedup = bool(prefix_dedup)
         # fleet tier (fleet/router.py): replica identity assigned at pool
         # registration — read by the fleet.replica_crash fault match in
@@ -873,6 +912,14 @@ class Engine:
         from ..observability.flight import FlightRecorder
 
         self.flight = FlightRecorder()
+        if getattr(self, "_kernel_fallback_reason", None):
+            # deferred from the _use_pallas gate (the recorder didn't exist
+            # yet); pairs with acp_engine_kernel_fallbacks_total
+            self.flight.record(
+                "kernel_fallback",
+                kernel="paged_decode",
+                reason=self._kernel_fallback_reason,
+            )
         # compute efficiency observatory (observability/profiler.py): per-
         # dispatch program telemetry, cold-compile tracking, goodput/waste
         # ledger. Public attribute like the flight recorder: REST/CLI read
@@ -1029,25 +1076,51 @@ class Engine:
 
             return verify_block  # raw; jitted standalone AND fused below
 
-        def make_megastep(mid_fn, final_fn, decode_block, verify_block):
+        def make_megastep(mid_fn, final_fn, decode_block, verify_block,
+                          plain_fn=None):
             """The fused per-cycle program (see _megastep_dispatch): one
-            compiled dispatch runs [mid-chunk KV writes] -> [final-chunk
-            continuation prefill + first-token sample] -> [decode block |
-            speculative verify], with the cache threaded phase to phase so
-            the write/read ordering is exactly the split path's dispatch
-            order. Each phase is the SAME raw body the split programs jit
-            standalone, so per-phase math is identical and greedy outputs
-            stay byte-identical. Absent phases pass None (an empty pytree:
-            presence is part of the trace, so every phase combination is
-            its own compiled shape — bounded by megastep_max_programs).
-            Donation: the cache and the decode carry arrays, matching the
-            split decode block's in-place reuse; dec_aux (temps/top_ks/
-            table/...) is host-retained across blocks and must NOT donate."""
+            compiled dispatch runs [staged swap-in scatters] -> [mid-chunk
+            KV writes] -> [plain full-prompt prefill + first-token sample]
+            -> [final-chunk continuation prefill + first-token sample] ->
+            [decode block | speculative verify], with the cache threaded
+            phase to phase so the write/read ordering is exactly the split
+            path's dispatch order. Each phase is the SAME raw body the
+            split programs jit standalone (the swaps phase is literally
+            _swap_in_rows' scatter expression; plains run the plain causal
+            program's raw body, byte-for-byte the chunked-off dispatch),
+            so per-phase math is identical and greedy outputs stay byte-
+            identical. Absent phases pass None (an empty pytree: presence
+            is part of the trace, so every phase combination is its own
+            compiled shape — bounded by megastep_max_programs). swaps is a
+            tuple of (page_ids, blocks) pow2 scatter groups; the restored
+            slots' pages are disjoint from every other phase's (page
+            ownership is per-slot), so phase order among the prefill
+            phases cannot change bytes. Donation: the cache and the decode
+            carry arrays, matching the split decode block's in-place
+            reuse; dec_aux (temps/top_ks/table/...) is host-retained
+            across blocks and must NOT donate. plain_fn is None in the
+            slot layout — plains/swaps only absorb under paged KV (their
+            padding lanes need TRASH_PAGE routing to stay harmless)."""
 
-            def megastep(params, cache, mids, finals, dec_carry, dec_aux, ver):
-                f_out = d_out = v_out = None
+            def megastep(params, cache, swaps, mids, plains, finals,
+                         dec_carry, dec_aux, ver):
+                p_out = f_out = d_out = v_out = None
+                if swaps is not None:
+                    for s_ids, s_blocks in swaps:
+                        cache = {
+                            name: cache[name].at[:, s_ids].set(s_blocks[name])
+                            for name in cache
+                        }
                 if mids is not None:
                     cache = mid_fn(params, cache, *mids)
+                if plains is not None:
+                    lanes, (p_rng, p_temps, p_top_ks, p_top_ps, p_table,
+                            p_con0, p_cst0, p_minc, p_budg) = plains
+                    cache, logits = plain_fn(params, cache, *lanes)
+                    p_out = sample_first(
+                        logits, p_rng, p_temps, p_top_ks, p_top_ps, p_table,
+                        p_con0, p_cst0, p_minc, p_budg,
+                    )
                 if finals is not None:
                     lanes, (f_rng, f_temps, f_top_ks, f_top_ps, f_table,
                             f_con0, f_cst0, f_minc, f_budg) = finals
@@ -1070,9 +1143,9 @@ class Engine:
                         params, cache, *ver
                     )
                     v_out = (out_toks, n_emit, new_states)
-                return cache, f_out, d_out, v_out
+                return cache, p_out, f_out, d_out, v_out
 
-            return jax.jit(megastep, donate_argnums=(1, 4))
+            return jax.jit(megastep, donate_argnums=(1, 6))
 
         if self.kv_layout == "paged":
             from ..models.llama import (
@@ -1133,6 +1206,9 @@ class Engine:
                 ),
                 decode_block,
                 verify_block,
+                plain_fn=lambda params, pages, toks, lens, page_ids: (
+                    prefill_paged_batch(params, pages, toks, lens, page_ids, config)
+                ),
             )
         else:
 
@@ -2858,18 +2934,27 @@ class Engine:
         ]
 
     def _run_restores(
-        self, restores: list
-    ) -> tuple[set, int]:
-        """Dispatch this round's host-tier swap-in rows (host->device
-        copies — issued immediately in every mode; a copy cannot ride the
-        fused model program). Returns ``(aborted_slots, refunded_tokens)``:
-        a restore the ``engine.host_swap_error`` fault cancelled dispatched
-        nothing, so its budget refunds and it stays out of the round's
-        flight/counter record."""
+        self, restores: list, defer: bool = False
+    ) -> tuple[set, int, list]:
+        """Dispatch or stage-commit this round's host-tier swap-in rows.
+        The blocking path issues the host->device copies immediately; a
+        chunk whose rows were prefetched last cycle (_stage_swap_in)
+        instead commits the already-staged device arrays — with
+        ``defer=True`` (a fused cycle) the staged scatter rides the
+        megastep as its swaps phase, so the deferred entries
+        ``(slot, sl, st, n, groups)`` come back for _megastep_dispatch /
+        _dispatch_pending_split to land. Returns ``(aborted_slots,
+        refunded_tokens, deferred)``: a restore the
+        ``engine.host_swap_error`` fault cancelled dispatched nothing, so
+        its budget refunds and it stays out of the round's flight/counter
+        record; a stage the ``engine.prefetch_error`` fault aborts (or a
+        stale/mismatched stage) degrades to the blocking copy, byte-
+        identically — the scatter writes the same rows either way."""
         aborted: set[int] = set()
         refund = 0
+        deferred: list = []
         if not restores:
-            return aborted, refund
+            return aborted, refund, deferred
         with self._hol_clock():
             for slot, sl, st, n in restores:
                 if self._faults.enabled and st == 0:
@@ -2892,15 +2977,130 @@ class Engine:
                             "swap_recompute", self._swap_in_cut(sl)
                         )
                         sl.swap_entry = None
+                        sl.swap_staged = None
                         aborted.add(slot)
                         refund += n
                         continue
-                sl.swap_stall_s += self._swap_in_rows(slot, sl.swap_entry, st, n)
-                sl.prefill_pos = st + n
-                self._seq_lens[slot] = sl.prefill_pos
-                if sl.prefill_pos >= self._swap_in_cut(sl):
-                    self._finish_swap_in(slot, sl)
-        return aborted, refund
+                staged, sl.swap_staged = sl.swap_staged, None
+                use_staged = (
+                    staged is not None
+                    and staged["start"] == st
+                    and staged["n"] == n
+                )
+                if use_staged and self._faults.enabled:
+                    if self._faults.pop("engine.prefetch_error") is not None:
+                        # aborted async stage: drop the staged copies and
+                        # run the blocking swap-in — same bytes land, only
+                        # the overlap (and its stall saving) is lost
+                        self.flight.record(
+                            "prefetch_abort", rid=sl.request.rid, slot=slot,
+                            start=st,
+                        )
+                        use_staged = False
+                if use_staged and defer:
+                    deferred.append((slot, sl, st, n, staged["groups"]))
+                    continue
+                if use_staged:
+                    sl.swap_stall_s += self._commit_staged_swap(
+                        staged["groups"]
+                    )
+                else:
+                    sl.swap_stall_s += self._swap_in_rows(
+                        slot, sl.swap_entry, st, n
+                    )
+                self._advance_restore(slot, sl, st, n)
+        return aborted, refund, deferred
+
+    def _advance_restore(self, slot: int, sl: _Slot, st: int, n: int) -> None:
+        """Post-commit bookkeeping for one restore chunk (shared by the
+        blocking path, the staged split commit, and the megastep's swaps-
+        phase commit): advance the host mirrors, finish the swap-in at the
+        cut, and otherwise stage the NEXT chunk's rows so the copy
+        overlaps the rest of this cycle's compute."""
+        sl.prefill_pos = st + n
+        self._seq_lens[slot] = sl.prefill_pos
+        if sl.prefill_pos >= self._swap_in_cut(sl):
+            self._finish_swap_in(slot, sl)
+        elif self.host_prefetch and self.kv_layout == "paged":
+            self._stage_swap_in(slot, sl)
+
+    def _commit_staged_swap(self, groups: list) -> float:  # acp: megastep-seam # acp: kv-seam # acp: swap-stage
+        """Commit half of the prefetch split (split-dispatch form): scatter
+        the staged device arrays into the pages with the SAME jitted
+        scatter the blocking path uses — ids and blocks hold identical
+        values, so the cache bytes are identical; the host->device copy
+        already overlapped last cycle's compute, so the only blocking cost
+        left is the dispatch itself."""
+        t0 = time.monotonic()
+        P = self.page_size
+        for ids, blocks in groups:
+            m = int(ids.shape[0])
+            fn = self._jit_swap_scatter.get(m)
+            if fn is None:
+                fn = jax.jit(
+                    lambda c, ids, blocks: {
+                        name: c[name].at[:, ids].set(blocks[name])
+                        for name in c
+                    },
+                    donate_argnums=(0,),
+                )
+                self._jit_swap_scatter[m] = fn
+            prof_t0 = self.profiler.start()
+            self.cache = fn(self.cache, ids, blocks)
+            self.profiler.record(
+                f"swap_scatter[{m}]", prof_t0, out=self.cache["k"],
+                real_tokens=m * P,
+            )
+        REGISTRY.counter_add(
+            "acp_engine_kv_prefetch_commits_total", 1.0,
+            help="host-KV restore chunks whose rows were prefetched (staged "
+            "host->device a cycle early) and landed by scatter commit — the "
+            "async-prefetch overlap win; chunks NOT counted here paid the "
+            "blocking copy as host_stall",
+        )
+        return time.monotonic() - t0
+
+    def _stage_swap_in(self, slot: int, sl: _Slot) -> None:  # acp: swap-stage
+        """Stage half of the prefetch split: slice the NEXT restore
+        chunk's host rows and launch them host->device with non-blocking
+        device puts, in the same pow2 page groups the blocking
+        _swap_in_rows would scatter. Nothing is committed — the pages are
+        untouched until the commit half lands the scatter inside the next
+        cycle's dispatch window, so an invalidated slot (preempt/cancel)
+        simply drops the staged arrays. Paged layout only: the slot
+        layout's dynamic_update_slice restore stays blocking."""
+        entry = sl.swap_entry
+        start = sl.prefill_pos
+        n = min(
+            self._slot_chunk_tokens(sl, self._chunk_tokens()),
+            self._swap_in_cut(sl) - start,
+        )
+        if n <= 0:
+            sl.swap_staged = None
+            return
+        rows = {"k": entry.k, "v": entry.v}
+        if "ks" in self.cache:
+            rows["ks"] = entry.k_scale
+            rows["vs"] = entry.v_scale
+        P = self.page_size
+        pages = self._slot_pages[slot][start // P : (start + n) // P]
+        groups: list = []
+        i = 0
+        for m in _pow2_sizes(len(pages)):
+            ids = np.asarray(pages[i : i + m], dtype=np.int32)
+            lo = start + i * P
+            blocks = {
+                name: a[:, lo : lo + m * P].reshape(
+                    a.shape[0], m, P, *a.shape[2:]
+                )
+                for name, a in rows.items()
+            }
+            groups.append((
+                self._put(ids),
+                {name: self._put(b) for name, b in blocks.items()},
+            ))
+            i += m
+        sl.swap_staged = {"start": start, "n": n, "groups": groups}
 
     def _record_chunk_round(
         self, landed: list, spent: int, budget: int, restore_slots: set
@@ -3017,25 +3217,52 @@ class Engine:
         # true continuations need the offset program
         plain = [c for c in finals if c[2] == 0]
         conts = [c for c in finals if c[2] > 0]
-        aborted_slots, refund = self._run_restores(restores)
+        paged = self.kv_layout == "paged"
+        staged_ready = any(
+            c[1].swap_staged is not None
+            and c[1].swap_staged["start"] == c[2]
+            and c[1].swap_staged["n"] == c[3]
+            for c in restores
+        )
+        fused = self._use_megastep() and (
+            mids or conts or (paged and plain) or staged_ready
+        )
+        aborted_slots, refund, deferred = self._run_restores(
+            restores, defer=bool(fused and paged)
+        )
         spent -= refund
-        if self._use_megastep() and (mids or conts):
-            # fused cycle: plain finals dispatch now (and join this very
-            # cycle's decode lanes, as in the split path); mid chunks and
-            # continuation finals defer into the single fused program the
+        if fused:
+            # fused cycle: mid chunks, continuation finals — and on the
+            # paged layout plain (start-0) finals plus prefetch-staged
+            # restore scatters — defer into the single fused program the
             # decode/verify site dispatches (_megastep_dispatch). Their
             # commit bookkeeping (prefill_pos, flight, counters) rides the
             # megastep commit so nothing is recorded that didn't dispatch.
-            with self._hol_clock():
-                for batch in _pow2_chunks(plain, self.prefill_batch_max):
-                    self._prefill_group(self._chunk_items(batch))
+            # Slot-layout plain finals still dispatch immediately (and join
+            # this very cycle's decode lanes, as in the split path); an
+            # absorbed plain samples its first token INSIDE the megastep,
+            # so it joins the NEXT cycle's lanes — a scheduling shift only,
+            # greedy bytes are unchanged.
+            plains_pend: list = plain if paged else []
+            if not paged:
+                with self._hol_clock():
+                    for batch in _pow2_chunks(plain, self.prefill_batch_max):
+                        self._prefill_group(self._chunk_items(batch))
+            deferred_keys = {(c[0], c[2]) for c in deferred}
             landed_now = [
                 c for c in sched
                 if c[0] not in aborted_slots
-                and (c in plain or c[0] in restore_slots)
+                and (
+                    (c in plain and not paged)
+                    or (
+                        c[0] in restore_slots
+                        and (c[0], c[2]) not in deferred_keys
+                    )
+                )
             ]
             self._fuse_pending = {
-                "mids": mids, "finals": conts, "landed": landed_now,
+                "mids": mids, "finals": conts, "plains": plains_pend,
+                "swaps": deferred, "landed": landed_now,
                 "spent": spent, "budget": chunk_budget,
                 "restores": restore_slots,
             }
@@ -4254,16 +4481,39 @@ class Engine:
 
         pending["mids"] = [c for c in pending["mids"] if live(c)]
         pending["finals"] = [c for c in pending["finals"] if live(c)]
+        pending["plains"] = [c for c in pending["plains"] if live(c)]
+
+        def live_swap(c):
+            # a deferred staged restore stays valid only while the slot is
+            # STILL mid-restore at the staged start (a preempt/cancel since
+            # planning freed the pages the staged scatter would write)
+            slot, sl, st, _n, _groups = c
+            return (
+                self._slots.get(slot) is sl
+                and sl.prefilling
+                and sl.prefill_pos == st
+                and sl.swap_entry is not None
+            )
+
+        pending["swaps"] = [c for c in pending["swaps"] if live_swap(c)]
 
     def _dispatch_pending_split(self, pending: dict) -> None:
         """Fallback for a fused cycle that cannot (or should not) compile
         a new megastep shape: dispatch the planned lanes through the
-        already-compiled split programs, then record the round."""
+        already-compiled split programs — staged restore scatters first
+        (their rows are this cycle's oldest KV), then mid chunks, plain
+        finals, and continuation finals — then record the round."""
         self._validate_pending(pending)
         mids, conts = pending["mids"], pending["finals"]
+        plains, swaps = pending["plains"], pending["swaps"]
         with self._hol_clock():
+            for slot, sl, st, n, groups in swaps:
+                sl.swap_stall_s += self._commit_staged_swap(groups)
+                self._advance_restore(slot, sl, st, n)
             for batch in _pow2_chunks(mids, self.prefill_batch_max):
                 self._chunk_dispatch(batch)
+            for batch in _pow2_chunks(plains, self.prefill_batch_max):
+                self._prefill_group(self._chunk_items(batch))
             for batch in _pow2_chunks(conts, self.prefill_batch_max):
                 self._prefill_group(
                     self._chunk_items(batch),
@@ -4275,8 +4525,9 @@ class Engine:
             sl.prefill_pos = st + n
             self._seq_lens[slot] = sl.prefill_pos
         self._record_chunk_round(
-            pending["landed"] + mids + conts, pending["spent"],
-            pending["budget"], pending["restores"],
+            pending["landed"] + [c[:4] for c in swaps] + mids + plains
+            + conts, pending["spent"], pending["budget"],
+            pending["restores"],
         )
 
     def _megastep_flush(self, pending: Optional[dict]) -> None:
@@ -4390,6 +4641,51 @@ class Engine:
             )
         return (model_lanes, sample), bucket, Bp, chunk, ln
 
+    def _fuse_plain_lanes(self, batch: list) -> tuple:
+        """Lane arrays for the megastep's plain-prefill phase (paged
+        layout only): start-0 finals whose whole row fits one chunk run
+        the plain causal program's raw body — byte-for-byte the
+        chunked-off dispatch — padded to a power-of-two batch. Padding
+        lanes sample garbage that is never committed and route every page
+        write to TRASH_PAGE, exactly like _fuse_mid_lanes padding."""
+        chunk = self._chunk_items(batch)
+        starts = np.zeros(len(batch), dtype=np.int32)
+        ln = self._prefill_lanes(chunk, starts)
+        B = len(batch)
+        Bp = 1 << (B - 1).bit_length()
+        bucket = ln["bucket"]
+
+        def pad(a, fill):
+            if Bp == B:
+                return a
+            out = np.full((Bp, *a.shape[1:]), fill, dtype=a.dtype)
+            out[:B] = a
+            return out
+
+        self._rng, step_rng = jax.random.split(self._rng)
+        sample = (
+            step_rng,
+            self._put(pad(ln["temps"], 0)),
+            self._put(pad(ln["top_ks"], 0)),
+            self._put(pad(ln["top_ps"], 1.0)),
+            ln["table"],
+            self._put(pad(ln["con_states0"], 0)),
+            self._put(pad(ln["constrained0"], False)),
+            ln["min_close"],
+            self._put(pad(ln["budgets"], 1)),
+        )
+        P = self.page_size
+        page_ids = np.full((Bp, bucket // P), TRASH_PAGE, dtype=np.int32)
+        for i, (_req, _slot, pages, _m) in enumerate(chunk):
+            assert pages is not None
+            page_ids[i, : len(pages)] = pages
+        model_lanes = (
+            self._put(pad(ln["tokens"], 0)),
+            self._put(pad(ln["lengths"], 0)),
+            self._put(page_ids),
+        )
+        return (model_lanes, sample), bucket, Bp, chunk, ln
+
     def _megastep_dispatch(  # acp: megastep-seam
         self,
         pending: dict,
@@ -4399,33 +4695,47 @@ class Engine:
         ver_meta: Optional[dict] = None,
     ) -> Optional[bool]:
         """THE fused dispatch: one compiled program runs this cycle's
-        pending mid chunks + continuation finals + (decode block | spec
-        verify). Returns True when it dispatched and committed; None when
-        the caller must fall back to the split programs (a NEW fused shape
-        past megastep_max_programs — fusion must not turn the jit cache
-        into a combinatorial zoo, so rare shapes reuse the split programs
-        that are already compiled)."""
+        pending staged swap-in scatters + mid chunks + plain finals +
+        continuation finals + (decode block | spec verify). Returns True
+        when it dispatched and committed; None when the caller must fall
+        back to the split programs (a NEW fused shape past
+        megastep_max_programs — fusion must not turn the jit cache into a
+        combinatorial zoo, so rare shapes reuse the split programs that
+        are already compiled)."""
         self._validate_pending(pending)
         mids, finals = pending["mids"], pending["finals"]
-        if not mids and not finals:
-            if d is None and ver is None:
+        plains, swaps = pending["plains"], pending["swaps"]
+        if not mids and not finals and not plains:
+            if not swaps and d is None and ver is None:
                 # everything the cycle planned was invalidated pre-dispatch
                 self._record_chunk_round(
                     pending["landed"], pending["spent"], pending["budget"],
                     pending["restores"],
                 )
                 return True
-            return None  # nothing to fuse; run the plain decode/verify
+            if d is None and ver is None:
+                # scatter-only cycle: nothing to fuse WITH — the split
+                # commit is already a single dispatch, so a new fused
+                # shape would buy nothing
+                return None
+            if not swaps:
+                return None  # nothing to fuse; run the plain decode/verify
         # the shape key is host arithmetic — compute it and apply the
         # program bound BEFORE building/uploading any lane arrays, so a
         # fallback cycle never pays device transfers it throws away
         KB = self.decode_block_size
-        mid_bucket = mid_Bp = fin_bucket = fin_Bp = 0
+        mid_bucket = mid_Bp = fin_bucket = fin_Bp = pl_bucket = pl_Bp = 0
         if mids:
             mid_bucket = _next_bucket(
                 max(n for _, _, _, n in mids), self.prefill_buckets
             )
             mid_Bp = 1 << (len(mids) - 1).bit_length()
+        if plains:
+            pl_bucket = max(
+                _next_bucket(len(sl.prefill_row), self.prefill_buckets)
+                for _slot, sl, _st, _n in plains
+            )
+            pl_Bp = 1 << (len(plains) - 1).bit_length()
         if finals:
             fin_bucket = max(
                 _next_bucket(len(sl.prefill_row) - st, self.prefill_buckets)
@@ -4434,8 +4744,18 @@ class Engine:
             fin_Bp = 1 << (len(finals) - 1).bit_length()
         tbl = "+tbl" if self._token_table is not None else ""
         parts = []
+        if swaps:
+            # the scatter group sizes ARE the trace shape (one cache
+            # scatter per pow2 group, in order)
+            parts.append("s" + "-".join(
+                str(int(ids.shape[0]))
+                for _slot, _sl, _st, _n, groups in swaps
+                for ids, _blocks in groups
+            ))
         if mids:
             parts.append(f"m{mid_bucket}x{mid_Bp}")
+        if plains:
+            parts.append(f"p{pl_bucket}x{pl_Bp}")
         if finals:
             parts.append(f"f{fin_bucket}x{fin_Bp}")
         W = T = 0
@@ -4458,10 +4778,20 @@ class Engine:
                 "distinct fused jit entries)",
             )
             return None
-        mid_lanes = fin_lanes = None
-        fin_chunk = fin_ln = None
+        mid_lanes = fin_lanes = pl_lanes = swap_arg = None
+        fin_chunk = fin_ln = pl_chunk = pl_ln = None
+        if swaps:
+            swap_arg = tuple(
+                (ids, blocks)
+                for _slot, _sl, _st, _n, groups in swaps
+                for ids, blocks in groups
+            )
         if mids:
             mid_lanes, mid_bucket, mid_Bp = self._fuse_mid_lanes(mids)
+        if plains:
+            pl_lanes, pl_bucket, pl_Bp, pl_chunk, pl_ln = (
+                self._fuse_plain_lanes(plains)
+            )
         if finals:
             fin_lanes, fin_bucket, fin_Bp, fin_chunk, fin_ln = (
                 self._fuse_final_lanes(finals)
@@ -4481,24 +4811,36 @@ class Engine:
         new_shape = shape not in self._megastep_shapes
         self._megastep_shapes.add(shape)
         prof_t0 = self.profiler.start()
-        cache, f_out, d_out, v_out = self._jit_megastep(
-            self.params, self.cache, mid_lanes, fin_lanes, dec_carry,
-            dec_aux, ver,
+        cache, p_out, f_out, d_out, v_out = self._jit_megastep(
+            self.params, self.cache, swap_arg, mid_lanes, pl_lanes,
+            fin_lanes, dec_carry, dec_aux, ver,
         )
         self.megastep_dispatches += 1
         if new_shape:
             self.flight.record("megastep_shape", program=key)
         mid_real = sum(n for _, _, _, n in mids)
+        pl_real = int(pl_ln["lengths"].sum()) if plains else 0
         fin_real = int(fin_ln["lengths"].sum()) if finals else 0
+        swap_real = sum(
+            int(ids.shape[0]) * self.page_size for ids, _ in (swap_arg or ())
+        )
         if self.profiler.enabled:
-            real = mid_real + fin_real
+            # swap rows count as real tokens only (the split scatter
+            # records real_tokens with no goodput accounting; fused keeps
+            # that) — restored rows are moved KV, not computed tokens
+            real = mid_real + pl_real + fin_real + swap_real
             padded = 0
             if mids:
                 padded += mid_Bp * mid_bucket - mid_real
+            if plains:
+                padded += pl_Bp * pl_bucket - pl_real
             if finals:
                 padded += fin_Bp * fin_bucket - fin_real
-            real_slots = len(mids) + len(finals)
-            padded_slots = (mid_Bp - len(mids)) + (fin_Bp - len(finals))
+            real_slots = len(mids) + len(plains) + len(finals)
+            padded_slots = (
+                (mid_Bp - len(mids)) + (pl_Bp - len(plains))
+                + (fin_Bp - len(finals))
+            )
             if d is not None:
                 real += n_act * KB
                 padded += (W - n_act) * KB
@@ -4513,6 +4855,7 @@ class Engine:
                 d_out[0] if d_out is not None
                 else v_out[0] if v_out is not None
                 else f_out[0] if f_out is not None
+                else p_out[0] if p_out is not None
                 else cache["k"]  # chunks-only: block on the committed KV
             )
             self.profiler.record(
@@ -4530,6 +4873,17 @@ class Engine:
                     pad_bucket=len(mids) * mid_bucket - mid_real,
                     pad_fuse=(mid_Bp - len(mids)) * mid_bucket,
                 )
+            if plains:
+                pre = sum(
+                    int(pl_ln["lengths"][i])
+                    for i, (r, _, _, _) in enumerate(pl_chunk)
+                    if r.prewarm
+                )
+                self.profiler.account(
+                    goodput=pl_real - pre, prewarm=pre,
+                    pad_bucket=len(plains) * pl_bucket - pl_real,
+                    pad_fuse=(pl_Bp - len(plains)) * pl_bucket,
+                )
             if finals:
                 pre = sum(
                     int(fin_ln["lengths"][i])
@@ -4544,17 +4898,30 @@ class Engine:
         # ONE host round trip for every phase's results (None phases fetch
         # nothing — device_get maps over the pytree)
         carry = d_out[1] if d_out is not None else None
-        f_np, dec_fetch, ver_np = jax.device_get((
+        f_np, p_np, dec_fetch, ver_np = jax.device_get((
             f_out,
+            p_out,
             (carry[2], d_out[0]) if d_out is not None else None,
             v_out,
         ))
         self.cache = cache
-        # commit order matters: mid chunks advance first (bookkeeping
-        # only), then the decode/verify commit — its lanes predate this
-        # cycle's finals, so it must run BEFORE finals flip their slots to
-        # ACTIVE (a freed-and-reused slot id would otherwise read garbage
-        # lanes) — and the finals commit last.
+        # commit order matters: swap bookkeeping and mid chunks advance
+        # first (bookkeeping only — their cache writes already landed in
+        # the program), then the decode/verify commit — its lanes predate
+        # this cycle's finals, so it must run BEFORE finals/plains flip
+        # their slots to ACTIVE (a freed-and-reused slot id would
+        # otherwise read garbage lanes) — and the finals/plains commit
+        # last. A fused restore adds NO stall seconds: the host->device
+        # copy overlapped last cycle and the scatter rode this dispatch.
+        for slot, sl, st, n, _groups in swaps:
+            REGISTRY.counter_add(
+                "acp_engine_kv_prefetch_commits_total", 1.0,
+                help="host-KV restore chunks whose rows were prefetched "
+                "(staged host->device a cycle early) and landed by scatter "
+                "commit — the async-prefetch overlap win; chunks NOT "
+                "counted here paid the blocking copy as host_stall",
+            )
+            self._advance_restore(slot, sl, st, n)
         for slot, sl, st, n in mids:
             sl.prefill_pos = st + n
             self._seq_lens[slot] = sl.prefill_pos
@@ -4572,9 +4939,16 @@ class Engine:
             self._finish_prefill_dispatch(
                 fin_chunk, firsts[:B], fstates[:B], fin_ln["full_lens"]
             )
+        if plains:
+            p_firsts, p_states = p_np
+            B = len(plains)
+            self._finish_prefill_dispatch(
+                pl_chunk, p_firsts[:B], p_states[:B], pl_ln["full_lens"]
+            )
         self._record_chunk_round(
-            pending["landed"] + mids + finals, pending["spent"],
-            pending["budget"], pending["restores"],
+            pending["landed"] + [c[:4] for c in swaps] + mids + plains
+            + finals, pending["spent"], pending["budget"],
+            pending["restores"],
         )
         return True
 

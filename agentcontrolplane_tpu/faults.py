@@ -59,6 +59,14 @@ Engine sites (see ``engine/engine.py``):
   slot recomputes from its restored position. Deterministic and graceful
   — the host tier is an optimization, so every failure degrades to
   today's discard-and-recompute path, byte-identically.
+- ``engine.prefetch_error`` — abort the next ``times=N`` async host-KV
+  prefetch commits (the staged host->device restore copies launched a
+  cycle ahead by the paged engine's swap-in prefetcher): the staged
+  arrays are discarded and the chunk degrades to the blocking
+  ``_swap_in_rows`` copy, byte-identically — prefetch only overlaps WHEN
+  the copy happens, never what lands in the pages. Each abort records a
+  ``prefetch_abort`` flight event; the lost overlap shows up as
+  ``host_stall`` seconds that prefetch would have hidden.
 - ``engine.spec_mismatch`` — force the WORST CASE for speculative decoding:
   for the next ``times=N`` verify dispatches every draft token is treated
   as mismatched (full rejection), so each dispatch commits exactly one

@@ -1059,11 +1059,6 @@ def decode_step_paged(
     positions = seq_lens[:, None]
     x = _embed(params, tokens[:, None], c)
     quantized = "ks" in pages
-    if quantized and use_pallas:
-        # the Pallas kernel has no int8 page walk (future work); the engine
-        # disables the kernel when quantize_kv is on, and this guard keeps a
-        # direct caller from silently reading int8 bytes as bf16
-        raise ValueError("quantized KV pages require the XLA reference path")
     tp_size = sp_size = 1
     if mesh is not None:
         axes = dict(zip(mesh.axis_names, mesh.devices.shape))
@@ -1074,6 +1069,11 @@ def decode_step_paged(
         x = carry
         layer, k_kv, v_kv = scanned  # read-only (value + optional scales)
         k_pages_l, v_pages_l = k_kv[0], v_kv[0]
+        # int8 pages carry f32 scale twins; the Pallas path DMAs them with
+        # each page fetch and dequantizes in VMEM (same formula as the
+        # reference, so the parity pin holds bit-for-bit in f32)
+        k_scales_l = k_kv[1] if quantized else None
+        v_scales_l = v_kv[1] if quantized else None
 
         def attn(q, k, v):
             if use_pallas and (tp_size > 1 or sp_size > 1):
@@ -1086,6 +1086,7 @@ def decode_step_paged(
                 out = paged_decode_attention_cache_plus_new_sharded(
                     mesh, q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
                     k[:, 0], v[:, 0],
+                    k_scales=k_scales_l, v_scales=v_scales_l,
                 )
             elif use_pallas:
                 from ..ops.pallas.paged_attention import (
@@ -1095,6 +1096,7 @@ def decode_step_paged(
                 out = paged_decode_attention_cache_plus_new(
                     q[:, 0], k_pages_l, v_pages_l, block_tables, seq_lens,
                     k[:, 0], v[:, 0],
+                    k_scales=k_scales_l, v_scales=v_scales_l,
                 )
             else:
                 out = paged_decode_attention_reference_cache_plus_new(

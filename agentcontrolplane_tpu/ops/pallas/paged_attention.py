@@ -26,6 +26,14 @@ as multiply+reduce — a batched matvec (empty lhs non-contracting dims)
 trips a Mosaic TPU_DotDimensionNumbersAttr round-trip bug on real
 hardware, and at these shapes the MXU has nothing to offer over the VPU.
 
+int8 page walk: with ``k_scales``/``v_scales`` (the allocator's per-row-
+per-head f32 scale twins, natural [num_pages, P, H_kv] layout) each page
+fetch also DMAs its scale rows on dedicated semaphore lanes and the body
+dequantizes in VMEM — ``value.astype(f32) * scale`` (exactly
+``ops.quant.kv_dequantize``), so quantized paged decode keeps the kernel
+path AND int8's HBM-bandwidth win: the f32 copy of a page only ever exists
+in VMEM scratch.
+
 Tested in interpreter mode on CPU against the exact reference; runs compiled
 on TPU (tests/engine/test_tpu_hardware.py).
 """
@@ -54,20 +62,35 @@ def _kernel(
     q_ref,  # [1, H, d] (VMEM) — this program's slot
     k_pages_ref,  # [num_pages, P_local, H_kv * d] (HBM/ANY)
     v_pages_ref,  # [num_pages, P_local, H_kv * d]
+    # quantized=True only: ks_pages_ref / vs_pages_ref
+    #   [num_pages, P_local, H_kv] f32 (HBM/ANY) — per-row-per-head scales
     # outputs
-    acc_ref,  # [1, H, d] f32 — unnormalized weighted V sum
-    m_ref,  # [1, 1, H] f32 — running max (unit middle dim: TPU block shapes
-    l_ref,  # [1, 1, H] f32 — need the trailing dims to tile or match)
+    # acc_ref: [1, H, d] f32 — unnormalized weighted V sum
+    # m_ref:   [1, 1, H] f32 — running max (unit middle dim: TPU block shapes
+    # l_ref:   [1, 1, H] f32 — need the trailing dims to tile or match)
     # scratch
-    k_buf,  # [NBUF, P_local, H_kv * d] (VMEM)
-    v_buf,  # [NBUF, P_local, H_kv * d]
-    sems,  # DMA sems [NBUF, 2]
-    *,
+    # k_buf / v_buf: [NBUF, P_local, H_kv * d] (VMEM)
+    # quantized=True only: ks_buf / vs_buf [NBUF, P_local, H_kv] f32 (VMEM)
+    # sems: DMA sems [NBUF, 4 if quantized else 2]
+    *rest,
     page_size: int,  # GLOBAL page size (pages hold this many tokens)
     n_kv_heads: int,
     head_dim: int,
     max_pages: int,
+    quantized: bool = False,
 ):
+    # int8 walk (quantized=True): pages hold int8 values plus f32 scale
+    # twins ([.., P, H_kv], one scale per row per KV head). The fetch loop
+    # DMAs the scale rows alongside the pages on their own semaphore lanes
+    # and the body dequantizes in VMEM — value * scale, identical to
+    # ops.quant.kv_dequantize — so int8 decode takes the kernel path with
+    # the same (acc, m, l) contract as the f32 walk.
+    if quantized:
+        (ks_pages_ref, vs_pages_ref, acc_ref, m_ref, l_ref,
+         k_buf, v_buf, ks_buf, vs_buf, sems) = rest
+    else:
+        acc_ref, m_ref, l_ref, k_buf, v_buf, sems = rest
+        ks_pages_ref = vs_pages_ref = ks_buf = vs_buf = None
     # Under context-parallel serving each rank holds a [P_local = P/sp]
     # slice of every page (pos_base = rank * P_local); the walk length and
     # token positions are computed with the GLOBAL page size so masking is
@@ -90,11 +113,17 @@ def _kernel(
         page = block_tables_ref[s, j]
         pltpu.make_async_copy(k_pages_ref.at[page], k_buf.at[slot], sems.at[slot, 0]).start()
         pltpu.make_async_copy(v_pages_ref.at[page], v_buf.at[slot], sems.at[slot, 1]).start()
+        if quantized:
+            pltpu.make_async_copy(ks_pages_ref.at[page], ks_buf.at[slot], sems.at[slot, 2]).start()
+            pltpu.make_async_copy(vs_pages_ref.at[page], vs_buf.at[slot], sems.at[slot, 3]).start()
 
     def wait_fetch(j, slot):
         page = block_tables_ref[s, j]
         pltpu.make_async_copy(k_pages_ref.at[page], k_buf.at[slot], sems.at[slot, 0]).wait()
         pltpu.make_async_copy(v_pages_ref.at[page], v_buf.at[slot], sems.at[slot, 1]).wait()
+        if quantized:
+            pltpu.make_async_copy(ks_pages_ref.at[page], ks_buf.at[slot], sems.at[slot, 2]).wait()
+            pltpu.make_async_copy(vs_pages_ref.at[page], vs_buf.at[slot], sems.at[slot, 3]).wait()
 
     # page walks are small-transfer latency-bound: keep NBUF-1 fetches in
     # flight (ramp pages 0..NBUF-2 here, steady state issues j+NBUF-1)
@@ -121,6 +150,12 @@ def _kernel(
         # into a reshape of q/p — no [P, H, d] repeated materialization
         k = k_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
         v = v_buf[slot].reshape(P, n_kv_heads, d).astype(jnp.float32)
+        if quantized:
+            # dequantize in VMEM: value * per-row-per-head scale, exactly
+            # kv_dequantize — masked rows (stale scales incl. TRASH_PAGE)
+            # stay finite, so the pos mask zeroes their weight as in f32
+            k = k * ks_buf[slot].reshape(P, n_kv_heads, 1)
+            v = v * vs_buf[slot].reshape(P, n_kv_heads, 1)
         qg = q.reshape(n_kv_heads, n_rep, d)
         # logits via multiply+reduce, NOT dot_general (see module doc)
         logits = (
@@ -160,13 +195,22 @@ def _paged_state(
     interpret: bool = False,
     pos_base: jax.Array | None = None,  # [1] int32 — sp rank's page offset
     global_page_size: int | None = None,  # tokens per page (sp>1: > P_local)
+    k_scales: jax.Array | None = None,  # [num_pages, P_local, H_kv] f32
+    v_scales: jax.Array | None = None,  # (int8 pages: per-row-per-head)
 ) -> tuple[jax.Array, jax.Array, jax.Array]:
-    """Run the kernel -> unnormalized (acc [S,H,d] f32, m [S,H], l [S,H])."""
+    """Run the kernel -> unnormalized (acc [S,H,d] f32, m [S,H], l [S,H]).
+
+    With ``k_scales``/``v_scales`` the pages are int8 and the kernel DMAs
+    the scale rows alongside each page fetch (natural [num_pages, P, H_kv]
+    layout — no lane padding; the transfers are small and strided, which
+    Mosaic handles, and the VMEM dequant keeps int8's HBM-bandwidth win).
+    """
     S, H, d = q.shape
     num_pages, P, H_kv, _ = k_pages.shape
     max_pages = block_tables.shape[1]
     if pos_base is None:
         pos_base = jnp.zeros((1,), dtype=jnp.int32)
+    quantized = k_scales is not None
 
     kernel = functools.partial(
         _kernel,
@@ -174,25 +218,49 @@ def _paged_state(
         n_kv_heads=H_kv,
         head_dim=d,
         max_pages=max_pages,
+        quantized=quantized,
     )
+    in_specs = [
+        pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
+        pl.BlockSpec(memory_space=pl.ANY),
+        pl.BlockSpec(memory_space=pl.ANY),
+    ]
+    scratch_shapes = [
+        pltpu.VMEM((NBUF, P, H_kv * d), k_pages.dtype),
+        pltpu.VMEM((NBUF, P, H_kv * d), v_pages.dtype),
+    ]
+    operands = [
+        block_tables,
+        seq_lens,
+        pos_base.astype(jnp.int32),
+        q,
+        k_pages.reshape(num_pages, P, H_kv * d),
+        v_pages.reshape(num_pages, P, H_kv * d),
+    ]
+    if quantized:
+        in_specs += [
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
+        ]
+        scratch_shapes += [
+            pltpu.VMEM((NBUF, P, H_kv), jnp.float32),
+            pltpu.VMEM((NBUF, P, H_kv), jnp.float32),
+        ]
+        operands += [
+            k_scales.astype(jnp.float32),
+            v_scales.astype(jnp.float32),
+        ]
+    scratch_shapes.append(pltpu.SemaphoreType.DMA((NBUF, 4 if quantized else 2)))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=3,
         grid=(S,),
-        in_specs=[
-            pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec(memory_space=pl.ANY),
-            pl.BlockSpec(memory_space=pl.ANY),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, H, d), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, H), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1, H), lambda s, *_: (s, 0, 0), memory_space=pltpu.VMEM),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((NBUF, P, H_kv * d), k_pages.dtype),
-            pltpu.VMEM((NBUF, P, H_kv * d), v_pages.dtype),
-            pltpu.SemaphoreType.DMA((NBUF, 2)),
-        ],
+        scratch_shapes=scratch_shapes,
     )
     acc, m, l = pl.pallas_call(
         kernel,
@@ -203,14 +271,7 @@ def _paged_state(
             jax.ShapeDtypeStruct((S, 1, H), jnp.float32),
         ],
         interpret=interpret,
-    )(
-        block_tables,
-        seq_lens,
-        pos_base.astype(jnp.int32),
-        q,
-        k_pages.reshape(num_pages, P, H_kv * d),
-        v_pages.reshape(num_pages, P, H_kv * d),
-    )
+    )(*operands)
     return acc, m[:, 0], l[:, 0]
 
 
@@ -221,9 +282,15 @@ def paged_decode_attention(
     block_tables: jax.Array,  # [S, max_pages] int32
     seq_lens: jax.Array,  # [S] int32 — valid tokens per slot (already written)
     interpret: bool = False,
+    *,
+    k_scales: jax.Array | None = None,  # [num_pages, P, H_kv] f32 — int8 pages
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """Attention over written pages only (the classic form)."""
-    acc, _m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
+    acc, _m, l = _paged_state(
+        q, k_pages, v_pages, block_tables, seq_lens, interpret,
+        k_scales=k_scales, v_scales=v_scales,
+    )
     out = acc / jnp.maximum(l, 1e-30)[..., None]
     return out.astype(q.dtype)
 
@@ -264,21 +331,40 @@ def paged_decode_attention_cache_plus_new(
     k_new: jax.Array,  # [S, H_kv, d]
     v_new: jax.Array,
     interpret: bool = False,
+    *,
+    k_scales: jax.Array | None = None,  # [num_pages, P, H_kv] f32 — int8 pages
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """Kernel over the read-only pages + the new token's self term, merged
-    outside the kernel."""
-    acc, m, l = _paged_state(q, k_pages, v_pages, block_tables, seq_lens, interpret)
+    outside the kernel. The new token's k/v stay full-precision (they are
+    not yet written to pages), so no scales apply to the self term."""
+    acc, m, l = _paged_state(
+        q, k_pages, v_pages, block_tables, seq_lens, interpret,
+        k_scales=k_scales, v_scales=v_scales,
+    )
     return _fold_self_term(q, k_new, v_new, acc, m, l)
 
 
-def _shard_wrap(fn, mesh, interpret, extra_sharded=()):
+def _shard_wrap(fn, mesh, interpret, extra_sharded=(), with_scales=False):
     from jax.sharding import PartitionSpec as P
 
     q_spec = P(None, "tp", None)
     pages_spec = P(None, None, "tp", None)
     in_specs = (q_spec, pages_spec, pages_spec, P(None, None), P(None)) + extra_sharded
+    if with_scales:
+        # scale twins shard with the pages' KV-head axis; ``interpret`` sits
+        # before the scale params in the wrapped signatures, so map the two
+        # trailing positionals back to keywords instead of partial()ing
+        scale_spec = P(None, None, "tp")
+        in_specs = in_specs + (scale_spec, scale_spec)
+        body = lambda q, kp, vp, bt, sl, *rest: fn(  # noqa: E731
+            q, kp, vp, bt, sl, *rest[:-2],
+            interpret=interpret, k_scales=rest[-2], v_scales=rest[-1],
+        )
+    else:
+        body = functools.partial(fn, interpret=interpret)
     return jax.shard_map(
-        functools.partial(fn, interpret=interpret),
+        body,
         mesh=mesh,
         in_specs=in_specs,
         out_specs=q_spec,
@@ -294,10 +380,17 @@ def paged_decode_attention_sharded(
     block_tables: jax.Array,  # replicated
     seq_lens: jax.Array,  # replicated
     interpret: bool = False,
+    *,
+    k_scales: jax.Array | None = None,  # [num_pages, P, H_kv] — heads over 'tp'
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     """tp>1 wrapper: GSPMD treats pallas_call as opaque, so we shard_map it —
     each shard runs the kernel over its local head slice (attention is
     head-parallel; page tables are shared), no collectives needed."""
+    if k_scales is not None:
+        return _shard_wrap(paged_decode_attention, mesh, interpret, with_scales=True)(
+            q, k_pages, v_pages, block_tables, seq_lens, k_scales, v_scales
+        )
     return _shard_wrap(paged_decode_attention, mesh, interpret)(
         q, k_pages, v_pages, block_tables, seq_lens
     )
@@ -313,6 +406,9 @@ def paged_decode_attention_cache_plus_new_sp_sharded(
     k_new: jax.Array,  # [S, H_kv, d] — heads over 'tp', replicated over 'sp'
     v_new: jax.Array,
     interpret: bool = False,
+    *,
+    k_scales: jax.Array | None = None,  # [num_pages, P, H_kv] — P over 'sp',
+    v_scales: jax.Array | None = None,  # heads over 'tp'
 ) -> jax.Array:
     """Context-parallel kernel wrapper: each sp rank holds a 1/sp slice of
     every page and runs the kernel over it (pos_base = rank * P_local, so
@@ -321,19 +417,23 @@ def paged_decode_attention_cache_plus_new_sp_sharded(
     psums of [S, H]-sized values — the online-softmax merge, never a
     gathered context. The self term folds once after the merge (replicated
     over sp). Composes with tp (heads stay head-parallel, no collectives
-    on that axis)."""
+    on that axis). int8 pages ride along: the scale twins shard exactly
+    like the pages ('sp' on rows, 'tp' on KV heads)."""
     from jax.sharding import PartitionSpec as P
 
     axes = dict(zip(mesh.axis_names, mesh.devices.shape))
     sp = axes.get("sp", 1)
     P_global = k_pages.shape[1]
     P_local = P_global // sp
+    quantized = k_scales is not None
 
-    def body(q, kp, vp, bt, sl, kn, vn):
+    def body(q, kp, vp, bt, sl, kn, vn, *scales):
         pos_base = (jax.lax.axis_index("sp") * P_local).reshape(1)
         acc, m, l = _paged_state(
             q, kp, vp, bt, sl, interpret,
             pos_base=pos_base, global_page_size=P_global,
+            k_scales=scales[0] if scales else None,
+            v_scales=scales[1] if scales else None,
         )
         m_g = jax.lax.pmax(m, "sp")
         corr = jnp.exp(m - m_g)
@@ -344,14 +444,20 @@ def paged_decode_attention_cache_plus_new_sp_sharded(
     q_spec = P(None, "tp", None)
     pages_spec = P(None, "sp", "tp", None)
     new_spec = P(None, "tp", None)
+    in_specs = (q_spec, pages_spec, pages_spec, P(None, None), P(None),
+                new_spec, new_spec)
+    operands = [q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new]
+    if quantized:
+        scale_spec = P(None, "sp", "tp")
+        in_specs = in_specs + (scale_spec, scale_spec)
+        operands += [k_scales, v_scales]
     return jax.shard_map(
         body,
         mesh=mesh,
-        in_specs=(q_spec, pages_spec, pages_spec, P(None, None), P(None),
-                  new_spec, new_spec),
+        in_specs=in_specs,
         out_specs=q_spec,
         check_vma=False,
-    )(q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new)
+    )(*operands)
 
 
 def paged_decode_attention_cache_plus_new_sharded(
@@ -364,6 +470,9 @@ def paged_decode_attention_cache_plus_new_sharded(
     k_new: jax.Array,  # [S, H_kv, d] — KV heads sharded over 'tp'
     v_new: jax.Array,
     interpret: bool = False,
+    *,
+    k_scales: jax.Array | None = None,  # [num_pages, P, H_kv] f32 — int8 pages
+    v_scales: jax.Array | None = None,
 ) -> jax.Array:
     from jax.sharding import PartitionSpec as P
 
@@ -371,9 +480,18 @@ def paged_decode_attention_cache_plus_new_sharded(
     if axes.get("sp", 1) > 1:
         return paged_decode_attention_cache_plus_new_sp_sharded(
             mesh, q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new,
-            interpret,
+            interpret, k_scales=k_scales, v_scales=v_scales,
         )
     new_spec = P(None, "tp", None)
+    if k_scales is not None:
+        return _shard_wrap(
+            paged_decode_attention_cache_plus_new,
+            mesh,
+            interpret,
+            extra_sharded=(new_spec, new_spec),
+            with_scales=True,
+        )(q, k_pages, v_pages, block_tables, seq_lens, k_new, v_new,
+          k_scales, v_scales)
     return _shard_wrap(
         paged_decode_attention_cache_plus_new,
         mesh,

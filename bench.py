@@ -62,6 +62,12 @@ contract, emitted as the doc's additive ``prof`` block with the burst's
 goodput ratio),
 ACP_BENCH_MEGASTEP=1 (fused-megastep dispatches-per-cycle A/B; knobs
 ACP_BENCH_MEGASTEP_DECODERS/_PROMPT/_LONGS/_CHUNK/_TAIL_TOKENS/_KV_LAYOUT),
+ACP_BENCH_METAL=1 / ACP_BENCH_METAL_TASKS / ACP_BENCH_METAL_TAIL_TOKENS /
+ACP_BENCH_METAL_KV_PAGES / ACP_BENCH_METAL_CHUNK (down-to-the-metal
+fixture: swap-in stall p99 with async host-KV prefetch off vs on, and
+dispatches-per-busy-cycle with the PR 20 absorbed swap/plain megastep
+phases vs split — both byte-identical, emitted as the doc's additive
+``metal`` block),
 ACP_BENCH_MEM=1 / ACP_BENCH_MEM_PROMPT / ACP_BENCH_MEM_TASKS /
 ACP_BENCH_MEM_PERSONA / ACP_BENCH_MEM_HOST_BYTES (KV memory-tier
 fixture: preempt->resume swap-in vs recompute-prefill latency, and
@@ -588,6 +594,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
                 doc["prof"] = val
             elif key == "megastep" and "megastep" not in doc:
                 doc["megastep"] = val
+            elif key == "metal" and "metal" not in doc:
+                doc["metal"] = val
             else:
                 return
             _flush_doc(doc)
@@ -618,6 +626,8 @@ def _parent_run(doc: dict, notes: list[str]) -> None:
         main_schedule.append(("RESULT prof", 900))
     if os.environ.get("ACP_BENCH_MEGASTEP", "0") == "1":
         main_schedule.append(("RESULT megastep", 900))
+    if os.environ.get("ACP_BENCH_METAL", "0") == "1":
+        main_schedule.append(("RESULT metal", 900))
     if ttft_on:
         main_schedule.append(("RESULT ttft", ttft_timeout))
 
@@ -1093,6 +1103,15 @@ def _child(args: argparse.Namespace) -> None:
         except Exception as e:  # the fixture must not lose the headline
             _result("megastep", {"error": str(e)})
 
+    if (
+        not args.only_ttft
+        and os.environ.get("ACP_BENCH_METAL", "0") == "1"
+    ):
+        try:
+            _result("metal", _bench_metal())
+        except Exception as e:  # the fixture must not lose the headline
+            _result("metal", {"error": str(e)})
+
     if ttft_on or args.only_ttft:
         try:
             _result("ttft", _bench_ttft(engine))
@@ -1240,6 +1259,254 @@ def _bench_megastep() -> dict:
         }
     finally:
         engine.stop()
+
+
+def _bench_metal() -> dict:
+    """Down-to-the-metal fixture (ACP_BENCH_METAL=1): PR 20's two wins.
+
+    (a) **Swap-in stall, prefetch off vs on**: an oversubscribed paged
+    engine (the pressure workload tests/engine/test_prefetch.py pins) —
+    preemptions swap KV to the host tier and resumes swap it back over
+    several chunked cycles while survivors keep decoding. Reported: the
+    p99 of the flight recorder's ``swap_in`` ``stall_s`` (blocked
+    host->device copy seconds per restore, the ``host_stall``-attributed
+    phase) with ``host_prefetch`` off (every restore chunk pays the
+    blocking copy) vs on (chunks past the first commit rows staged a
+    cycle early — ``acp_engine_kv_prefetch_commits_total`` counts the
+    overlap). Byte-identical by contract.
+
+    (b) **Dispatches per busy cycle with the absorbed phases**: the PR 13
+    megastep workload shape (short decoders streaming while long prompts
+    chunk through them) re-run with host-KV pool pressure so swap
+    round-trips ride the measured window, and with the dispatch count
+    now including the residuals PR 20 absorbs — standalone
+    ``swap_scatter`` commits and plain ``prefill`` dispatches — split
+    (``megastep=False``) vs fused. PR 13 recorded 1.12 with the residuals
+    unfused; the fused leg's absolute number is the trend series
+    (``metal_dispatches_per_busy_cycle``) and must hold at or under that
+    bar. Byte-identical fused vs split.
+
+    Knobs: ACP_BENCH_METAL_TASKS (default 6, part a),
+    ACP_BENCH_METAL_KV_PAGES (10, part a), ACP_BENCH_METAL_DECODERS (6),
+    ACP_BENCH_METAL_PROMPT (1024), ACP_BENCH_METAL_LONGS (4),
+    ACP_BENCH_METAL_CHUNK (64), ACP_BENCH_METAL_TAIL_TOKENS (96)."""
+    import dataclasses
+
+    from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+    from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+    from agentcontrolplane_tpu.models.llama import PRESETS
+    from agentcontrolplane_tpu.observability.metrics import REGISTRY
+
+    armed = os.environ.get("ACP_INVARIANTS", "") not in ("", "0")
+    # the megastep CYCLE_KINDS plus the dispatches PR 20 absorbs:
+    # standalone staged-restore scatters and (paged) plain start-0 prefills
+    KINDS = (
+        "megastep", "chunk", "decode", "spec_verify", "prefill_cont",
+        "prefill", "spill", "swap_scatter",
+    )
+
+    def dispatches(eng) -> int:
+        return sum(
+            v["dispatches"]
+            for k, v in eng.profiler.stats()["programs"].items()
+            if k.split("[")[0] in KINDS
+        )
+
+    def chunk_cycles(eng) -> int:
+        # prefill_round fires once per scheduler cycle that carried chunk
+        # work (restore rounds included) — the busy-cycle denominator
+        return sum(1 for _ in eng.flight.events(kind="prefill_round", last=4096))
+
+    def commits() -> float:
+        m = REGISTRY._metrics.get("acp_engine_kv_prefetch_commits_total")
+        return 0.0 if m is None else m.values.get((), 0.0)
+
+    def p99_ms(stalls: list[float]) -> float:
+        if not stalls:
+            return 0.0
+        s = sorted(stalls)
+        return round(s[min(len(s) - 1, int(0.99 * len(s)))] * 1e3, 2)
+
+    # -- (a) swap-in stall p99: prefetch off vs on --------------------------
+    n_req = int(os.environ.get("ACP_BENCH_METAL_TASKS", "6"))
+    kv_pages = int(os.environ.get("ACP_BENCH_METAL_KV_PAGES", "10"))
+    cfg = dataclasses.replace(
+        PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2
+    )
+    eng = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout="paged",
+        page_size=8,
+        kv_pages=kv_pages,
+        host_kv_bytes=1 << 22,
+        prefill_chunk=16,
+        prefix_cache_entries=0,  # later legs must not skip earlier prefills
+        check_invariants=armed,
+    )
+    eng.start()
+    try:
+        prompts = [[10 + i] * 20 for i in range(n_req)]
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        solo = [eng.generate(list(p), sp).tokens for p in prompts]
+
+        rounds = int(os.environ.get("ACP_BENCH_METAL_ROUNDS", "4"))
+
+        def stall_leg(prefetch_on: bool, n_rounds: int = rounds) -> dict:
+            # several pressure rounds per leg: each round forms ~1 swap
+            # round-trip, and the p99 needs a population, not one sample
+            eng.host_prefetch = prefetch_on
+            t0 = time.monotonic()
+            k0, s0 = commits(), eng.kv_swap_ins
+            toks = []
+            for _ in range(n_rounds):
+                with eng.hold_admission():
+                    futs = [eng.submit(list(p), sp) for p in prompts]
+                toks.append([f.result(timeout=1800).tokens for f in futs])
+            stalls = [
+                e["detail"]["stall_s"]
+                for e in eng.flight.events(kind="swap_in", last=4096)
+                if e["t"] >= t0
+            ]
+            return {
+                "tokens": toks,
+                "stall_p99_ms": p99_ms(stalls),
+                "swap_ins": eng.kv_swap_ins - s0,
+                "commits": int(commits() - k0),
+            }
+
+        stall_leg(False, 1)  # warm both paths' shapes outside the measurement
+        stall_leg(True, 1)
+        s_off = stall_leg(False)
+        s_on = stall_leg(True)
+        stall_identical = all(
+            rt == solo for rt in s_off["tokens"] + s_on["tokens"]
+        )
+        reduction = (
+            round(s_off["stall_p99_ms"] / s_on["stall_p99_ms"], 2)
+            if s_on["stall_p99_ms"] > 0 else 0.0
+        )
+        swap_part = {
+            "tasks": n_req,
+            "kv_pages": kv_pages,
+            "prefetch_off_p99_ms": s_off["stall_p99_ms"],
+            "prefetch_on_p99_ms": s_on["stall_p99_ms"],
+            "stall_reduction_x": reduction,
+            "swap_ins_off": s_off["swap_ins"],
+            "swap_ins_on": s_on["swap_ins"],
+            "prefetch_commits": s_on["commits"],
+            "byte_identical": stall_identical,
+        }
+    finally:
+        eng.stop()
+
+    # -- (b) dispatches per busy cycle, split vs fused, absorbed phases -----
+    from agentcontrolplane_tpu.testing import FAULTS
+
+    n_dec = int(os.environ.get("ACP_BENCH_METAL_DECODERS", "6"))
+    plen = int(os.environ.get("ACP_BENCH_METAL_PROMPT", "1024"))
+    n_long = int(os.environ.get("ACP_BENCH_METAL_LONGS", "4"))
+    chunk = int(os.environ.get("ACP_BENCH_METAL_CHUNK", "64"))
+    dec_budget = int(os.environ.get("ACP_BENCH_METAL_TAIL_TOKENS", "96"))
+    page = 16
+    max_ctx = plen + 2 * chunk
+    # comfortable pool (organic pressure preemption would be timing-shaped);
+    # swap round-trips are injected DETERMINISTICALLY instead: each leg arms
+    # ``engine.force_preempt`` mid-decode, so two decoders swap out to the
+    # host tier and restore over chunked cycles while the longs keep
+    # chunking — the staged scatter commits ride the measured busy cycles
+    need = n_dec * ((48 + dec_budget) // page + 1) + n_long * (max_ctx // page)
+    cfg = dataclasses.replace(PRESETS["tiny"], max_seq_len=max_ctx, vocab_size=512)
+    eng = Engine(
+        config=cfg,
+        tokenizer=ByteTokenizer(),
+        max_slots=n_dec + 2,
+        max_ctx=max_ctx,
+        prefill_buckets=(64, chunk, plen),
+        decode_block_size=4,
+        kv_layout="paged",
+        page_size=page,
+        kv_pages=need + 8,
+        host_kv_bytes=64 << 20,
+        prefill_chunk=chunk,
+        prefix_cache_entries=0,
+        check_invariants=armed,
+    )
+    eng.start()
+    try:
+        shorts = [[2 + ((i + j) % 200) for j in range(48)] for i in range(n_dec)]
+        longs = [
+            [1 + ((i + j) % 250) for j in range(plen - 8 * i)]
+            for i in range(n_long)
+        ]
+        dec_sp = SamplingParams(temperature=0.0, max_tokens=dec_budget)
+        one = SamplingParams(temperature=0.0, max_tokens=4)
+
+        def dispatch_leg(mega_on: bool) -> dict:
+            eng.megastep = mega_on
+            d0, c0, s0 = dispatches(eng), chunk_cycles(eng), eng.kv_swap_ins
+            futs = [eng.submit(list(s), dec_sp) for s in shorts]
+            for f in futs:
+                f.admitted.result(timeout=1800)
+            # victims at ~10 decode blocks in carry 80+ rows: the restore
+            # is multi-chunk, so its later chunks stage and absorb
+            FAULTS.arm(
+                "engine.force_preempt", after_steps=eng.decode_steps + 10,
+                times=2,
+            )
+            long_futs = [eng.submit(list(p), one) for p in longs]
+            results = [f.result(timeout=1800) for f in futs + long_futs]
+            FAULTS.reset()
+            cycles = max(1, chunk_cycles(eng) - c0)
+            return {
+                "tokens": [r.tokens for r in results],
+                "per_cycle": round((dispatches(eng) - d0) / cycles, 2),
+                "busy_cycles": cycles,
+                "swap_ins": eng.kv_swap_ins - s0,
+            }
+
+        for mega_on in (False, True):  # compiles land outside the legs
+            dispatch_leg(mega_on)
+        eng.profiler.mark_prewarmed()
+
+        d_off = dispatch_leg(mega_on=False)
+        d_on = dispatch_leg(mega_on=True)
+        dispatch_identical = d_off["tokens"] == d_on["tokens"]
+        dispatch_part = {
+            "decoders": n_dec,
+            "long_prompts": n_long,
+            "prompt_tokens": plen,
+            "chunk": chunk,
+            "kv_pages": need + 8,
+            "split_per_busy_cycle": d_off["per_cycle"],
+            "dispatches_per_busy_cycle": d_on["per_cycle"],
+            "busy_cycles": d_on["busy_cycles"],
+            "swap_ins": d_on["swap_ins"],
+            "within_pr13_bar": d_on["per_cycle"] <= 1.12,
+            "byte_identical": dispatch_identical,
+        }
+    finally:
+        eng.stop()
+
+    return {
+        "swap_stall": swap_part,
+        "dispatch": dispatch_part,
+        "note": (
+            f"swap-in stall p99 {swap_part['prefetch_on_p99_ms']}ms "
+            f"prefetch-on vs {swap_part['prefetch_off_p99_ms']}ms off "
+            f"({swap_part['stall_reduction_x']}x; "
+            f"{swap_part['prefetch_commits']} staged commits landed); busy "
+            f"cycles pay {dispatch_part['dispatches_per_busy_cycle']} "
+            f"dispatch(es) with absorbed swap/plain phases vs "
+            f"{dispatch_part['split_per_busy_cycle']} split "
+            f"({dispatch_part['swap_ins']} swap round-trips in-window, "
+            "PR 13 bar 1.12), both byte-identical"
+        ),
+    }
 
 
 def _bench_tool_turn(engine) -> dict:

@@ -555,6 +555,59 @@ def test_dispatch_seam_allows_builder_stores_and_unmarked_classes(tmp_path):
     assert analyze([root]) == []
 
 
+def test_swap_stage_fires_outside_declared_surface(tmp_path):
+    """A new stage site (swap_staged assigned mid-cycle) or restore-row
+    landing (a _jit_swap_* load) from an unmarked method of a class that
+    adopted the prefetch split bypasses its fault/teardown contract."""
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _stage_swap_in(self, sl):  # acp: swap-stage
+                sl.swap_staged = {"groups": []}
+
+            def _sneaky_stage(self, sl):
+                sl.swap_staged = {"groups": [1]}
+
+            def _sneaky_commit(self):
+                fn = self._jit_swap_scatter
+                return fn(self.cache)
+        """,
+    )
+    violations = analyze([root])
+    assert _rules(violations) == ["swap-stage", "swap-stage"]
+    assert "_sneaky_stage" in violations[0].message
+    assert "_sneaky_commit" in violations[1].message
+
+
+def test_swap_stage_allows_teardown_and_marked_methods(tmp_path):
+    root = _write(
+        tmp_path,
+        "eng.py",
+        """
+        class Engine:
+            def _stage_swap_in(self, sl):  # acp: swap-stage
+                sl.swap_staged = {"groups": []}
+
+            def _swap_in_rows(self, slot):  # acp: megastep-seam
+                # the blocking fallback is part of the declared surface
+                return self._jit_swap_restore(self.cache)
+
+            def _preempt(self, sl):
+                # clearing a stage is teardown, not a copy — fault aborts
+                # and slot teardown discard stages from anywhere
+                sl.swap_staged = None
+
+        class NeverAdoptedPrefetch:
+            def restore(self, sl):
+                # no swap-stage method declared: out of scope
+                sl.swap_staged = {"groups": []}
+        """,
+    )
+    assert analyze([root]) == []
+
+
 # -- suppression pragma -------------------------------------------------------
 
 
@@ -1224,7 +1277,7 @@ def test_runner_json_findings_doc(tmp_path, capsys):
     assert doc["version"] == 1
     assert doc["counts"]["violations"] == 1
     assert doc["counts"]["by_rule"] == {"jit-purity": 1}
-    assert doc["counts"]["rules_total"] == 10
+    assert doc["counts"]["rules_total"] == 11
     assert doc["counts"]["suppressions_total"] == 1
     [v] = doc["violations"]
     assert v["rule"] == "jit-purity" and v["path"] == "models/bad.py"

@@ -1,0 +1,155 @@
+"""Async host-KV prefetch (ISSUE 20): the stage/commit split of swap-in.
+
+The load-bearing guarantees:
+
+- **Byte identity, prefetch on vs off** — the prefetcher only changes
+  WHEN the host->device restore copies happen (a cycle early, overlapped
+  with compute), never what lands in the pages, so greedy output under an
+  oversubscribed pool is bit-identical with ``host_prefetch`` on or off.
+- **The overlap actually happens** — multi-chunk restores commit staged
+  rows (``acp_engine_kv_prefetch_commits_total``), not blocking copies.
+- **Graceful degradation** — an ``engine.prefetch_error``-aborted stage
+  (and any stale stage) falls back to the blocking copy byte-identically,
+  recording a ``prefetch_abort`` flight event.
+- **Megastep absorption** — on a fused paged cycle the staged scatter
+  rides the megastep as its swaps phase (an ``s...`` part in the fused
+  program key) instead of dispatching standalone.
+"""
+
+import dataclasses
+import time
+
+import pytest
+
+import jax
+
+from agentcontrolplane_tpu.engine.engine import Engine, SamplingParams
+from agentcontrolplane_tpu.engine.invariants import verify_engine
+from agentcontrolplane_tpu.engine.tokenizer import ByteTokenizer
+from agentcontrolplane_tpu.models.llama import PRESETS
+from agentcontrolplane_tpu.observability.metrics import REGISTRY
+from agentcontrolplane_tpu.parallel.mesh import make_mesh
+from agentcontrolplane_tpu.testing import FAULTS
+
+TOK = ByteTokenizer()
+CFG = dataclasses.replace(PRESETS["tiny"], vocab_size=512, max_seq_len=256, n_kv_heads=2)
+
+
+def make_engine(**kw):
+    mesh = make_mesh({"tp": 2}, devices=jax.devices()[:2])
+    kw.setdefault("check_invariants", True)
+    kw.setdefault("prefix_cache_entries", 0)
+    eng = Engine(
+        config=CFG,
+        tokenizer=TOK,
+        mesh=mesh,
+        max_slots=4,
+        max_ctx=64,
+        prefill_buckets=(32, 64),
+        decode_block_size=4,
+        kv_layout="paged",
+        page_size=8,
+        **kw,
+    )
+    eng.start()
+    return eng
+
+
+def counter(name: str) -> float:
+    m = REGISTRY._metrics.get(name)
+    return 0.0 if m is None else m.values.get((), 0.0)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    yield
+    FAULTS.reset()
+
+
+def _settle(eng: Engine) -> None:
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline and (eng._has_work() or len(eng._waiting)):
+        time.sleep(0.01)
+    time.sleep(0.1)
+
+
+def _pressure_run(eng):
+    """Oversubscribed pool: preemptions swap KV out and resumes swap it
+    back in over several chunked cycles while survivors keep decoding —
+    the workload where prefetch has something to overlap with."""
+    sp = SamplingParams(temperature=0.0, max_tokens=12)
+    prompts = [ch * 20 for ch in "abcdef"]
+    solo = {p: eng.generate(p, sp).tokens for p in prompts}
+    with eng.hold_admission():
+        futs = [eng.submit(p, sp) for p in prompts]
+    results = {p: f.result(timeout=300) for p, f in zip(prompts, futs)}
+    for p, r in results.items():
+        assert r.tokens == solo[p], f"swap round-trip diverged for {p!r}"
+    return [results[p].tokens for p in prompts]
+
+
+def test_prefetch_on_off_byte_identity_and_overlap_counted():
+    outs = {}
+    for pf in (False, True):
+        before = counter("acp_engine_kv_prefetch_commits_total")
+        eng = make_engine(
+            kv_pages=10, host_kv_bytes=1 << 22, prefill_chunk=16,
+            host_prefetch=pf,
+        )
+        try:
+            outs[pf] = _pressure_run(eng)
+            assert eng.kv_swap_ins >= 1, "no swap round-trip formed"
+            committed = (
+                counter("acp_engine_kv_prefetch_commits_total") - before
+            )
+            if pf:
+                assert committed > 0, "prefetch never staged a commit"
+            else:
+                assert committed == 0, "host_prefetch=False still staged"
+            _settle(eng)
+            assert verify_engine(eng) == []
+        finally:
+            eng.stop()
+    assert outs[True] == outs[False], "prefetch changed sampled bytes"
+
+
+def test_prefetch_error_degrades_to_blocking_copy_identically():
+    eng = make_engine(kv_pages=10, host_kv_bytes=1 << 22, prefill_chunk=16)
+    try:
+        sp = SamplingParams(temperature=0.0, max_tokens=12)
+        prompts = [ch * 20 for ch in "abcdef"]
+        solo = {p: eng.generate(p, sp).tokens for p in prompts}
+        FAULTS.arm("engine.prefetch_error", times=2)
+        with eng.hold_admission():
+            futs = [eng.submit(p, sp) for p in prompts]
+        for p, f in zip(prompts, futs):
+            assert f.result(timeout=300).tokens == solo[p], (
+                f"prefetch abort diverged for {p!r}"
+            )
+        aborts = eng.flight.events(kind="prefetch_abort")
+        assert aborts, "armed engine.prefetch_error never fired"
+        _settle(eng)
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
+
+
+def test_staged_scatter_absorbs_into_megastep_swaps_phase():
+    """A restore chunk committing while other slots decode must ride the
+    fused program (an ``s...`` part in a megastep key) rather than
+    dispatch its scatter standalone."""
+    eng = make_engine(
+        kv_pages=10, host_kv_bytes=1 << 22, prefill_chunk=16, megastep=True,
+    )
+    try:
+        _pressure_run(eng)
+        _settle(eng)
+        keys = eng.profiler.stats()["programs"]
+        fused_swap = [
+            k for k in keys
+            if k.startswith("megastep[") and ",s" in k.replace("+s", ",s")
+        ]
+        assert fused_swap, f"no fused swaps-phase program key in {sorted(keys)}"
+        assert verify_engine(eng) == []
+    finally:
+        eng.stop()
